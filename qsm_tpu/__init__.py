@@ -1,0 +1,27 @@
+"""qsm_tpu — TPU-native state-machine property testing & linearizability
+checking, with the capability surface of
+``advancedtelematic/quickcheck-state-machine-distributed`` (see SURVEY.md).
+
+Layers (mirroring SURVEY.md §1, redesigned TPU-first):
+
+* ``qsm_tpu.core``     — spec protocol, history encoding, generation/shrinking,
+  sequential runner (reference L3/L6 pure parts)
+* ``qsm_tpu.sched``    — deterministic PULSE-style scheduler, in-memory actor
+  transport, concurrent runner, fault injection (reference L0–L2, L4)
+* ``qsm_tpu.ops``      — linearisers: ``WingGongCPU`` oracle and the batched
+  ``JaxTPU`` branch-and-bound kernel (reference L5)
+* ``qsm_tpu.models``   — the five milestone specs + correct/racy SUT pairs
+  (reference L7)
+* ``qsm_tpu.parallel`` — mesh/sharding for batch-parallel checking at scale
+* ``qsm_tpu.utils``    — config, structured logging, CLI
+"""
+
+from .core.spec import CmdSig, Spec, compile_step_table
+from .core.history import (EncodedBatch, History, Op, encode_batch,
+                           overlapping_history, sequential_history)
+from .core.generator import Program, ProgOp, generate_program, shrink_candidates
+from .core.sequential import ModelSUT, run_sequential
+from .ops.backend import LineariseBackend, Verdict, check_one
+from .ops.wing_gong_cpu import WingGongCPU
+
+__version__ = "0.1.0"

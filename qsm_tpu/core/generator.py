@@ -1,0 +1,130 @@
+"""Seeded program generation and shrinking.
+
+The reference generates random command sequences with QuickCheck's ``Gen`` and
+minimizes failures with ``shrink`` — dropping/simplifying commands and
+re-checking, which produces the "thousands of shrunk histories" workload the
+TPU kernel batches (SURVEY.md §2 Generator/shrinker, §3.5; BASELINE.json:5).
+
+A *program* here is a prefix-free parallel program: every op is assigned to a
+pid, and each pid executes its ops in order.  All nondeterminism flows from an
+explicit seed, so (seed, config) reproduces any program exactly — the
+determinism contract shrinking soundness depends on (SURVEY.md §7 hard-parts
+#4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Iterator, List, Tuple
+
+from .spec import Spec
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgOp:
+    """One generated command, assigned to a logical process."""
+
+    pid: int
+    cmd: int
+    arg: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Program:
+    """A parallel program: ops in global generation order; per-pid order is
+    the subsequence with that pid."""
+
+    ops: Tuple[ProgOp, ...]
+    n_pids: int
+
+    def per_pid(self) -> List[List[ProgOp]]:
+        out: List[List[ProgOp]] = [[] for _ in range(self.n_pids)]
+        for op in self.ops:
+            out[op.pid].append(op)
+        return out
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+
+def generate_program(
+    spec: Spec, seed: int, n_pids: int, max_ops: int, min_ops: int = 1
+) -> Program:
+    """Seeded, precondition-respecting program generation.
+
+    Commands come from ``spec.gen_cmd`` (uniform by default); sizes ramp the
+    way QuickCheck sizes do — smaller programs early in a trial sequence are
+    handled by the caller passing a smaller ``max_ops``.
+    """
+    rng = random.Random(seed)
+    n_ops = rng.randint(min_ops, max_ops)
+    ops = []
+    # Track an approximate model state so preconditions can be respected:
+    # advance with the model's first valid response, the way the reference
+    # generates against the advancing model (SURVEY.md §3.4).  For concurrent
+    # programs this is heuristic (the real interleaving differs), which is
+    # why preconditions must be *generation-time* restrictions only.
+    state = [int(v) for v in spec.initial_state()]
+    for _ in range(n_ops):
+        pid = rng.randrange(n_pids)
+        cmd, arg = spec.gen_cmd(rng, state)
+        ops.append(ProgOp(pid=pid, cmd=cmd, arg=arg))
+        for resp in spec.resp_domain(cmd):
+            new_state, ok = spec.step_py(list(state), cmd, arg, resp)
+            if ok:
+                state = [int(v) for v in new_state]
+                break
+    return Program(ops=tuple(ops), n_pids=n_pids)
+
+
+# ---------------------------------------------------------------------------
+# Shrinking
+# ---------------------------------------------------------------------------
+
+def shrink_candidates(spec: Spec, prog: Program) -> Iterator[Program]:
+    """Yield smaller candidate programs, most-aggressive first.
+
+    Mirrors QuickCheck's list shrinking: drop halves, then single ops, then
+    shrink individual args toward zero (SURVEY.md §3.5).  Candidates preserve
+    per-pid ordering of the surviving ops.  Deduplication/ordering is the
+    caller's concern; this is a pure enumeration.
+    """
+    ops = list(prog.ops)
+    n = len(ops)
+    # 1. drop contiguous chunks (halving sizes, like QC's shrinkList)
+    k = n // 2
+    while k >= 1:
+        for start in range(0, n - k + 1, k):
+            rest = ops[:start] + ops[start + k:]
+            if rest:
+                yield Program(tuple(rest), prog.n_pids)
+        k //= 2
+    # 2. shrink individual args
+    for i, op in enumerate(ops):
+        for smaller in spec.shrink_arg(op.cmd, op.arg):
+            cand = list(ops)
+            cand[i] = ProgOp(op.pid, op.cmd, smaller)
+            yield Program(tuple(cand), prog.n_pids)
+    # 3. move ops onto fewer pids (pid renumber toward 0)
+    used = sorted({op.pid for op in ops})
+    if len(used) > 1:
+        drop = used[-1]
+        cand = [ProgOp(0 if op.pid == drop else op.pid, op.cmd, op.arg)
+                for op in ops]
+        yield Program(tuple(cand), prog.n_pids)
+
+
+def dedupe(programs: Iterator[Program], limit: int) -> List[Program]:
+    """Collect up to ``limit`` distinct candidates preserving order."""
+    seen = set()
+    out = []
+    for p in programs:
+        key = (p.n_pids, p.ops)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(p)
+        if len(out) >= limit:
+            break
+    return out

@@ -1,0 +1,182 @@
+"""Histories: invocation/response event sequences and their array encoding.
+
+The reference collects a ``History cmd resp`` — a sequence of per-pid
+invocation and response events — from ``runCommands`` and feeds it to the
+lineariser (SURVEY.md §0 items 3-4, names anchored on BASELINE.json:5).
+
+TPU-first redesign: a history is encoded to **fixed-shape int arrays** so that
+thousands of histories batch into one device call (BASELINE.json:9):
+
+    ops[B, N, 4]      = (pid, cmd, arg, resp) per operation
+    interval[B, N, 2] = (invoke_time, response_time) logical timestamps
+    valid[B, N]       = operation exists (histories are ragged; N is a bucket)
+    pending[B, N]     = invoked but never responded (crash/fault injection);
+                        the checker may prune or complete these (SURVEY.md §3.2)
+
+``N`` (MAX_OPS) is bucketed to {12, 24, 32, 48, 64} to bound XLA
+recompilation across the five milestone configs (BASELINE.json:7-11).
+
+The real-time precedence partial order needed by Wing-Gong is derived, not
+stored: op *i* precedes op *j* iff ``response_time[i] < invoke_time[j]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+OP_BUCKETS = (12, 24, 32, 48, 64)
+
+# Sentinel response for pending operations (no response observed).
+NO_RESP = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class Op:
+    """One completed or pending operation in a concurrent history."""
+
+    pid: int
+    cmd: int
+    arg: int
+    resp: int  # NO_RESP if pending
+    invoke_time: int
+    response_time: int  # large sentinel (>= any time) if pending
+
+    @property
+    def is_pending(self) -> bool:
+        return self.resp == NO_RESP
+
+
+@dataclasses.dataclass
+class History:
+    """A single concurrent history plus its provenance.
+
+    ``ops`` are in invocation order.  ``seed`` / ``program_id`` make every
+    failure replayable from (seed, config) alone — the reference's
+    checkpoint/resume philosophy (SURVEY.md §5).
+    """
+
+    ops: List[Op]
+    seed: Optional[int] = None
+    program_id: Optional[int] = None
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    @property
+    def n_pending(self) -> int:
+        return sum(1 for o in self.ops if o.is_pending)
+
+    def completed(self) -> "History":
+        """Drop pending ops (prune-all completion)."""
+        return History([o for o in self.ops if not o.is_pending],
+                       seed=self.seed, program_id=self.program_id)
+
+    def precedes_matrix(self) -> np.ndarray:
+        """bool[n, n]: strict real-time precedence (resp_i < inv_j)."""
+        n = len(self.ops)
+        inv = np.array([o.invoke_time for o in self.ops], np.int64)
+        ret = np.array([o.response_time for o in self.ops], np.int64)
+        pend = np.array([o.is_pending for o in self.ops], bool)
+        # A pending op precedes nothing (its response never happened).
+        mat = ret[:, None] < inv[None, :]
+        mat[pend, :] = False
+        np.fill_diagonal(mat, False)
+        return mat
+
+
+def bucket_for(n_ops: int) -> int:
+    for b in OP_BUCKETS:
+        if n_ops <= b:
+            return b
+    raise ValueError(f"history of {n_ops} ops exceeds largest bucket "
+                     f"{OP_BUCKETS[-1]}")
+
+
+@dataclasses.dataclass
+class EncodedBatch:
+    """A batch of histories encoded to fixed-shape arrays (host-side numpy;
+    the backend moves them to device)."""
+
+    ops: np.ndarray        # int32[B, N, 4]  (pid, cmd, arg, resp)
+    interval: np.ndarray   # int32[B, N, 2]  (invoke_time, response_time)
+    valid: np.ndarray      # bool[B, N]
+    pending: np.ndarray    # bool[B, N]
+    init_state: np.ndarray  # int32[STATE_DIM]  (shared across the batch)
+
+    @property
+    def batch_size(self) -> int:
+        return self.ops.shape[0]
+
+    @property
+    def max_ops(self) -> int:
+        return self.ops.shape[1]
+
+    def precedes(self) -> np.ndarray:
+        """bool[B, N, N] strict precedence matrices."""
+        inv = self.interval[:, :, 0].astype(np.int64)
+        ret = self.interval[:, :, 1].astype(np.int64)
+        mat = ret[:, :, None] < inv[:, None, :]
+        mat &= self.valid[:, :, None] & self.valid[:, None, :]
+        mat &= ~self.pending[:, :, None]  # pending ops precede nothing
+        b, n, _ = mat.shape
+        mat[:, np.arange(n), np.arange(n)] = False
+        return mat
+
+
+def encode_batch(
+    histories: Sequence[History],
+    init_state: np.ndarray,
+    max_ops: Optional[int] = None,
+) -> EncodedBatch:
+    """Pad a list of histories into one fixed-shape batch.
+
+    ``max_ops`` defaults to the smallest bucket that fits the longest history;
+    callers that want a stable shape across calls (to reuse a compiled kernel)
+    pass it explicitly.
+    """
+    longest = max((len(h) for h in histories), default=1)
+    n = max_ops if max_ops is not None else bucket_for(max(longest, 1))
+    if longest > n:
+        raise ValueError(f"history of {longest} ops does not fit max_ops={n}")
+    b = len(histories)
+    ops = np.zeros((b, n, 4), np.int32)
+    interval = np.zeros((b, n, 2), np.int32)
+    valid = np.zeros((b, n), bool)
+    pending = np.zeros((b, n), bool)
+    for i, h in enumerate(histories):
+        for j, o in enumerate(h.ops):
+            ops[i, j] = (o.pid, o.cmd, o.arg, max(o.resp, 0))
+            interval[i, j] = (o.invoke_time, o.response_time)
+            valid[i, j] = True
+            pending[i, j] = o.is_pending
+    return EncodedBatch(ops=ops, interval=interval, valid=valid,
+                        pending=pending,
+                        init_state=np.asarray(init_state, np.int32))
+
+
+def sequential_history(
+    steps: Sequence[Tuple[int, int, int, int]],
+) -> History:
+    """Build a (trivially sequential) history from (pid, cmd, arg, resp)
+    tuples — handy for golden-history unit tests (SURVEY.md §4)."""
+    ops = []
+    t = 0
+    for pid, cmd, arg, resp in steps:
+        ops.append(Op(pid=pid, cmd=cmd, arg=arg, resp=resp,
+                      invoke_time=t, response_time=t + 1))
+        t += 2
+    return History(ops)
+
+
+def overlapping_history(
+    spans: Sequence[Tuple[int, int, int, int, int, int]],
+) -> History:
+    """Build a history from explicit (pid, cmd, arg, resp, inv_t, ret_t)
+    tuples, for hand-written concurrent golden tests."""
+    ops = [Op(pid=p, cmd=c, arg=a, resp=r, invoke_time=i, response_time=t)
+           for (p, c, a, r, i, t) in spans]
+    ops.sort(key=lambda o: o.invoke_time)
+    return History(ops)

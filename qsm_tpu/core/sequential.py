@@ -1,0 +1,72 @@
+"""Sequential execution path — the reference's ``prop_sequential`` analogue.
+
+Runs a generated program one command at a time against a sequential SUT,
+checking ``precondition → execute → postcondition → transition`` at every
+step (SURVEY.md §3.4).  No scheduler, no lineariser; this is milestone M1 and
+stays the debugging baseline for every spec/SUT pair.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Protocol
+
+from .generator import Program
+from .history import History, Op
+from .spec import Spec
+
+
+class SequentialSUT(Protocol):
+    """A system under test driven one atomic command at a time."""
+
+    def reset(self) -> None: ...
+    def apply(self, cmd: int, arg: int) -> int: ...
+
+
+class ModelSUT:
+    """The spec's own model run as an SUT (always linearisable by
+    construction) — used to validate specs and the checker itself."""
+
+    def __init__(self, spec: Spec):
+        self.spec = spec
+        self.reset()
+
+    def reset(self) -> None:
+        self.state = [int(v) for v in self.spec.initial_state()]
+
+    def apply(self, cmd: int, arg: int) -> int:
+        for resp in self.spec.resp_domain(cmd):
+            new_state, ok = self.spec.step_py(list(self.state), cmd, arg, resp)
+            if ok:
+                self.state = [int(v) for v in new_state]
+                return resp
+        raise AssertionError(
+            f"model has no valid response for cmd={cmd} arg={arg} "
+            f"state={self.state}")
+
+
+@dataclasses.dataclass
+class SequentialResult:
+    ok: bool
+    history: History
+    failed_at: Optional[int] = None  # index of first postcondition failure
+
+
+def run_sequential(spec: Spec, sut: SequentialSUT, program: Program
+                   ) -> SequentialResult:
+    """Execute ``program`` sequentially; verify each response against the
+    model inline.  Returns the (sequential) history for regression dumps."""
+    sut.reset()
+    state = [int(v) for v in spec.initial_state()]
+    t = 0
+    ops = []
+    for idx, op in enumerate(program.ops):
+        resp = sut.apply(op.cmd, op.arg)
+        ops.append(Op(pid=op.pid, cmd=op.cmd, arg=op.arg, resp=resp,
+                      invoke_time=t, response_time=t + 1))
+        t += 2
+        new_state, ok = spec.step_py(state, op.cmd, op.arg, resp)
+        if not ok:
+            return SequentialResult(False, History(ops), failed_at=idx)
+        state = [int(v) for v in new_state]
+    return SequentialResult(True, History(ops))

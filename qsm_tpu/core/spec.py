@@ -1,0 +1,159 @@
+"""State-machine specification protocol.
+
+The reference frames a spec as a record of ``initialModel`` / ``transition`` /
+``precondition`` / ``postcondition`` plus a command generator and shrinker
+(reference: the state-machine record described in SURVEY.md §2, names anchored
+on BASELINE.json:5 — the mount at /root/reference was empty, so module-level
+citations are to the survey, not file:line).
+
+TPU-first redesign
+------------------
+Instead of an arbitrary Haskell record over rich types, a spec here is a small
+class over **integer domains** so that every spec compiles to a pure, branchless
+``step(state, cmd, arg, resp) -> (state', ok)`` function usable in three forms:
+
+* ``step_py``  — pure-Python ints, used by the CPU oracle (``WingGongCPU``) and
+  the sequential runner.  This is the parity reference.
+* ``step_jax`` — the same function written against ``jax.numpy``; traced once
+  inside the TPU kernel's ``lax.while_loop`` and vmapped over ops/batches.
+* an optional dense **step table** (``compile_step_table``) for small specs,
+  used in tests to cross-check ``step_py`` == ``step_jax`` exhaustively.
+
+Model state is a fixed-length ``int32[STATE_DIM]`` vector (packed-int encoding,
+SURVEY.md §7 "hard parts" #2), so queue/KV-style specs whose state space is too
+big to tabulate still trace to static shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class CmdSig:
+    """Signature of one command in a spec's alphabet.
+
+    ``n_args``/``n_resps`` bound the integer domains so generators and the
+    pending-op completion logic (fault injection) can enumerate them.
+    """
+
+    name: str
+    n_args: int  # args drawn from [0, n_args); 1 means "no argument"
+    n_resps: int  # responses live in [0, n_resps)
+
+
+class Spec:
+    """Base class for state-machine specifications.
+
+    Subclasses define:
+      * ``CMDS``        — tuple of :class:`CmdSig` (the command alphabet)
+      * ``STATE_DIM``   — length of the packed int32 model-state vector
+      * ``initial_state()``
+      * ``step_py(state, cmd, arg, resp)``   (list[int] -> (list[int], bool))
+      * ``step_jax(state, cmd, arg, resp)``  (jnp arrays, branchless)
+      * optionally ``gen_cmd(rng, hint)``    (seeded command generation)
+      * optionally ``partition_key(cmd, arg)`` for P-compositionality
+        (per-key linearizability split; see ops/pcomp.py and PAPERS.md:5).
+
+    ``step`` fuses the reference's ``transition`` and ``postcondition`` into a
+    single function: ``ok`` is the postcondition verdict, ``state'`` the
+    transition result.  Preconditions are enforced at *generation* time only
+    (the reference does the same for the concurrent path — SURVEY.md §3.1).
+    """
+
+    name: str = "spec"
+    CMDS: Tuple[CmdSig, ...] = ()
+    STATE_DIM: int = 1
+
+    # -- model ------------------------------------------------------------
+    def initial_state(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step_py(
+        self, state: Sequence[int], cmd: int, arg: int, resp: int
+    ) -> Tuple[Sequence[int], bool]:
+        raise NotImplementedError
+
+    def step_jax(self, state, cmd, arg, resp):
+        raise NotImplementedError
+
+    # -- generation -------------------------------------------------------
+    def precondition(self, state: Sequence[int], cmd: int, arg: int) -> bool:
+        """May ``cmd(arg)`` be issued when the model is in ``state``?
+
+        Enforced at generation time (the reference checks ``precondition``
+        during generation and sequential execution — SURVEY.md §3.4); the
+        generator tracks an approximate model state and rejection-samples
+        against this.  Default: always true.
+        """
+        return True
+
+    def gen_cmd(self, rng, state: Optional[Sequence[int]] = None
+                ) -> Tuple[int, int]:
+        """Return a random (cmd, arg) whose precondition holds in ``state``.
+
+        Default: uniform over the alphabet, rejection-sampled against
+        :meth:`precondition` (bounded tries; falls back to the last sample
+        so generation always terminates).
+        """
+        cmd = arg = 0
+        for _ in range(32):
+            cmd = rng.randrange(len(self.CMDS))
+            arg = rng.randrange(self.CMDS[cmd].n_args)
+            if state is None or self.precondition(state, cmd, arg):
+                break
+        return cmd, arg
+
+    def shrink_arg(self, cmd: int, arg: int):
+        """Candidate smaller args for shrinking (toward 0)."""
+        out = []
+        if arg > 0:
+            out.append(0)
+        if arg > 1:
+            out.append(arg // 2)
+        return out
+
+    # -- decomposition ----------------------------------------------------
+    def partition_key(self, cmd: int, arg: int) -> Optional[int]:
+        """Key for P-compositionality decomposition, or None if the spec is
+        not per-key decomposable.  Sound only when sub-histories for distinct
+        keys are independent (PAPERS.md:5)."""
+        return None
+
+    # -- derived ----------------------------------------------------------
+    @property
+    def n_cmds(self) -> int:
+        return len(self.CMDS)
+
+    @property
+    def max_resps(self) -> int:
+        return max(c.n_resps for c in self.CMDS)
+
+    def resp_domain(self, cmd: int) -> range:
+        return range(self.CMDS[cmd].n_resps)
+
+
+def compile_step_table(spec: Spec, n_states: int):
+    """Tabulate ``step_py`` for specs whose packed state fits one scalar.
+
+    Returns ``(trans, ok)`` with shapes ``[n_states, n_cmds, max_args,
+    max_resps]``; used by tests to cross-check the py/jax step functions
+    exhaustively (SURVEY.md §7 design stance: the step-table compiler).
+    Requires ``STATE_DIM == 1`` and state values in ``[0, n_states)``.
+    """
+    assert spec.STATE_DIM == 1, "step tables only for scalar-state specs"
+    max_args = max(c.n_args for c in spec.CMDS)
+    max_resps = spec.max_resps
+    trans = np.zeros((n_states, spec.n_cmds, max_args, max_resps), np.int32)
+    ok = np.zeros((n_states, spec.n_cmds, max_args, max_resps), bool)
+    for s in range(n_states):
+        for c, sig in enumerate(spec.CMDS):
+            for a in range(sig.n_args):
+                for r in range(sig.n_resps):
+                    ns, good = spec.step_py([s], c, a, r)
+                    trans[s, c, a, r] = ns[0]
+                    ok[s, c, a, r] = good
+    return trans, ok

@@ -1,0 +1,52 @@
+"""Shared read/write register — milestone config #1 (BASELINE.json:7).
+
+The reference's in-tree example is a 2-pid shared register with a correct
+implementation expected to pass ``prop_concurrent`` and a racy one expected to
+fail (SURVEY.md §4).  This module provides the model spec; the matching
+correct/racy SUT implementations live in ``qsm_tpu.models.suts``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.spec import CmdSig, Spec
+
+READ = 0
+WRITE = 1
+
+
+class RegisterSpec(Spec):
+    """Atomic register over values [0, n_values).
+
+    Model state: ``[value]``.  READ must return the current value; WRITE
+    always succeeds (resp 0) and sets it.
+    """
+
+    name = "register"
+    STATE_DIM = 1
+
+    def __init__(self, n_values: int = 5):
+        self.n_values = n_values
+        self.CMDS = (
+            CmdSig("read", n_args=1, n_resps=n_values),
+            CmdSig("write", n_args=n_values, n_resps=1),
+        )
+
+    def initial_state(self) -> np.ndarray:
+        return np.zeros(1, np.int32)
+
+    def step_py(self, state, cmd, arg, resp):
+        value = state[0]
+        if cmd == READ:
+            return [value], resp == value
+        return [arg], resp == 0
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        value = state[0]
+        is_read = cmd == READ
+        ok = jnp.where(is_read, resp == value, resp == 0)
+        new_value = jnp.where(is_read, value, arg)
+        return jnp.stack([new_value.astype(state.dtype)]), ok

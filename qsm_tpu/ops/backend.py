@@ -1,0 +1,42 @@
+"""Linearisability-checker backend protocol.
+
+The north-star threads a ``LineariseBackend`` (default ``WingGongCPU``, new
+``JaxTPU``) through the runner and property layer (BASELINE.json:5).  Backends
+decide *batches* of histories because the shrink loop produces thousands of
+candidates at once (SURVEY.md §3.5).
+
+Verdicts are a tri-state: the device kernel runs under a bounded iteration
+budget and reports BUDGET_EXCEEDED instead of guessing; the property layer
+resolves those via the CPU oracle so CPU/TPU verdicts stay bit-identical
+(SURVEY.md §7 hard-parts #5).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Protocol, Sequence
+
+import numpy as np
+
+from ..core.history import History
+from ..core.spec import Spec
+
+
+class Verdict(enum.IntEnum):
+    VIOLATION = 0
+    LINEARIZABLE = 1
+    BUDGET_EXCEEDED = 2
+
+
+class LineariseBackend(Protocol):
+    name: str
+
+    def check_histories(
+        self, spec: Spec, histories: Sequence[History]
+    ) -> np.ndarray:
+        """Return int8[len(histories)] of :class:`Verdict` values."""
+        ...
+
+
+def check_one(backend: LineariseBackend, spec: Spec, history: History) -> Verdict:
+    return Verdict(int(backend.check_histories(spec, [history])[0]))

@@ -1,0 +1,122 @@
+"""Wing–Gong linearisability checking on CPU — the parity oracle.
+
+Faithful reimplementation of the interleaving search in the reference's
+``Test.StateMachine.Linearise`` (BASELINE.json:5; algorithm shape per
+SURVEY.md §3.2): build every real-time-precedence-respecting interleaving of
+the concurrent history lazily, stepping ``transition`` and checking
+``postcondition`` at each node, succeeding iff SOME root-to-leaf path is
+all-ok.  Worst case O(n!).
+
+This backend is (a) the verdict oracle the TPU kernel must match bit-for-bit
+and (b) the benchmark denominator for the ≥100× target (BASELINE.md).  It is
+deliberately a direct DFS like the reference's; an optional Lowe-style
+memoisation cache (``memo=True``) is provided for *testing at larger sizes*
+but is off for baseline measurement.
+
+Pending operations (invoked, no response — produced by fault injection) are
+handled the way the reference's complete/prune step is described (SURVEY.md
+§3.2): a pending op may be linearised with ANY response in its domain (it took
+effect, the response was lost) or never linearised at all (it did not take
+effect).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from ..core.history import History
+from ..core.spec import Spec
+from .backend import Verdict
+
+_DEFAULT_NODE_BUDGET = 50_000_000
+
+
+class WingGongCPU:
+    """Pure-Python Wing–Gong DFS backend (the oracle)."""
+
+    name = "wing_gong_cpu"
+
+    def __init__(self, node_budget: int = _DEFAULT_NODE_BUDGET,
+                 memo: bool = False):
+        self.node_budget = node_budget
+        self.memo = memo
+        self.nodes_explored = 0  # cumulative, for stats/benchmarks
+
+    # ------------------------------------------------------------------
+    def check_histories(
+        self, spec: Spec, histories: Sequence[History]
+    ) -> np.ndarray:
+        out = np.empty(len(histories), np.int8)
+        for i, h in enumerate(histories):
+            out[i] = self._check(spec, h)
+        return out
+
+    # ------------------------------------------------------------------
+    def _check(self, spec: Spec, history: History) -> Verdict:
+        ops = history.ops
+        n = len(ops)
+        if n == 0:
+            return Verdict.LINEARIZABLE
+        prec = history.precedes_matrix()
+        # blockers[j] = list of i that must be linearised before j may be.
+        blockers: List[List[int]] = [
+            [i for i in range(n) if prec[i, j]] for j in range(n)
+        ]
+        pending = [o.is_pending for o in ops]
+        n_required = sum(1 for p in pending if not p)
+        init = tuple(int(v) for v in spec.initial_state())
+
+        taken = [False] * n
+        budget = [self.node_budget]
+        seen = set() if self.memo else None
+
+        def eligible(j: int) -> bool:
+            if taken[j]:
+                return False
+            for i in blockers[j]:
+                if not taken[i]:
+                    return False
+            return True
+
+        def dfs(state, got_required: int) -> Verdict:
+            if got_required == n_required:
+                return Verdict.LINEARIZABLE
+            if budget[0] <= 0:
+                return Verdict.BUDGET_EXCEEDED
+            if seen is not None:
+                key = (state, tuple(taken))
+                if key in seen:
+                    return Verdict.VIOLATION
+            saw_budget = False
+            for j in range(n):
+                if not eligible(j):
+                    continue
+                op = ops[j]
+                resps = (spec.resp_domain(op.cmd) if pending[j]
+                         else (op.resp,))
+                for resp in resps:
+                    budget[0] -= 1
+                    self.nodes_explored += 1
+                    if budget[0] <= 0:
+                        return Verdict.BUDGET_EXCEEDED
+                    new_state, ok = spec.step_py(list(state), op.cmd,
+                                                 op.arg, resp)
+                    if not ok:
+                        continue
+                    taken[j] = True
+                    sub = dfs(tuple(int(v) for v in new_state),
+                              got_required + (0 if pending[j] else 1))
+                    taken[j] = False
+                    if sub == Verdict.LINEARIZABLE:
+                        return sub
+                    if sub == Verdict.BUDGET_EXCEEDED:
+                        saw_budget = True
+            if saw_budget:
+                return Verdict.BUDGET_EXCEEDED
+            if seen is not None:
+                seen.add((state, tuple(taken)))
+            return Verdict.VIOLATION
+
+        return dfs(init, 0)

@@ -1,0 +1,14 @@
+"""Test-wide environment: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is unavailable in CI; sharding code is validated on a
+virtual CPU mesh exactly as the build instructions prescribe.  Must run
+before any ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,119 @@
+"""Golden-history unit tests for the Wing–Gong CPU oracle.
+
+Mirrors the reference family's lineariser unit tests on small hand-written
+histories with known verdicts (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from qsm_tpu import (History, Op, Verdict, WingGongCPU, check_one,
+                     overlapping_history, sequential_history)
+from qsm_tpu.models.register import READ, WRITE, RegisterSpec
+
+SPEC = RegisterSpec(n_values=5)
+ORACLE = WingGongCPU()
+
+
+def verdict(h):
+    return check_one(ORACLE, SPEC, h)
+
+
+def test_empty_history_linearizable():
+    assert verdict(History([])) == Verdict.LINEARIZABLE
+
+
+def test_sequential_valid():
+    h = sequential_history([
+        (0, WRITE, 3, 0),
+        (0, READ, 0, 3),
+        (1, WRITE, 1, 0),
+        (1, READ, 0, 1),
+    ])
+    assert verdict(h) == Verdict.LINEARIZABLE
+
+
+def test_sequential_stale_read_violates():
+    h = sequential_history([
+        (0, WRITE, 3, 0),
+        (1, READ, 0, 0),  # returns initial value after write completed
+    ])
+    assert verdict(h) == Verdict.VIOLATION
+
+
+def test_concurrent_read_during_write_either_value_ok():
+    # write(3) on pid0 spans [0, 5]; read on pid1 spans [1, 2].
+    # The read overlaps the write, so 0 (old) and 3 (new) are both fine.
+    for seen in (0, 3):
+        h = overlapping_history([
+            (0, WRITE, 3, 0, 0, 5),
+            (1, READ, 0, seen, 1, 2),
+        ])
+        assert verdict(h) == Verdict.LINEARIZABLE, seen
+    h = overlapping_history([
+        (0, WRITE, 3, 0, 0, 5),
+        (1, READ, 0, 2, 1, 2),  # value never written
+    ])
+    assert verdict(h) == Verdict.VIOLATION
+
+
+def test_new_old_inversion_violates():
+    # Two sequential reads after an overlapping write: first sees new value,
+    # second sees old value again -> not linearizable.
+    h = overlapping_history([
+        (0, WRITE, 3, 0, 0, 7),
+        (1, READ, 0, 3, 1, 2),
+        (1, READ, 0, 0, 3, 4),
+    ])
+    assert verdict(h) == Verdict.VIOLATION
+    # In the other order (old then new) it is fine.
+    h2 = overlapping_history([
+        (0, WRITE, 3, 0, 0, 7),
+        (1, READ, 0, 0, 1, 2),
+        (1, READ, 0, 3, 3, 4),
+    ])
+    assert verdict(h2) == Verdict.LINEARIZABLE
+
+
+def test_real_time_order_respected():
+    # pid1's read completes strictly before pid0's write begins; it must not
+    # see the written value.
+    h = overlapping_history([
+        (1, READ, 0, 3, 0, 1),
+        (0, WRITE, 3, 0, 2, 3),
+    ])
+    assert verdict(h) == Verdict.VIOLATION
+
+
+def test_pending_write_may_have_taken_effect():
+    # write(1) invoked, never responded (crash). A later read may see 1
+    # (completed) or 0 (pruned) — both linearizable.
+    for seen in (0, 1):
+        h = History([
+            Op(pid=0, cmd=WRITE, arg=1, resp=-1, invoke_time=0,
+               response_time=10**9),
+            Op(pid=1, cmd=READ, arg=0, resp=seen, invoke_time=2,
+               response_time=3),
+        ])
+        assert verdict(h) == Verdict.LINEARIZABLE, seen
+    h = History([
+        Op(pid=0, cmd=WRITE, arg=1, resp=-1, invoke_time=0,
+           response_time=10**9),
+        Op(pid=1, cmd=READ, arg=0, resp=4, invoke_time=2, response_time=3),
+    ])
+    assert verdict(h) == Verdict.VIOLATION
+
+
+def test_budget_exceeded_reported():
+    tiny = WingGongCPU(node_budget=3)
+    # A history needing more than 3 nodes.
+    h = sequential_history([(0, WRITE, i % 5, 0) for i in range(10)])
+    assert check_one(tiny, SPEC, h) == Verdict.BUDGET_EXCEEDED
+
+
+def test_batch_api_shapes():
+    hs = [sequential_history([(0, WRITE, 1, 0)]),
+          sequential_history([(0, READ, 0, 4)])]
+    out = ORACLE.check_histories(SPEC, hs)
+    assert out.dtype == np.int8
+    assert list(out) == [Verdict.LINEARIZABLE, Verdict.VIOLATION]

@@ -1,0 +1,57 @@
+"""Sequential property path (M1): spec + generator + sequential runner."""
+
+import random
+
+from qsm_tpu import ModelSUT, generate_program, run_sequential
+from qsm_tpu.models.register import READ, WRITE, RegisterSpec
+
+SPEC = RegisterSpec(n_values=5)
+
+
+def test_model_sut_always_passes():
+    for seed in range(25):
+        prog = generate_program(SPEC, seed=seed, n_pids=1, max_ops=12)
+        res = run_sequential(SPEC, ModelSUT(SPEC), prog)
+        assert res.ok, (seed, res.failed_at)
+        assert len(res.history) == len(prog)
+
+
+class StuckRegister:
+    """Broken SUT: writes are dropped."""
+
+    def reset(self):
+        self.value = 0
+
+    def apply(self, cmd, arg):
+        if cmd == READ:
+            return self.value
+        return 0  # ack but don't store
+
+
+def test_broken_sut_fails():
+    failed = False
+    for seed in range(50):
+        prog = generate_program(SPEC, seed=seed, n_pids=1, max_ops=12)
+        res = run_sequential(SPEC, StuckRegister(), prog)
+        if not res.ok:
+            failed = True
+            break
+    assert failed, "write-dropping register was never caught"
+
+
+def test_generation_deterministic():
+    a = generate_program(SPEC, seed=7, n_pids=2, max_ops=12)
+    b = generate_program(SPEC, seed=7, n_pids=2, max_ops=12)
+    assert a == b
+
+
+def test_generated_domains_in_range():
+    rng = random.Random(0)
+    for _ in range(20):
+        seed = rng.randrange(1 << 30)
+        prog = generate_program(SPEC, seed=seed, n_pids=3, max_ops=12)
+        assert 1 <= len(prog) <= 12
+        for op in prog.ops:
+            assert 0 <= op.pid < 3
+            sig = SPEC.CMDS[op.cmd]
+            assert 0 <= op.arg < sig.n_args
