@@ -21,7 +21,11 @@ from .core.history import (EncodedBatch, History, Op, encode_batch,
                            overlapping_history, sequential_history)
 from .core.generator import Program, ProgOp, generate_program, shrink_candidates
 from .core.sequential import ModelSUT, run_sequential
+from .core.property import (Counterexample, PropertyConfig, PropertyResult,
+                            prop_concurrent, replay, trial_seed)
 from .ops.backend import LineariseBackend, Verdict, check_one
 from .ops.wing_gong_cpu import WingGongCPU
+from .sched.scheduler import FaultPlan, Recv, Scheduler, Send
+from .sched.runner import ConcurrentSUT, run_concurrent
 
 __version__ = "0.1.0"

@@ -1,0 +1,193 @@
+"""Property layer — the reference's ``prop_concurrent`` / ``prop_sequential``
+QuickCheck combinators (SURVEY.md §2 Property layer; BASELINE.json:5).
+
+Flow per trial: generate → execute under the deterministic scheduler →
+linearise → on failure, shrink.  The shrink loop is where the reference pays
+"thousands of shrunk histories, one at a time on CPU" (SURVEY.md §3.5); here
+every shrink round executes all candidates host-side and decides them in ONE
+backend batch — the end-to-end speedup path (BASELINE.json:5,9).
+
+Budget-exceeded device verdicts are resolved by the CPU oracle so the
+property's verdicts are always exact (SURVEY.md §7 hard-parts #5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.backend import LineariseBackend, Verdict
+from ..ops.wing_gong_cpu import WingGongCPU
+from ..sched.runner import ConcurrentSUT, run_concurrent
+from ..sched.scheduler import FaultPlan
+from .generator import Program, dedupe, generate_program, shrink_candidates
+from .history import History
+from .spec import Spec
+
+
+@dataclasses.dataclass
+class PropertyConfig:
+    n_trials: int = 100
+    n_pids: int = 2
+    max_ops: int = 12
+    seed: int = 0
+    shrink_rounds: int = 200
+    shrink_batch: int = 256  # candidates decided per backend batch
+    faults: Optional[FaultPlan] = None
+    ramp_sizes: bool = True  # QC-style size ramp across trials
+    max_steps: int = 100_000
+
+
+@dataclasses.dataclass
+class Counterexample:
+    program: Program
+    history: History
+    trial: int
+    trial_seed: str  # replay key
+    shrink_steps: int
+
+
+@dataclasses.dataclass
+class PropertyResult:
+    ok: bool
+    trials_run: int
+    histories_checked: int
+    counterexample: Optional[Counterexample] = None
+    # trials the backend AND oracle both failed to decide within budget; a
+    # nonzero count means ok=True is not a sound verdict (surfaced, never
+    # silently swallowed)
+    undecided: int = 0
+
+    def __bool__(self) -> bool:
+        return self.ok and self.undecided == 0
+
+
+def trial_seed(base_seed: int, trial: int) -> str:
+    """Stable per-trial seed key (str-seeded Random uses sha512 — stable
+    across processes, unlike hash())."""
+    return f"{base_seed}:{trial}"
+
+
+def _trial_ops(cfg: PropertyConfig, trial: int) -> int:
+    if not cfg.ramp_sizes or cfg.n_trials <= 1:
+        return cfg.max_ops
+    frac = (trial + 1) / cfg.n_trials
+    return max(2, math.ceil(cfg.max_ops * frac))
+
+
+def _resolve(spec: Spec, verdicts: np.ndarray, histories: Sequence[History],
+             backend: LineariseBackend, oracle: WingGongCPU) -> np.ndarray:
+    """Resolve BUDGET_EXCEEDED device verdicts via the CPU oracle.
+
+    Skipped when the backend IS the oracle (re-running the identical search
+    with the identical budget can only repeat the verdict).  Verdicts still
+    undecided afterwards stay BUDGET_EXCEEDED and are surfaced by the caller.
+    """
+    if backend is oracle:
+        return verdicts
+    out = verdicts.copy()
+    todo = [i for i, v in enumerate(out) if v == Verdict.BUDGET_EXCEEDED]
+    if todo:
+        resolved = oracle.check_histories(spec, [histories[i] for i in todo])
+        for i, v in zip(todo, resolved):
+            out[i] = v
+    return out
+
+
+def _execute(sut: ConcurrentSUT, prog: Program, sched_seed: str,
+             cfg: PropertyConfig) -> History:
+    return run_concurrent(sut, prog, seed=sched_seed, faults=cfg.faults,
+                          max_steps=cfg.max_steps)
+
+
+def shrink_failure(
+    spec: Spec,
+    sut: ConcurrentSUT,
+    backend: LineariseBackend,
+    oracle: WingGongCPU,
+    cfg: PropertyConfig,
+    program: Program,
+    history: History,
+    sched_seed: str,
+) -> tuple[Program, History, int, int]:
+    """Greedy shrink: each round, decide ALL candidates in one backend batch
+    and step to the first (canonical order) still-failing one.
+
+    Returns (min_program, min_history, shrink_steps, histories_checked)."""
+    steps = 0
+    checked = 0
+    for _ in range(cfg.shrink_rounds):
+        cands = dedupe(shrink_candidates(spec, program), cfg.shrink_batch)
+        if not cands:
+            break
+        hists = [_execute(sut, c, sched_seed, cfg) for c in cands]
+        verdicts = _resolve(
+            spec, backend.check_histories(spec, hists), hists, backend,
+            oracle)
+        checked += len(hists)
+        fail = next((i for i, v in enumerate(verdicts)
+                     if v == Verdict.VIOLATION), None)
+        if fail is None:
+            break
+        program, history = cands[fail], hists[fail]
+        steps += 1
+    return program, history, steps, checked
+
+
+def prop_concurrent(
+    spec: Spec,
+    sut: ConcurrentSUT,
+    cfg: Optional[PropertyConfig] = None,
+    backend: Optional[LineariseBackend] = None,
+    oracle: Optional[WingGongCPU] = None,
+) -> PropertyResult:
+    """Generate → execute → linearise → shrink; the reference's main entry
+    point (SURVEY.md §3.1)."""
+    cfg = cfg or PropertyConfig()
+    oracle = oracle or WingGongCPU()
+    backend = backend or oracle
+    checked = 0
+    undecided = 0
+    for t in range(cfg.n_trials):
+        s = trial_seed(cfg.seed, t)
+        prog = generate_program(
+            spec, seed=random.Random(s).randrange(1 << 62),
+            n_pids=cfg.n_pids, max_ops=_trial_ops(cfg, t))
+        hist = _execute(sut, prog, s, cfg)
+        v = _resolve(spec, backend.check_histories(spec, [hist]),
+                     [hist], backend, oracle)[0]
+        checked += 1
+        if v == Verdict.BUDGET_EXCEEDED:
+            undecided += 1
+        if v == Verdict.VIOLATION:
+            mp, mh, steps, c2 = shrink_failure(
+                spec, sut, backend, oracle, cfg, prog, hist, s)
+            return PropertyResult(
+                ok=False, trials_run=t + 1, histories_checked=checked + c2,
+                undecided=undecided,
+                counterexample=Counterexample(
+                    program=mp, history=mh, trial=t, trial_seed=s,
+                    shrink_steps=steps))
+    return PropertyResult(ok=True, trials_run=cfg.n_trials,
+                          histories_checked=checked, undecided=undecided)
+
+
+def replay(
+    spec: Spec,
+    sut: ConcurrentSUT,
+    trial_seed_key: str,
+    cfg: Optional[PropertyConfig] = None,
+) -> History:
+    """Reproduce a trial's history exactly from its seed key — the
+    checkpoint/resume story: every artifact derivable from (seed, config)
+    (SURVEY.md §5)."""
+    cfg = cfg or PropertyConfig()
+    _, t = trial_seed_key.rsplit(":", 1)
+    prog = generate_program(
+        spec, seed=random.Random(trial_seed_key).randrange(1 << 62),
+        n_pids=cfg.n_pids, max_ops=_trial_ops(cfg, int(t)))
+    return _execute(sut, prog, trial_seed_key, cfg)

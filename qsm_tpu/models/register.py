@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.spec import CmdSig, Spec
+from ..sched.scheduler import Recv, Scheduler, Send
 
 READ = 0
 WRITE = 1
@@ -50,3 +51,89 @@ class RegisterSpec(Spec):
         ok = jnp.where(is_read, resp == value, resp == 0)
         new_value = jnp.where(is_read, value, arg)
         return jnp.stack([new_value.astype(state.dtype)]), ok
+
+
+# ---------------------------------------------------------------------------
+# SUT implementations (the reference's correct-vs-racy example pair)
+# ---------------------------------------------------------------------------
+
+def register_server(store: dict, key: str):
+    """Shared server loop: handles ('read', _) / ('write', arg) messages
+    against ``store[key]`` atomically.  All three SUTs differ only in how
+    their ``perform`` talks to instances of this loop."""
+    while True:
+        msg = yield Recv()
+        kind, arg = msg.payload
+        if kind == "read":
+            yield Send(msg.src, store[key])
+        else:
+            store[key] = arg
+            yield Send(msg.src, 0)
+
+
+class AtomicRegisterSUT:
+    """Correct implementation: one server process applies each message
+    atomically.  Expected to PASS prop_concurrent."""
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {"server": 0}
+        sched.spawn("server", register_server(self.store, "server"),
+                    daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        yield Send("server", ("read" if cmd == READ else "write", arg))
+        msg = yield Recv()
+        return msg.payload
+
+
+class RacyCachedRegisterSUT:
+    """Racy implementation: each client caches the value on first read and
+    serves later reads from the cache; writes update the server and the
+    writer's own cache only.  Cross-pid stale reads violate linearizability
+    — expected to FAIL prop_concurrent (the reference family's racy-register
+    pattern, SURVEY.md §4)."""
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {"server": 0}
+        self.cache = {}
+        sched.spawn("server", register_server(self.store, "server"),
+                    daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        if cmd == READ:
+            if pid in self.cache:
+                return self.cache[pid]  # stale: never revalidated
+            yield Send("server", ("read", arg))
+            msg = yield Recv()
+            self.cache[pid] = msg.payload
+            return msg.payload
+        yield Send("server", ("write", arg))
+        msg = yield Recv()
+        self.cache[pid] = arg
+        return 0
+
+
+class ReplicatedRegisterSUT:
+    """Racy implementation: two replicas, writes propagate as two separate
+    messages, reads go to the pid's home replica.  Concurrent writes can
+    apply in different orders at the two replicas, leaving them divergent
+    — a subtler ordering bug only some interleavings expose."""
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {"replica:0": 0, "replica:1": 0}
+        for name in self.store:
+            sched.spawn(name, register_server(self.store, name), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        home = f"replica:{pid % 2}"
+        if cmd == READ:
+            yield Send(home, ("read", arg))
+            msg = yield Recv()
+            return msg.payload
+        # write to both replicas; delivery order at each is the scheduler's
+        # choice, so concurrent writes may land in opposite orders
+        yield Send("replica:0", ("write", arg))
+        yield Send("replica:1", ("write", arg))
+        yield Recv()
+        yield Recv()
+        return 0

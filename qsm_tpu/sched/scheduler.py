@@ -1,0 +1,236 @@
+"""Deterministic PULSE-style scheduler over an in-memory actor transport.
+
+The reference builds on ``distributed-process`` (Cloud Haskell): a scheduler
+process intercepts instrumented sends into a pending-message pool and, at
+quiescence, picks the next message to deliver using QuickCheck-seeded
+randomness — producing deterministic, replayable interleavings (SURVEY.md §0
+item 2, §3.3; PULSE design after Claessen et al. ICFP'09).
+
+TPU-first redesign: there is no reason to run real OS concurrency to *study*
+concurrency.  Processes here are Python generators stepped by the scheduler —
+a user-level cooperative runtime, which is exactly what PULSE instruments
+Erlang/Haskell processes down to.  All nondeterminism flows from one seeded
+RNG choosing message-delivery order; process step order is fixed, so
+(seed, program) → identical interleaving → identical history — the
+determinism contract that makes shrinking sound (SURVEY.md §7 hard-parts #4).
+
+Processes yield *effects*:
+
+* ``Send(to, payload)`` — asynchronous send, captured into the pool; the
+  sender keeps running (actor-mailbox semantics).
+* ``Recv()`` — pop the oldest mailbox message, or block until one arrives.
+
+Everything between two yields is atomic, like a Cloud Haskell process between
+two ``expect`` calls.
+
+Fault injection (SURVEY.md §5): the scheduler mediates ALL delivery, so
+message drop/duplication/partition and process crash are implemented here, as
+seeded decisions of a :class:`FaultPlan`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+# ---------------------------------------------------------------------------
+# Effects & messages
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Send:
+    to: str
+    payload: Any
+
+
+class Recv:
+    """Block until a message is in this process's mailbox; the yield
+    evaluates to the delivered :class:`Message`."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Message:
+    src: str
+    dst: str
+    payload: Any
+    uid: int  # global send sequence number (trace/debug)
+
+
+# ---------------------------------------------------------------------------
+# Fault injection
+# ---------------------------------------------------------------------------
+
+class FaultPlan:
+    """Seeded fault decisions, consulted by the scheduler at delivery time.
+
+    The reference's scheduler position makes these natural (SURVEY.md §5:
+    "message drop/delay/duplication and crash injection are possible at the
+    scheduler").  Probabilities are applied with the scheduler's own RNG so
+    runs stay replayable from the seed.
+    """
+
+    DELIVER, DROP, DUPLICATE = "deliver", "drop", "duplicate"
+
+    def __init__(self, p_drop: float = 0.0, p_duplicate: float = 0.0,
+                 partitions: Optional[List[set]] = None,
+                 crash_at: Optional[Dict[str, int]] = None,
+                 protected: Optional[set] = None):
+        self.p_drop = p_drop
+        self.p_duplicate = p_duplicate
+        self.partitions = partitions or []
+        self.crash_at = dict(crash_at or {})
+        # processes whose messages are never dropped (e.g. history plumbing)
+        self.protected = protected or set()
+
+    def decide(self, msg: Message, rng: random.Random) -> str:
+        if msg.src in self.protected or msg.dst in self.protected:
+            return self.DELIVER
+        for group in self.partitions:
+            # a partition blocks traffic crossing the group boundary
+            if (msg.src in group) != (msg.dst in group):
+                return self.DROP
+        r = rng.random()
+        if r < self.p_drop:
+            return self.DROP
+        if r < self.p_drop + self.p_duplicate:
+            return self.DUPLICATE
+        return self.DELIVER
+
+
+# ---------------------------------------------------------------------------
+# Scheduler
+# ---------------------------------------------------------------------------
+
+class DeadlockError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class _Proc:
+    name: str
+    gen: Iterator
+    daemon: bool
+    mailbox: deque = dataclasses.field(default_factory=deque)
+    blocked: bool = False  # waiting in Recv with empty mailbox
+    done: bool = False
+    crashed: bool = False
+    send_value: Any = None  # value to send into the generator on next step
+
+
+class Scheduler:
+    """Single-threaded deterministic actor scheduler."""
+
+    def __init__(self, seed: int, faults: Optional[FaultPlan] = None,
+                 max_steps: int = 100_000):
+        self.rng = random.Random(seed)
+        self.faults = faults
+        self.max_steps = max_steps
+        self.procs: Dict[str, _Proc] = {}
+        self.pool: List[Message] = []  # in-flight messages
+        self.clock = 0  # logical event clock (history timestamps)
+        self.trace: List[int] = []  # delivered message uids, in order
+        self._uid = 0
+        self._steps = 0
+
+    # -- process management ------------------------------------------------
+    def spawn(self, name: str, gen: Iterator, daemon: bool = False) -> None:
+        assert name not in self.procs, f"duplicate process {name}"
+        self.procs[name] = _Proc(name=name, gen=gen, daemon=daemon)
+
+    def crash(self, name: str) -> None:
+        """Kill a process: it stops running; messages to it are dropped."""
+        p = self.procs.get(name)
+        if p and not p.done:
+            p.crashed = True
+            p.done = True
+            p.gen.close()
+
+    def tick(self) -> int:
+        """Advance the logical clock (history event timestamps)."""
+        self.clock += 1
+        return self.clock
+
+    # -- main loop ---------------------------------------------------------
+    def _runnable(self) -> List[_Proc]:
+        return [p for p in self.procs.values()
+                if not p.done and not p.blocked]
+
+    def _step(self, p: _Proc) -> None:
+        """Run one process until it blocks, sends+continues, or finishes.
+        Sends captured here go to the pool, NOT straight to mailboxes —
+        delivery order is the scheduler's seeded choice."""
+        while True:
+            self._steps += 1
+            if self._steps > self.max_steps:
+                raise DeadlockError(
+                    f"scheduler exceeded max_steps={self.max_steps}")
+            try:
+                eff = p.gen.send(p.send_value)
+            except StopIteration:
+                p.done = True
+                return
+            p.send_value = None
+            if isinstance(eff, Send):
+                self._uid += 1
+                self.pool.append(Message(src=p.name, dst=eff.to,
+                                         payload=eff.payload, uid=self._uid))
+                continue  # async send: sender keeps running
+            if isinstance(eff, Recv):
+                if p.mailbox:
+                    p.send_value = p.mailbox.popleft()
+                    continue
+                p.blocked = True
+                return
+            raise TypeError(f"process {p.name} yielded {eff!r}")
+
+    def _deliver_one(self) -> None:
+        """Quiescence point: seeded choice of the next in-flight message."""
+        idx = self.rng.randrange(len(self.pool))
+        msg = self.pool.pop(idx)
+        action = (self.faults.decide(msg, self.rng)
+                  if self.faults else FaultPlan.DELIVER)
+        if action == FaultPlan.DROP:
+            return
+        if action == FaultPlan.DUPLICATE:
+            self._uid += 1
+            self.pool.append(dataclasses.replace(msg, uid=self._uid))
+        dst = self.procs.get(msg.dst)
+        if dst is None or dst.done:
+            return  # message to dead/unknown process: dropped
+        self.trace.append(msg.uid)
+        dst.mailbox.append(msg)
+        if dst.blocked:
+            dst.blocked = False
+            dst.send_value = dst.mailbox.popleft()
+
+    def run(self) -> None:
+        """Run to completion: all non-daemon processes finished.
+
+        Crash schedule (fault plan) is applied on delivery counts.  If the
+        system wedges (clients blocked, nothing in flight) the run simply
+        ends — unresponded operations surface as *pending* ops in the
+        history, which the lineariser complete/prunes (SURVEY.md §3.2)."""
+        n_delivered = 0
+        fired_crashes = set()  # scheduler-local: never mutate the shared plan
+        while True:
+            runnable = self._runnable()
+            if runnable:
+                # Fixed order: interleavings come from delivery choice only.
+                self._step(runnable[0])
+                continue
+            if self.faults:
+                for name, at in self.faults.crash_at.items():
+                    if n_delivered >= at and name not in fired_crashes:
+                        self.crash(name)
+                        fired_crashes.add(name)
+            clients_left = [p for p in self.procs.values()
+                            if not p.daemon and not p.done]
+            if not clients_left:
+                return
+            if not self.pool:
+                return  # wedged: pending ops recorded by the runner
+            self._deliver_one()
+            n_delivered += 1

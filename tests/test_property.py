@@ -1,0 +1,45 @@
+"""End-to-end slice (M4): correct impl passes, racy impls fail and shrink to
+minimal counterexamples — the reference's correct-vs-racy regression asset
+(SURVEY.md §4, BASELINE.json:7)."""
+
+import pytest
+
+from qsm_tpu import (PropertyConfig, Verdict, WingGongCPU, check_one,
+                     prop_concurrent, replay)
+from qsm_tpu.models.register import (AtomicRegisterSUT, RacyCachedRegisterSUT,
+                                     ReplicatedRegisterSUT, RegisterSpec)
+
+SPEC = RegisterSpec(n_values=5)
+CFG = PropertyConfig(n_trials=60, n_pids=2, max_ops=12, seed=1234)
+
+
+def test_atomic_register_passes():
+    res = prop_concurrent(SPEC, AtomicRegisterSUT(), CFG)
+    assert res.ok, res.counterexample
+
+
+def test_racy_cached_register_fails_and_shrinks():
+    res = prop_concurrent(SPEC, RacyCachedRegisterSUT(), CFG)
+    assert not res.ok, "racy cached register was never caught"
+    cx = res.counterexample
+    # minimal counterexample needs at most ~3 ops (read-cache, write, read)
+    assert len(cx.program) <= 4, cx.program
+    # the shrunk history must itself be a violation
+    assert check_one(WingGongCPU(), SPEC, cx.history) == Verdict.VIOLATION
+
+
+def test_replicated_register_fails():
+    cfg = PropertyConfig(n_trials=300, n_pids=2, max_ops=12, seed=7)
+    res = prop_concurrent(SPEC, ReplicatedRegisterSUT(), cfg)
+    assert not res.ok, "divergent replicas were never caught"
+    assert check_one(WingGongCPU(), SPEC, res.counterexample.history) \
+        == Verdict.VIOLATION
+
+
+def test_replay_reproduces_counterexample_trial():
+    res = prop_concurrent(SPEC, RacyCachedRegisterSUT(), CFG)
+    assert not res.ok
+    h = replay(SPEC, RacyCachedRegisterSUT(), res.counterexample.trial_seed,
+               CFG)
+    v = check_one(WingGongCPU(), SPEC, h)
+    assert v == Verdict.VIOLATION
