@@ -1,0 +1,305 @@
+"""``JaxTPU`` — the batched Wing–Gong branch-and-bound kernel.
+
+This is the TPU replacement for the reference's pure, single-threaded
+``Test.StateMachine.Linearise`` DFS (SURVEY.md §3.2; the north-star of
+BASELINE.json:5): thousands of candidate histories are decided in ONE
+``vmap``'d device call.
+
+Mapping dynamic search onto static XLA shapes (SURVEY.md §7 hard-parts #1)
+---------------------------------------------------------------------------
+Wing–Gong is a backtracking DFS with data-dependent branching.  Here it runs
+as a ``lax.while_loop`` over an explicit fixed-size stack:
+
+* ``taken``   bool[N]        — ops already linearised on the current path
+* ``chosen``  int32[N+1]     — op index picked at each depth; doubles as the
+  sibling cursor (at depth ``d`` only indices ``> chosen[d]`` are tried, so
+  backtracking resumes exactly where the recursion would)
+* ``states``  int32[N+1, S]  — model-state stack (packed int vectors, so
+  queue/KV specs avoid exponential step tables — hard-parts #2)
+
+Each iteration is one DFS transition {descend | advance-sibling | backtrack},
+chosen branchlessly:
+
+* candidate mask = untaken ∧ precedence-minimal ∧ postcondition-ok ∧ beyond
+  cursor; precedence-minimality is a masked any() over the precomputed strict
+  precedes matrix (``resp_i < inv_j``), and postconditions for ALL ops are
+  evaluated vectorised from the current state (one ``vmap`` of
+  ``spec.step_jax`` — most branches die here, which is what keeps typical
+  search trees tiny despite the O(n!) worst case)
+* first candidate via ``argmax`` of the bool mask (same canonical op order as
+  the CPU oracle, so explored trees — and therefore verdicts — agree)
+
+Worst-case blowups are cut by an iteration budget: the kernel reports
+BUDGET_EXCEEDED honestly and the property layer resolves those via the CPU
+oracle, keeping CPU/TPU verdicts bit-identical (hard-parts #5).
+
+Pending (crash/fault) ops are expanded host-side into complete histories —
+every prune/complete×response combination (SURVEY.md §3.2 complete/prune) —
+so the kernel itself only ever sees complete histories with static shapes.
+
+Batching: ``vmap`` over histories (≥1024 per call — BASELINE.json:9); batch
+sizes and op counts are bucketed to bound recompilation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.history import History, bucket_for, encode_batch
+from ..core.spec import Spec
+from .backend import Verdict
+
+RUNNING = 0
+SUCCESS = 1  # == Verdict.LINEARIZABLE
+FAILURE = 2
+BUDGET = 3
+
+_BATCH_BUCKETS = (8, 64, 256, 1024, 4096)
+
+
+def _batch_bucket(b: int) -> int:
+    for s in _BATCH_BUCKETS:
+        if b <= s:
+            return s
+    # beyond the largest bucket, round up to a multiple of it
+    top = _BATCH_BUCKETS[-1]
+    return ((b + top - 1) // top) * top
+
+
+def build_kernel(spec: Spec, n_ops: int, budget: int):
+    """Build the single-history while-loop checker for one (spec, N) shape.
+
+    Returned function signature (all jnp arrays):
+        (cmd[N], arg[N], resp[N], valid[N], precedes[N,N], init_state[S])
+        -> (status: int32, iters: int32)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    iota = jnp.arange(n_ops, dtype=jnp.int32)
+    iota1 = jnp.arange(n_ops + 1, dtype=jnp.int32)
+
+    # NOTE: all stack updates below are branchless one-hot mask arithmetic,
+    # deliberately avoiding jnp .at[].set scatters.  Besides being the
+    # TPU-idiomatic form (masked selects fuse; scatters don't), this works
+    # around an upstream JAX 0.9.0 bug where a *vmapped boolean* scatter
+    # (bool_arr.at[j].set(True)) silently drops updates when the batch
+    # dimension is >= 1024 — on both CPU and TPU backends.  Regression
+    # coverage: tests/test_parity.py::test_large_batch_parity.
+
+    def check_one(cmd, arg, resp, valid, precedes, init_state):
+        n_req = jnp.sum(valid.astype(jnp.int32))
+
+        def cond(c):
+            return c["status"] == RUNNING
+
+        def body(c):
+            d, taken = c["d"], c["taken"]
+            chosen, states = c["chosen"], c["states"]
+            state = states[d]
+            untaken = valid & ~taken
+            # minimality: op j is blocked if some untaken op precedes it
+            blocked = jnp.any(untaken[:, None] & precedes, axis=0)
+            # vectorised transition+postcondition from the current state
+            nxt, ok = jax.vmap(
+                lambda cc, aa, rr: spec.step_jax(state, cc, aa, rr),
+                out_axes=(0, 0))(cmd, arg, resp)
+            ok, nxt = ok.reshape(n_ops), nxt.reshape(n_ops, -1)
+            cand = untaken & ~blocked & ok & (iota > chosen[d])
+            has = jnp.any(cand)
+            j = jnp.argmax(cand).astype(jnp.int32)
+
+            # -- descend: take op j, push state, open cursor at d+1 ------
+            # -- backtrack: untake op below, keep its cursor -------------
+            d_back = jnp.maximum(d - 1, 0)
+            prev = jnp.maximum(chosen[d_back], 0)
+            taken_new = jnp.where(
+                has, taken | (iota == j),
+                taken & ~((iota == prev) & (d > 0)))
+            chosen_desc = jnp.where(iota1 == d, j,
+                                    jnp.where(iota1 == d + 1, -1, chosen))
+            states_desc = jnp.where((iota1 == d + 1)[:, None],
+                                    nxt[j][None, :].astype(jnp.int32),
+                                    states)
+
+            d_new = jnp.where(has, d + 1, d_back)
+            status = jnp.where(
+                has & (d + 1 == n_req), SUCCESS,
+                jnp.where((~has) & (d == 0), FAILURE, RUNNING))
+            iters = c["iters"] + 1
+            status = jnp.where((status == RUNNING) & (iters >= budget),
+                               BUDGET, status)
+            return {
+                "d": d_new,
+                "taken": taken_new,
+                "chosen": jnp.where(has, chosen_desc, chosen),
+                "states": jnp.where(has, states_desc, states),
+                "status": status.astype(jnp.int32),
+                "iters": iters,
+            }
+
+        init = {
+            "d": jnp.int32(0),
+            "taken": jnp.zeros(n_ops, bool),
+            "chosen": jnp.full(n_ops + 1, -1, jnp.int32),
+            "states": jnp.zeros((n_ops + 1, spec.STATE_DIM),
+                                jnp.int32).at[0].set(init_state),
+            "status": jnp.where(n_req == 0, SUCCESS,
+                                RUNNING).astype(jnp.int32),
+            "iters": jnp.int32(0),
+        }
+        out = jax.lax.while_loop(cond, body, init)
+        return out["status"], out["iters"]
+
+    return check_one
+
+
+class JaxTPU:
+    """Batched device backend implementing :class:`LineariseBackend`.
+
+    One compiled executable per (max_ops bucket, batch bucket); host code
+    pads batches into those shapes.  ``check_histories`` returns verdicts
+    bit-compatible with ``WingGongCPU`` (BUDGET_EXCEEDED when the iteration
+    budget ran out — never a guess).
+    """
+
+    name = "jax_tpu"
+
+    def __init__(self, spec: Spec, budget: int = 200_000,
+                 max_expansions: int = 128,
+                 sharding=None):
+        self.spec = spec
+        self.budget = budget
+        self.max_expansions = max_expansions
+        self.sharding = sharding  # optional NamedSharding for the batch axis
+        self._compiled: Dict[Tuple[int, int], object] = {}
+        self.batches_run = 0
+        self.device_histories = 0
+
+    # -- compilation cache -------------------------------------------------
+    def _kernel(self, n_ops: int, batch: int):
+        import jax
+
+        key = (n_ops, batch)
+        fn = self._compiled.get(key)
+        if fn is None:
+            single = build_kernel(self.spec, n_ops, self.budget)
+            batched = jax.vmap(single, in_axes=(0, 0, 0, 0, 0, None))
+            fn = jax.jit(batched)
+            self._compiled[key] = fn
+        return fn
+
+    # -- pending-op expansion ---------------------------------------------
+    def _expand(self, h: History) -> Optional[List[History]]:
+        """All complete/prune completions of a history's pending ops, or
+        None if the expansion would exceed ``max_expansions`` (the caller
+        then defers to the oracle via BUDGET_EXCEEDED)."""
+        if h.n_pending == 0:
+            return [h]
+        pend = [i for i, o in enumerate(h.ops) if o.is_pending]
+        n = 1
+        choices = []
+        for i in pend:
+            # None = prune; r = complete with response r
+            opts = [None] + list(self.spec.resp_domain(h.ops[i].cmd))
+            n *= len(opts)
+            if n > self.max_expansions:
+                return None
+            choices.append(opts)
+        pend_pos = {i: k for k, i in enumerate(pend)}
+        out = []
+        for combo in itertools.product(*choices):
+            ops = []
+            for i, o in enumerate(h.ops):
+                if i in pend_pos:
+                    c = combo[pend_pos[i]]
+                    if c is None:
+                        continue  # pruned: never took effect
+                    # completed: took effect; response unobserved, so its
+                    # linearisation point is unconstrained on the right —
+                    # keep the pending sentinel response_time
+                    ops.append(dataclasses.replace(o, resp=int(c)))
+                else:
+                    ops.append(o)
+            out.append(History(ops, seed=h.seed, program_id=h.program_id))
+        return out
+
+    # -- main entry --------------------------------------------------------
+    def check_histories(self, spec: Spec, histories: Sequence[History]
+                        ) -> np.ndarray:
+        assert spec is self.spec, \
+            "JaxTPU is compiled per spec; construct one per spec"
+        if not histories:
+            return np.empty(0, np.int8)
+
+        # 1. host-side pending expansion
+        groups: List[Tuple[int, int]] = []  # (start, count) per input
+        flat: List[History] = []
+        overflow: List[int] = []
+        for idx, h in enumerate(histories):
+            exp = self._expand(h)
+            if exp is None:
+                overflow.append(idx)
+                groups.append((len(flat), 0))
+            else:
+                groups.append((len(flat), len(exp)))
+                flat.extend(exp)
+
+        out = np.full(len(histories), int(Verdict.BUDGET_EXCEEDED), np.int8)
+        if flat:
+            statuses = self._run_device(flat)
+            for idx, (start, count) in enumerate(groups):
+                if count == 0:
+                    continue
+                sub = statuses[start:start + count]
+                if (sub == SUCCESS).any():
+                    out[idx] = int(Verdict.LINEARIZABLE)
+                elif (sub == BUDGET).any():
+                    out[idx] = int(Verdict.BUDGET_EXCEEDED)
+                else:
+                    out[idx] = int(Verdict.VIOLATION)
+        return out
+
+    def _run_device(self, flat: Sequence[History]) -> np.ndarray:
+        import jax.numpy as jnp
+
+        n_ops = bucket_for(max(len(h) for h in flat) or 1)
+        batch = _batch_bucket(len(flat))
+        enc = encode_batch(flat, self.spec.initial_state(), max_ops=n_ops)
+        b = len(flat)
+        cmd = np.zeros((batch, n_ops), np.int32)
+        arg = np.zeros((batch, n_ops), np.int32)
+        resp = np.zeros((batch, n_ops), np.int32)
+        valid = np.zeros((batch, n_ops), bool)
+        prec = np.zeros((batch, n_ops, n_ops), bool)
+        cmd[:b] = enc.ops[:, :, 1]
+        arg[:b] = enc.ops[:, :, 2]
+        resp[:b] = enc.ops[:, :, 3]
+        valid[:b] = enc.valid
+        prec[:b] = enc.precedes()
+        args = (cmd, arg, resp, valid, prec,
+                enc.init_state)
+        if self.sharding is not None:
+            import jax
+            args = tuple(
+                jax.device_put(a, s) for a, s in
+                zip(args, self._arg_shardings()))
+        status, _iters = self._kernel(n_ops, batch)(*args)
+        self.batches_run += 1
+        self.device_histories += b
+        return np.asarray(status)[:b]
+
+    def _arg_shardings(self):
+        """Batch-axis sharding for each kernel argument (replicated init)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.sharding.mesh
+        axis = self.sharding.spec[0] if self.sharding.spec else None
+        batched = jax.NamedSharding(mesh, P(axis))
+        replicated = jax.NamedSharding(mesh, P())
+        return (batched, batched, batched, batched, batched, replicated)

@@ -188,6 +188,12 @@ class Scheduler:
 
     def _deliver_one(self) -> None:
         """Quiescence point: seeded choice of the next in-flight message."""
+        # Deliveries count against max_steps too: duplication faults can
+        # otherwise spin the pool forever with no process ever runnable.
+        self._steps += 1
+        if self._steps > self.max_steps:
+            raise DeadlockError(
+                f"scheduler exceeded max_steps={self.max_steps}")
         idx = self.rng.randrange(len(self.pool))
         msg = self.pool.pop(idx)
         action = (self.faults.decide(msg, self.rng)
