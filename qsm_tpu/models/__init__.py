@@ -1,0 +1,7 @@
+"""The five milestone specs + correct/racy SUT pairs (BASELINE.json:7-11;
+SURVEY.md §2 Examples — the reference's test suite IS its examples)."""
+
+from .register import (AtomicRegisterSUT, RacyCachedRegisterSUT,
+                       RegisterSpec, ReplicatedRegisterSUT)
+from .counter import AtomicTicketSUT, RacyTicketSUT, TicketSpec
+from .cas import AtomicCasSUT, CasSpec, RacyCasSUT

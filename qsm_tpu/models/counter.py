@@ -1,0 +1,117 @@
+"""Ticket dispenser / atomic counter — milestone config #2 (BASELINE.json:8).
+
+The ticket dispenser is the qsm family's classic example (SURVEY.md §2
+Examples): ``take`` hands out the next ticket number, ``reset`` restarts the
+sequence.  The linearizability bug it exists to catch is the non-atomic
+read-then-increment: two pids read the same counter value and both get the
+same ticket.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.spec import CmdSig, Spec
+from ..sched.scheduler import Recv, Scheduler, Send
+
+TAKE = 0
+RESET = 1
+
+
+class TicketSpec(Spec):
+    """Atomic ticket dispenser.
+
+    Model state: ``[next]``.  TAKE must return the current ``next`` and
+    advance it; RESET returns 0 and sets ``next`` to 0.  ``n_tickets`` bounds
+    the response domain; keep it above the history length so TAKE always has
+    a valid response (preconditions are generation-time only).
+    """
+
+    name = "ticket"
+    STATE_DIM = 1
+
+    def __init__(self, n_tickets: int = 25):
+        self.n_tickets = n_tickets
+        self.CMDS = (
+            CmdSig("take", n_args=1, n_resps=n_tickets),
+            CmdSig("reset", n_args=1, n_resps=1),
+        )
+
+    def initial_state(self) -> np.ndarray:
+        return np.zeros(1, np.int32)
+
+    def precondition(self, state, cmd, arg) -> bool:
+        return cmd != TAKE or state[0] < self.n_tickets
+
+    def step_py(self, state, cmd, arg, resp):
+        nxt = state[0]
+        if cmd == TAKE:
+            return [nxt + 1], resp == nxt
+        return [0], resp == 0
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        nxt = state[0]
+        is_take = cmd == TAKE
+        ok = jnp.where(is_take, resp == nxt, resp == 0)
+        new = jnp.where(is_take, nxt + 1, 0)
+        return jnp.stack([new.astype(state.dtype)]), ok
+
+
+# ---------------------------------------------------------------------------
+# SUT implementations
+# ---------------------------------------------------------------------------
+
+def _atomic_server(store: dict):
+    """Server applying take/reset atomically per message."""
+    while True:
+        msg = yield Recv()
+        kind = msg.payload[0]
+        if kind == "take":
+            yield Send(msg.src, store["next"])
+            store["next"] += 1
+        elif kind == "reset":
+            store["next"] = 0
+            yield Send(msg.src, 0)
+        elif kind == "read":
+            yield Send(msg.src, store["next"])
+        elif kind == "incr":
+            store["next"] += 1
+            yield Send(msg.src, 0)
+
+
+class AtomicTicketSUT:
+    """Correct: one server message per TAKE — read+increment is atomic.
+    Expected to PASS prop_concurrent."""
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {"next": 0}
+        sched.spawn("server", _atomic_server(self.store), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        yield Send("server", ("take",) if cmd == TAKE else ("reset",))
+        msg = yield Recv()
+        return msg.payload
+
+
+class RacyTicketSUT:
+    """Racy: TAKE is read-then-increment as TWO server round-trips; two pids
+    can read the same counter and hand out duplicate tickets — the classic
+    dispenser bug (SURVEY.md §2 Examples).  Expected to FAIL."""
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {"next": 0}
+        sched.spawn("server", _atomic_server(self.store), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        if cmd == TAKE:
+            yield Send("server", ("read",))
+            msg = yield Recv()
+            ticket = msg.payload
+            yield Send("server", ("incr",))
+            yield Recv()
+            return ticket
+        yield Send("server", ("reset",))
+        msg = yield Recv()
+        return msg.payload

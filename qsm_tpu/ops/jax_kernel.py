@@ -62,12 +62,13 @@ _BATCH_BUCKETS = (8, 64, 256, 1024, 4096)
 
 
 def _batch_bucket(b: int) -> int:
+    """Smallest bucket holding ``b`` rows; callers split batches larger than
+    the top bucket into top-bucket chunks so the compile cache stays bounded
+    at len(_BATCH_BUCKETS) executables per op bucket."""
     for s in _BATCH_BUCKETS:
         if b <= s:
             return s
-    # beyond the largest bucket, round up to a multiple of it
-    top = _BATCH_BUCKETS[-1]
-    return ((b + top - 1) // top) * top
+    return _BATCH_BUCKETS[-1]
 
 
 def build_kernel(spec: Spec, n_ops: int, budget: int):
@@ -265,8 +266,11 @@ class JaxTPU:
         return out
 
     def _run_device(self, flat: Sequence[History]) -> np.ndarray:
-        import jax.numpy as jnp
-
+        top = _BATCH_BUCKETS[-1]
+        if len(flat) > top:
+            return np.concatenate([
+                self._run_device(flat[i:i + top])
+                for i in range(0, len(flat), top)])
         n_ops = bucket_for(max(len(h) for h in flat) or 1)
         batch = _batch_bucket(len(flat))
         enc = encode_batch(flat, self.spec.initial_state(), max_ops=n_ops)

@@ -154,6 +154,13 @@ class Scheduler:
         return self.clock
 
     # -- main loop ---------------------------------------------------------
+    def _bump_steps(self) -> None:
+        """Count one unit of scheduler work against ``max_steps``."""
+        self._steps += 1
+        if self._steps > self.max_steps:
+            raise DeadlockError(
+                f"scheduler exceeded max_steps={self.max_steps}")
+
     def _runnable(self) -> List[_Proc]:
         return [p for p in self.procs.values()
                 if not p.done and not p.blocked]
@@ -163,10 +170,7 @@ class Scheduler:
         Sends captured here go to the pool, NOT straight to mailboxes —
         delivery order is the scheduler's seeded choice."""
         while True:
-            self._steps += 1
-            if self._steps > self.max_steps:
-                raise DeadlockError(
-                    f"scheduler exceeded max_steps={self.max_steps}")
+            self._bump_steps()
             try:
                 eff = p.gen.send(p.send_value)
             except StopIteration:
@@ -190,10 +194,7 @@ class Scheduler:
         """Quiescence point: seeded choice of the next in-flight message."""
         # Deliveries count against max_steps too: duplication faults can
         # otherwise spin the pool forever with no process ever runnable.
-        self._steps += 1
-        if self._steps > self.max_steps:
-            raise DeadlockError(
-                f"scheduler exceeded max_steps={self.max_steps}")
+        self._bump_steps()
         idx = self.rng.randrange(len(self.pool))
         msg = self.pool.pop(idx)
         action = (self.faults.decide(msg, self.rng)

@@ -1,14 +1,61 @@
 """Test-wide environment: force an 8-device virtual CPU mesh.
 
 Multi-chip hardware is unavailable in CI; sharding code is validated on a
-virtual CPU mesh exactly as the build instructions prescribe.  Must run
-before any ``import jax`` anywhere in the test session.
+virtual CPU mesh exactly as the build instructions prescribe.
+
+Two environment quirks make this trickier than setting ``JAX_PLATFORMS``:
+
+* The image ships ``JAX_PLATFORMS=axon`` plus a sitecustomize that registers
+  the axon TPU plugin in every interpreter, so ``setdefault`` is a no-op and
+  even an explicit env override is ignored once the plugin registered.
+  ``jax.config.update("jax_platforms", "cpu")`` *after* import does win.
+* Initializing the axon backend contacts the single-chip tunnel; doing that
+  from test workers can wedge (and a wedged tunnel then hangs every later
+  ``jax.devices()``).  Forcing cpu before any device query keeps the tests
+  entirely off the chip — which is also the point: tests must not depend on
+  TPU availability (bench.py owns the real-chip path).
 """
 
 import os
+import re
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+N_DEVICES = 8
+
+# Replace any pre-existing (possibly smaller) count rather than respecting it:
+# this file's contract is "at least an 8-device mesh", not "whatever the
+# caller exported".
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["XLA_FLAGS"] = (
+    f"{_flags} --xla_force_host_platform_device_count={N_DEVICES}").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402  (must follow the env setup above)
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= N_DEVICES, (
+    "conftest failed to materialize the 8-device virtual CPU mesh; "
+    f"got {jax.devices()}")
+
+
+# ---------------------------------------------------------------------------
+# Shared helpers
+# ---------------------------------------------------------------------------
+
+def assert_backend_parity(spec, histories, device_backend, oracle=None,
+                          expect_violations=True):
+    """Assert device verdicts == oracle verdicts on ``histories`` and that
+    the sample isn't vacuous (SURVEY.md §4: cross-backend parity suite)."""
+    from qsm_tpu import Verdict, WingGongCPU
+
+    oracle = oracle or WingGongCPU()
+    cpu = oracle.check_histories(spec, histories)
+    dev = device_backend.check_histories(spec, histories)
+    mismatch = [(i, int(c), int(d))
+                for i, (c, d) in enumerate(zip(cpu, dev)) if c != d]
+    assert not mismatch, f"CPU/device verdict mismatches: {mismatch}"
+    assert (cpu == Verdict.LINEARIZABLE).any(), "parity sample vacuous: no passes"
+    if expect_violations:
+        assert (cpu == Verdict.VIOLATION).any(), "parity sample vacuous: no fails"
+    return cpu

@@ -1,0 +1,35 @@
+"""CAS register (config #3, BASELINE.json:9): correct impl passes, the
+non-atomic read-compare-write impl loses updates and fails."""
+
+from qsm_tpu import (PropertyConfig, Verdict, WingGongCPU, check_one,
+                     generate_program, prop_concurrent, run_concurrent)
+from qsm_tpu.models.cas import CAS, AtomicCasSUT, CasSpec, RacyCasSUT
+from qsm_tpu.ops.jax_kernel import JaxTPU
+
+SPEC = CasSpec(n_values=5)
+CFG = PropertyConfig(n_trials=80, n_pids=8, max_ops=32, seed=5)
+
+
+def test_atomic_cas_passes():
+    res = prop_concurrent(SPEC, AtomicCasSUT(SPEC), CFG)
+    assert res.ok, res.counterexample
+
+
+def test_racy_cas_fails_and_shrinks():
+    res = prop_concurrent(SPEC, RacyCasSUT(SPEC), CFG)
+    assert not res.ok, "lost updates were never caught"
+    cx = res.counterexample
+    assert check_one(WingGongCPU(), SPEC, cx.history) == Verdict.VIOLATION
+    # the minimal counterexample must still contain a CAS
+    assert any(op.cmd == CAS for op in cx.program.ops), cx.program
+
+
+def test_cas_backend_parity():
+    from conftest import assert_backend_parity
+
+    hists = []
+    for seed in range(30):
+        prog = generate_program(SPEC, seed=seed, n_pids=8, max_ops=24)
+        for sut in (AtomicCasSUT(SPEC), RacyCasSUT(SPEC)):
+            hists.append(run_concurrent(sut, prog, seed=f"c{seed}"))
+    assert_backend_parity(SPEC, hists, JaxTPU(SPEC))

@@ -1,0 +1,35 @@
+"""Ticket dispenser (config #2, BASELINE.json:8): correct impl passes,
+read-then-increment racy impl hands out duplicate tickets and fails."""
+
+from qsm_tpu import (PropertyConfig, Verdict, WingGongCPU, check_one,
+                     generate_program, prop_concurrent, run_concurrent)
+from qsm_tpu.models.counter import AtomicTicketSUT, RacyTicketSUT, TicketSpec
+from qsm_tpu.ops.jax_kernel import JaxTPU
+
+SPEC = TicketSpec(n_tickets=25)
+CFG = PropertyConfig(n_trials=60, n_pids=4, max_ops=24, seed=99)
+
+
+def test_atomic_ticket_passes():
+    res = prop_concurrent(SPEC, AtomicTicketSUT(), CFG)
+    assert res.ok, res.counterexample
+
+
+def test_racy_ticket_fails_and_shrinks():
+    res = prop_concurrent(SPEC, RacyTicketSUT(), CFG)
+    assert not res.ok, "duplicate tickets were never caught"
+    cx = res.counterexample
+    # minimal counterexample: two overlapping TAKEs
+    assert len(cx.program) <= 3, cx.program
+    assert check_one(WingGongCPU(), SPEC, cx.history) == Verdict.VIOLATION
+
+
+def test_ticket_backend_parity():
+    from conftest import assert_backend_parity
+
+    hists = []
+    for seed in range(40):
+        prog = generate_program(SPEC, seed=seed, n_pids=4, max_ops=20)
+        for sut_cls in (AtomicTicketSUT, RacyTicketSUT):
+            hists.append(run_concurrent(sut_cls(), prog, seed=f"t{seed}"))
+    assert_backend_parity(SPEC, hists, JaxTPU(SPEC))
