@@ -148,7 +148,10 @@ def prop_concurrent(
     """Generate → execute → linearise → shrink; the reference's main entry
     point (SURVEY.md §3.1)."""
     cfg = cfg or PropertyConfig()
-    oracle = oracle or WingGongCPU()
+    # memoised oracle: identical verdicts, orders of magnitude faster on
+    # violating histories (Lowe-style cache) — the right default for the
+    # resolution path; parity tests construct the memo-less one explicitly
+    oracle = oracle or WingGongCPU(memo=True)
     backend = backend or oracle
     checked = 0
     undecided = 0

@@ -5,3 +5,5 @@ from .register import (AtomicRegisterSUT, RacyCachedRegisterSUT,
                        RegisterSpec, ReplicatedRegisterSUT)
 from .counter import AtomicTicketSUT, RacyTicketSUT, TicketSpec
 from .cas import AtomicCasSUT, CasSpec, RacyCasSUT
+from .queue import AtomicQueueSUT, QueueSpec, RacyTwoPhaseQueueSUT
+from .kv import AtomicKvSUT, KvSpec, StaleCacheKvSUT

@@ -1,0 +1,142 @@
+"""Multi-key KV map — milestone config #5 (BASELINE.json:11).
+
+16 pids, histories up to 64 ops — far past direct Wing–Gong range in the
+worst case.  The spec declares a partition key, so the checker may apply the
+P-compositionality split (Horn & Kroening, PAPERS.md:5): a history is
+linearizable iff every per-key sub-history is, and each sub-history projects
+onto a plain atomic register — many small, batchable problems instead of one
+exponential one (SURVEY.md §2b "per-key decomposition").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.spec import CmdSig, Spec
+from ..sched.scheduler import Recv, Scheduler, Send
+from .register import RegisterSpec
+
+GET = 0
+PUT = 1
+
+
+class KvSpec(Spec):
+    """Atomic map over ``n_keys`` keys with values [0, n_values).
+
+    GET(k) returns the key's value; PUT packs ``k * n_values + v`` into its
+    integer argument and responds 0.  Model state: one value per key.
+    """
+
+    name = "kv"
+
+    def __init__(self, n_keys: int = 4, n_values: int = 4):
+        self.n_keys = n_keys
+        self.n_values = n_values
+        self.STATE_DIM = n_keys
+        self.CMDS = (
+            CmdSig("get", n_args=n_keys, n_resps=n_values),
+            CmdSig("put", n_args=n_keys * n_values, n_resps=1),
+        )
+
+    def initial_state(self) -> np.ndarray:
+        return np.zeros(self.n_keys, np.int32)
+
+    def put_arg(self, key: int, value: int) -> int:
+        return key * self.n_values + value
+
+    def step_py(self, state, cmd, arg, resp):
+        state = list(state)
+        if cmd == GET:
+            return state, resp == state[arg]
+        key, value = divmod(arg, self.n_values)
+        state[key] = value
+        return state, resp == 0
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        iota = jnp.arange(self.n_keys)
+        is_get = cmd == GET
+        key = jnp.where(is_get, arg, arg // self.n_values)
+        value = arg % self.n_values
+        ok = jnp.where(is_get, resp == state[key], resp == 0)
+        new_state = jnp.where(~is_get & (iota == key), value, state)
+        return new_state.astype(state.dtype), ok
+
+    # -- P-compositionality (PAPERS.md:5) ------------------------------
+    def partition_key(self, cmd, arg):
+        return arg if cmd == GET else arg // self.n_values
+
+    def projected_spec(self) -> RegisterSpec:
+        """Each per-key sub-history is a history of a plain register."""
+        return RegisterSpec(n_values=self.n_values)
+
+    def project_op(self, cmd, arg, resp):
+        """Map a KV op to the projected register spec's (cmd, arg, resp)."""
+        if cmd == GET:
+            return 0, 0, resp  # READ
+        return 1, arg % self.n_values, resp  # WRITE(v)
+
+
+# ---------------------------------------------------------------------------
+# SUT implementations
+# ---------------------------------------------------------------------------
+
+def _kv_server(store: dict):
+    while True:
+        msg = yield Recv()
+        kind, key, *rest = msg.payload
+        if kind == "get":
+            yield Send(msg.src, store.get(key, 0))
+        else:
+            store[key] = rest[0]
+            yield Send(msg.src, 0)
+
+
+class AtomicKvSUT:
+    """Correct: single server, one atomically-applied message per op.
+    Expected to PASS prop_concurrent."""
+
+    def __init__(self, spec: KvSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {}
+        sched.spawn("server", _kv_server(self.store), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        if cmd == GET:
+            yield Send("server", ("get", arg))
+        else:
+            key, value = divmod(arg, self.spec.n_values)
+            yield Send("server", ("put", key, value))
+        msg = yield Recv()
+        return msg.payload
+
+
+class StaleCacheKvSUT:
+    """Racy: each client caches GET results per key and never revalidates;
+    other pids' PUTs are invisible to it — stale reads violate per-key
+    linearizability.  Expected to FAIL."""
+
+    def __init__(self, spec: KvSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {}
+        self.cache = {}  # (pid, key) -> value
+        sched.spawn("server", _kv_server(self.store), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        if cmd == GET:
+            if (pid, arg) in self.cache:
+                return self.cache[(pid, arg)]
+            yield Send("server", ("get", arg))
+            msg = yield Recv()
+            self.cache[(pid, arg)] = msg.payload
+            return msg.payload
+        key, value = divmod(arg, self.spec.n_values)
+        yield Send("server", ("put", key, value))
+        msg = yield Recv()
+        self.cache[(pid, key)] = value
+        return 0

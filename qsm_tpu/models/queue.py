@@ -1,0 +1,169 @@
+"""Bounded FIFO queue — milestone config #4 (BASELINE.json:10).
+
+The first spec whose state space is too big to tabulate: the model state is
+the *queue contents*, kept as a packed int32 vector ``[length, slot0..slotC-1]``
+with ``transition`` a branchless jitted function rather than a step table —
+exactly the representation SURVEY.md §7 hard-parts #2 prescribes.
+
+The racy implementation splits dequeue into front-read + pop round trips, so
+two concurrent dequeues can both observe (and both "remove") the same head —
+the classic duplicate-dequeue race a FIFO linearizability checker must catch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.spec import CmdSig, Spec
+from ..sched.scheduler import Recv, Scheduler, Send
+
+ENQ = 0
+DEQ = 1
+
+OK = 0
+FULL = 1
+
+
+class QueueSpec(Spec):
+    """Bounded FIFO queue of capacity ``capacity`` over values [0, n_values).
+
+    ENQ(v) responds OK(0) and appends, or FULL(1) when at capacity.
+    DEQ responds the head value, or the sentinel ``n_values`` when empty.
+    Model state: ``[length, slot0, ..., slot_{capacity-1}]`` with slot0 the
+    head; vacated slots are zeroed so equal queue contents always pack to the
+    same state vector (canonical form matters for memoised oracles).
+    """
+
+    name = "queue"
+
+    def __init__(self, capacity: int = 4, n_values: int = 4):
+        self.capacity = capacity
+        self.n_values = n_values
+        self.STATE_DIM = 1 + capacity
+        self.EMPTY = n_values  # DEQ-on-empty response sentinel
+        self.CMDS = (
+            CmdSig("enq", n_args=n_values, n_resps=2),
+            CmdSig("deq", n_args=1, n_resps=n_values + 1),
+        )
+
+    def initial_state(self) -> np.ndarray:
+        return np.zeros(self.STATE_DIM, np.int32)
+
+    def step_py(self, state, cmd, arg, resp):
+        length = state[0]
+        slots = list(state[1:])
+        if cmd == ENQ:
+            if length == self.capacity:
+                return [length] + slots, resp == FULL
+            new = slots.copy()
+            new[length] = arg
+            return [length + 1] + new, resp == OK
+        if length == 0:
+            return [0] + slots, resp == self.EMPTY
+        head = slots[0]
+        new = slots[1:] + [0]
+        return [length - 1] + new, resp == head
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        length = state[0]
+        slots = state[1:]
+        iota = jnp.arange(self.capacity)
+
+        is_enq = cmd == ENQ
+        full = length == self.capacity
+        empty = length == 0
+        head = slots[0]
+
+        enq_ok = jnp.where(full, resp == FULL, resp == OK)
+        deq_ok = jnp.where(empty, resp == self.EMPTY, resp == head)
+        ok = jnp.where(is_enq, enq_ok, deq_ok)
+
+        enq_slots = jnp.where((iota == length) & ~full, arg, slots)
+        # dequeue: shift left one, zero the vacated tail slot
+        deq_slots = jnp.where(empty, slots,
+                              jnp.where(iota == self.capacity - 1, 0,
+                                        jnp.roll(slots, -1)))
+        new_slots = jnp.where(is_enq, enq_slots, deq_slots)
+        new_len = jnp.where(is_enq,
+                            length + (~full).astype(length.dtype),
+                            length - (~empty).astype(length.dtype))
+        new_state = jnp.concatenate(
+            [new_len[None], new_slots]).astype(state.dtype)
+        return new_state, ok
+
+
+# ---------------------------------------------------------------------------
+# SUT implementations
+# ---------------------------------------------------------------------------
+
+def _queue_server(q: dict, capacity: int, n_values: int):
+    """Atomic per-message queue server; also answers the racy SUT's
+    two-phase ('front', 'pop') protocol."""
+    while True:
+        msg = yield Recv()
+        kind, *rest = msg.payload
+        items = q["items"]
+        if kind == "enq":
+            if len(items) >= capacity:
+                yield Send(msg.src, FULL)
+            else:
+                items.append(rest[0])
+                yield Send(msg.src, OK)
+        elif kind == "deq":
+            yield Send(msg.src, items.pop(0) if items else n_values)
+        elif kind == "front":
+            yield Send(msg.src, items[0] if items else n_values)
+        elif kind == "pop":
+            if items:
+                items.pop(0)
+            yield Send(msg.src, OK)
+
+
+class AtomicQueueSUT:
+    """Correct: enq/deq each a single atomically-applied server message.
+    Expected to PASS prop_concurrent."""
+
+    def __init__(self, spec: QueueSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.q = {"items": []}
+        sched.spawn("server",
+                    _queue_server(self.q, self.spec.capacity,
+                                  self.spec.n_values), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        yield Send("server", ("enq", arg) if cmd == ENQ else ("deq",))
+        msg = yield Recv()
+        return msg.payload
+
+
+class RacyTwoPhaseQueueSUT:
+    """Racy: dequeue is front-read then pop as separate round trips; two
+    concurrent dequeues can both return the same head (duplicate delivery)
+    while two elements get popped.  Expected to FAIL."""
+
+    def __init__(self, spec: QueueSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.q = {"items": []}
+        sched.spawn("server",
+                    _queue_server(self.q, self.spec.capacity,
+                                  self.spec.n_values), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        if cmd == ENQ:
+            yield Send("server", ("enq", arg))
+            msg = yield Recv()
+            return msg.payload
+        yield Send("server", ("front",))
+        msg = yield Recv()
+        head = msg.payload
+        if head == self.spec.n_values:
+            return head  # observed empty
+        yield Send("server", ("pop",))
+        yield Recv()
+        return head

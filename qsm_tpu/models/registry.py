@@ -1,0 +1,64 @@
+"""Model registry — names → (spec factory, SUT implementations).
+
+The CLI and regression files refer to specs/SUTs by name; everything needed
+to reproduce a run is then (model, impl, seed, config) — the reference's
+"every artifact derivable from (seed, config)" philosophy (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict
+
+from .cas import AtomicCasSUT, CasSpec, RacyCasSUT
+from .counter import AtomicTicketSUT, RacyTicketSUT, TicketSpec
+from .kv import AtomicKvSUT, KvSpec, StaleCacheKvSUT
+from .queue import AtomicQueueSUT, QueueSpec, RacyTwoPhaseQueueSUT
+from .register import (AtomicRegisterSUT, RacyCachedRegisterSUT,
+                       RegisterSpec, ReplicatedRegisterSUT)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelEntry:
+    make_spec: Callable[[], object]
+    impls: Dict[str, Callable]  # impl name -> SUT factory (takes spec)
+    default_pids: int
+    default_ops: int
+
+
+def _no_spec(cls):
+    return lambda spec: cls()
+
+
+MODELS: Dict[str, ModelEntry] = {
+    "register": ModelEntry(
+        make_spec=RegisterSpec,
+        impls={"atomic": _no_spec(AtomicRegisterSUT),
+               "racy": _no_spec(RacyCachedRegisterSUT),
+               "replicated": _no_spec(ReplicatedRegisterSUT)},
+        default_pids=2, default_ops=12),
+    "ticket": ModelEntry(
+        make_spec=TicketSpec,
+        impls={"atomic": _no_spec(AtomicTicketSUT),
+               "racy": _no_spec(RacyTicketSUT)},
+        default_pids=4, default_ops=24),
+    "cas": ModelEntry(
+        make_spec=CasSpec,
+        impls={"atomic": AtomicCasSUT, "racy": RacyCasSUT},
+        default_pids=8, default_ops=32),
+    "queue": ModelEntry(
+        make_spec=QueueSpec,
+        impls={"atomic": AtomicQueueSUT, "racy": RacyTwoPhaseQueueSUT},
+        default_pids=8, default_ops=48),
+    "kv": ModelEntry(
+        make_spec=KvSpec,
+        impls={"atomic": AtomicKvSUT, "racy": StaleCacheKvSUT},
+        default_pids=16, default_ops=64),
+}
+
+
+def make(model: str, impl: str):
+    """(spec, sut) for a registry entry."""
+    entry = MODELS[model]
+    spec = entry.make_spec()
+    return spec, entry.impls[impl](spec)

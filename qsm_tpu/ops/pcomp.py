@@ -1,0 +1,94 @@
+"""P-compositionality — per-key decomposition of linearizability checking.
+
+After Horn & Kroening (PAPERS.md:5): for specs that are products of
+independent per-key objects, a history is linearizable **iff** each per-key
+sub-history is linearizable against the per-key object.  The split turns one
+history of ≤64 ops over 16 pids (config #5, BASELINE.json:11) into K small
+sub-problems — exactly the shape the batched device kernel wants: more,
+smaller, independent histories per ``vmap`` batch (SURVEY.md §2b).
+
+Soundness rests on the spec's own declaration (SURVEY.md §7 hard-parts #3):
+``partition_key`` must be total (no cross-key ops) and the projected spec
+must faithfully model a single key.  ``PComp`` validates totality at runtime
+and refuses to decompose otherwise, rather than silently giving unsound
+verdicts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..core.history import NO_RESP, History, Op
+from ..core.spec import Spec
+from .backend import LineariseBackend, Verdict
+
+
+def split_history(spec: Spec, history: History) -> Dict[int, History]:
+    """Project a history into per-key sub-histories of the projected spec.
+
+    Timestamps are preserved, so real-time precedence *within* each key is
+    exactly the induced sub-order; cross-key precedence is discarded, which
+    is precisely what P-compositionality licenses."""
+    per_key: Dict[int, List[Op]] = {}
+    for op in history.ops:
+        key = spec.partition_key(op.cmd, op.arg)
+        if key is None:
+            raise ValueError(
+                f"{spec.name}: partition_key is not total "
+                f"(cmd={op.cmd}, arg={op.arg}); cannot decompose")
+        if op.is_pending:
+            cmd, arg, _ = spec.project_op(op.cmd, op.arg, 0)
+            resp = NO_RESP
+        else:
+            cmd, arg, resp = spec.project_op(op.cmd, op.arg, op.resp)
+        per_key.setdefault(key, []).append(
+            dataclasses.replace(op, cmd=cmd, arg=arg, resp=resp))
+    return {k: History(ops, seed=history.seed,
+                       program_id=history.program_id)
+            for k, ops in per_key.items()}
+
+
+class PComp:
+    """Backend combinator: decompose per key, decide ALL sub-histories of
+    the whole input batch in one inner-backend call, aggregate per input.
+
+    Aggregation: VIOLATION if any key violates; else BUDGET_EXCEEDED if any
+    key was undecided; else LINEARIZABLE.
+    """
+
+    def __init__(self, spec: Spec, make_inner=None):
+        """``make_inner(projected_spec) -> LineariseBackend``; defaults to
+        the CPU oracle.  A factory (not an instance) because device backends
+        bind to one spec at construction (compile cache per spec)."""
+        from .wing_gong_cpu import WingGongCPU
+
+        self.spec = spec
+        self.projected = spec.projected_spec()
+        self.inner: LineariseBackend = (
+            make_inner(self.projected) if make_inner is not None
+            else WingGongCPU())
+        self.name = f"pcomp({self.inner.name})"
+
+    def check_histories(self, spec: Spec, histories: Sequence[History]
+                        ) -> np.ndarray:
+        assert spec is self.spec, "PComp is bound to one spec"
+        flat: List[History] = []
+        groups: List[slice] = []
+        for h in histories:
+            start = len(flat)
+            flat.extend(split_history(spec, h).values())
+            groups.append(slice(start, len(flat)))
+        out = np.full(len(histories), int(Verdict.LINEARIZABLE), np.int8)
+        if not flat:
+            return out
+        sub = self.inner.check_histories(self.projected, flat)
+        for i, g in enumerate(groups):
+            v = sub[g]
+            if (v == Verdict.VIOLATION).any():
+                out[i] = int(Verdict.VIOLATION)
+            elif (v == Verdict.BUDGET_EXCEEDED).any():
+                out[i] = int(Verdict.BUDGET_EXCEEDED)
+        return out

@@ -1,0 +1,3 @@
+"""Mesh/sharding for batch-parallel checking at scale (SURVEY.md §2b, §5)."""
+
+from .mesh import batch_sharding, make_mesh, replicated_sharding

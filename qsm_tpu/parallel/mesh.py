@@ -1,0 +1,51 @@
+"""Device mesh + batch-axis sharding for the checker plane.
+
+The reference's distribution story is actor messaging (distributed-process
+over network-transport-*, SURVEY.md §5 comm backend); its checker is pure and
+single-threaded.  Our checker plane instead scales the *batch axis* of the
+linearisation kernel over a ``jax.sharding.Mesh``: trials and shrink
+candidates are independent (SURVEY.md §2b "trial/batch parallelism"), so the
+natural mapping is data parallelism — shard histories over devices, replicate
+the (tiny) spec state, and let XLA place everything with zero collectives in
+the hot loop (verdict gather rides the ICI at the end of the batch).
+
+Single chip needs none of this; the helpers here exist so the SAME kernel
+runs unchanged from v5e-1 to a full pod slice: ``pjit``-style sharding comes
+entirely from ``NamedSharding`` annotations on the inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "batch"):
+    """A 1-D device mesh over the first ``n_devices`` devices (all by
+    default).  The single axis is the history-batch axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def batch_sharding(mesh, axis: Optional[str] = None):
+    """NamedSharding placing dim 0 (the batch) over the mesh axis."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.NamedSharding(mesh, P(axis or mesh.axis_names[0]))
+
+
+def replicated_sharding(mesh):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.NamedSharding(mesh, P())

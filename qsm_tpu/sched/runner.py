@@ -75,10 +75,31 @@ def _client(rec: HistoryRecorder, sut: ConcurrentSUT, pid: int, ops):
         rec.respond(op_id, resp)
 
 
-def run_concurrent(
+def prepare_run(
     sut: ConcurrentSUT,
     program: Program,
     seed,  # int or str; any random.Random seed value
+    faults: Optional[FaultPlan] = None,
+    max_steps: int = 100_000,
+) -> tuple:
+    """(scheduler, recorder) wired up and ready to ``sched.run()``.
+
+    Split out of :func:`run_concurrent` so callers that need scheduler
+    internals afterwards (e.g. the delivery trace, for coverage stats) share
+    the exact same run protocol."""
+    sched = Scheduler(seed=seed, faults=faults, max_steps=max_steps)
+    rec = HistoryRecorder(sched)
+    sut.setup(sched)
+    for pid, ops in enumerate(program.per_pid()):
+        if ops:
+            sched.spawn(f"client:{pid}", _client(rec, sut, pid, ops))
+    return sched, rec
+
+
+def run_concurrent(
+    sut: ConcurrentSUT,
+    program: Program,
+    seed,
     faults: Optional[FaultPlan] = None,
     max_steps: int = 100_000,
 ) -> History:
@@ -88,11 +109,6 @@ def run_concurrent(
     History, bit for bit.  Unresponded ops (faults/wedges) come back as
     pending ops for the lineariser to complete/prune.
     """
-    sched = Scheduler(seed=seed, faults=faults, max_steps=max_steps)
-    rec = HistoryRecorder(sched)
-    sut.setup(sched)
-    for pid, ops in enumerate(program.per_pid()):
-        if ops:
-            sched.spawn(f"client:{pid}", _client(rec, sut, pid, ops))
+    sched, rec = prepare_run(sut, program, seed, faults, max_steps)
     sched.run()
     return rec.history(seed=seed)

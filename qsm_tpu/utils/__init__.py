@@ -1,0 +1,6 @@
+"""Config, CLI, structured logging, counterexample printing, coverage stats
+(SURVEY.md §5 auxiliary subsystems)."""
+
+from .report import (JsonlLogger, format_counterexample, format_history,
+                     load_regression, save_regression)
+from .stats import CoverageStats, schedule_coverage

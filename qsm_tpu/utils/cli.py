@@ -1,0 +1,231 @@
+"""Command-line interface — ``python -m qsm_tpu <cmd>``.
+
+The reference's knobs are QuickCheck ``Args`` (maxSuccess, replay seed, size)
+(SURVEY.md §5 config): here that's a plain argparse CLI over the registry —
+``run`` (property check), ``replay`` (reproduce a persisted failure),
+``bench`` (checker throughput), ``coverage`` (schedule diversity).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from ..core.property import PropertyConfig, prop_concurrent, replay
+from ..models.registry import MODELS, make
+from ..ops.wing_gong_cpu import WingGongCPU
+from ..sched.runner import run_concurrent
+from ..sched.scheduler import FaultPlan
+from .report import (JsonlLogger, format_counterexample, format_history,
+                     load_regression, save_regression)
+from .stats import schedule_coverage
+
+
+def _make_backend(name: str, spec):
+    if name == "cpu":
+        return WingGongCPU(memo=True)
+    if name == "tpu":
+        from ..ops.jax_kernel import JaxTPU
+
+        return JaxTPU(spec)
+    if name == "pcomp":
+        from ..ops.pcomp import PComp
+
+        return PComp(spec)
+    if name == "pcomp-tpu":
+        from ..ops.jax_kernel import JaxTPU
+        from ..ops.pcomp import PComp
+
+        return PComp(spec, lambda pspec: JaxTPU(pspec))
+    raise SystemExit(f"unknown backend {name!r}")
+
+
+def _add_run_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--model", required=True, choices=sorted(MODELS))
+    p.add_argument("--impl", default="racy")
+    p.add_argument("--pids", type=int, default=None)
+    p.add_argument("--ops", type=int, default=None)
+    p.add_argument("--trials", type=int, default=100)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--backend", default="cpu",
+                   choices=["cpu", "tpu", "pcomp", "pcomp-tpu"])
+    p.add_argument("--p-drop", type=float, default=0.0)
+    p.add_argument("--p-duplicate", type=float, default=0.0)
+    p.add_argument("--log", default=None, help="JSONL log path")
+    p.add_argument("--save-regression", default=None,
+                   help="write failing counterexample to this JSON file")
+
+
+def cmd_run(args) -> int:
+    entry = MODELS[args.model]
+    spec, sut = make(args.model, args.impl)
+    faults = None
+    if args.p_drop or args.p_duplicate:
+        faults = FaultPlan(p_drop=args.p_drop, p_duplicate=args.p_duplicate)
+    cfg = PropertyConfig(
+        n_trials=args.trials,
+        n_pids=args.pids or entry.default_pids,
+        max_ops=args.ops or entry.default_ops,
+        seed=args.seed, faults=faults)
+    log = JsonlLogger(path=args.log) if args.log else JsonlLogger()
+    t0 = time.perf_counter()
+    backend = _make_backend(args.backend, spec)
+    # pass the cpu backend through as the oracle too, so _resolve's
+    # backend-is-oracle short-circuit fires (re-running the identical
+    # search can only repeat the verdict)
+    oracle = backend if args.backend == "cpu" else None
+    res = prop_concurrent(spec, sut, cfg, backend=backend, oracle=oracle)
+    dt = time.perf_counter() - t0
+    log.emit("result", model=args.model, impl=args.impl, ok=res.ok,
+             trials=res.trials_run, histories=res.histories_checked,
+             undecided=res.undecided, seconds=round(dt, 3))
+    if res.ok:
+        print(f"OK: {args.model}/{args.impl} passed {res.trials_run} trials "
+              f"({res.histories_checked} histories, {dt:.1f}s)")
+        if res.undecided:
+            print(f"WARNING: {res.undecided} trials undecided "
+                  "(budget exceeded on both backends)")
+            return 2
+        return 0
+    cx = res.counterexample
+    print(f"FAIL: {args.model}/{args.impl} — linearizability violation")
+    print(format_counterexample(spec, cx))
+    fault_flags = ""
+    if faults is not None:
+        fault_flags = (f" --p-drop {args.p_drop}"
+                       f" --p-duplicate {args.p_duplicate}")
+    print(f"replay: python -m qsm_tpu replay --model {args.model} "
+          f"--impl {args.impl} --trial-seed '{cx.trial_seed}' "
+          f"--pids {cfg.n_pids} --ops {cfg.max_ops} "
+          f"--trials {cfg.n_trials}{fault_flags}")
+    if args.save_regression:
+        save_regression(args.save_regression, args.model, args.impl, spec,
+                        cfg, cx)
+        print(f"regression saved to {args.save_regression}")
+    return 1
+
+
+def cmd_replay(args) -> int:
+    if args.regression:
+        model, impl, seed_key, prog, hist, faults = \
+            load_regression(args.regression)
+        spec, sut = make(model, impl)
+        print(f"replaying {model}/{impl} trial seed {seed_key!r}")
+        h = run_concurrent(sut, prog, seed=seed_key, faults=faults)
+        fields = lambda hh: [(o.pid, o.cmd, o.arg, o.resp, o.invoke_time,
+                              o.response_time) for o in hh.ops]
+        same = fields(h) == fields(hist)
+        print(f"history reproduced bit-identically: {same}")
+    else:
+        if not (args.model and args.trial_seed):
+            raise SystemExit(
+                "replay needs either --regression FILE or both "
+                "--model and --trial-seed")
+        spec, sut = make(args.model, args.impl)
+        entry = MODELS[args.model]
+        faults = None
+        if args.p_drop or args.p_duplicate:
+            faults = FaultPlan(p_drop=args.p_drop,
+                               p_duplicate=args.p_duplicate)
+        cfg = PropertyConfig(n_trials=args.trials,
+                             n_pids=args.pids or entry.default_pids,
+                             max_ops=args.ops or entry.default_ops,
+                             faults=faults)
+        h = replay(spec, sut, args.trial_seed, cfg)
+    v = WingGongCPU().check_histories(spec, [h])[0]
+    print(format_history(spec, h))
+    print(f"verdict: {['VIOLATION', 'LINEARIZABLE', 'BUDGET_EXCEEDED'][v]}")
+    return 0 if v == 1 else 1
+
+
+def cmd_bench(args) -> int:
+    entry = MODELS[args.model]
+    spec = entry.make_spec()
+    n_pids = args.pids or entry.default_pids
+    n_ops = args.ops or entry.default_ops
+    from .corpus import build_corpus
+
+    hists = build_corpus(
+        spec, (entry.impls["atomic"], entry.impls["racy"]),
+        n=args.corpus, n_pids=n_pids, max_ops=n_ops, seed_prefix="bench")
+    backend = _make_backend(args.backend, spec)
+    backend.check_histories(spec, hists)  # warmup
+    t0 = time.perf_counter()
+    v = backend.check_histories(spec, hists)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "model": args.model, "backend": args.backend,
+        "histories": len(hists), "seconds": round(dt, 3),
+        "histories_per_sec": round(len(hists) / dt, 1),
+        "undecided": int((v == 2).sum())}))
+    return 0
+
+
+def cmd_coverage(args) -> int:
+    entry = MODELS[args.model]
+    spec, _ = make(args.model, args.impl)
+    from ..core.generator import generate_program
+
+    prog = generate_program(spec, seed=args.seed,
+                            n_pids=args.pids or entry.default_pids,
+                            max_ops=args.ops or entry.default_ops)
+    stats = schedule_coverage(
+        lambda: make(args.model, args.impl)[1], prog,
+        seeds=[f"{args.seed}:{i}" for i in range(args.runs)])
+    print(json.dumps({
+        "model": args.model, "ops": len(prog), "runs": stats.seeds,
+        "distinct_schedules": stats.distinct_schedules,
+        "distinct_histories": stats.distinct_histories,
+        "schedule_diversity": round(stats.schedule_diversity, 3)}))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="qsm_tpu",
+        description="TPU-native state-machine property testing / "
+                    "linearizability checking")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("run", help="run prop_concurrent on a model/impl")
+    _add_run_args(p)
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("replay", help="reproduce a failure from seed or file")
+    p.add_argument("--regression", default=None)
+    p.add_argument("--model", default=None, choices=sorted(MODELS))
+    p.add_argument("--impl", default="racy")
+    p.add_argument("--trial-seed", default=None)
+    p.add_argument("--pids", type=int, default=None)
+    p.add_argument("--ops", type=int, default=None)
+    p.add_argument("--trials", type=int, default=100)
+    p.add_argument("--p-drop", type=float, default=0.0)
+    p.add_argument("--p-duplicate", type=float, default=0.0)
+    p.set_defaults(fn=cmd_replay)
+
+    p = sub.add_parser("bench", help="checker throughput on one model")
+    p.add_argument("--model", default="cas", choices=sorted(MODELS))
+    p.add_argument("--backend", default="cpu",
+                   choices=["cpu", "tpu", "pcomp", "pcomp-tpu"])
+    p.add_argument("--pids", type=int, default=None)
+    p.add_argument("--ops", type=int, default=None)
+    p.add_argument("--corpus", type=int, default=256)
+    p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser("coverage", help="schedule-coverage stats")
+    p.add_argument("--model", required=True, choices=sorted(MODELS))
+    p.add_argument("--impl", default="atomic")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--pids", type=int, default=None)
+    p.add_argument("--ops", type=int, default=None)
+    p.add_argument("--runs", type=int, default=100)
+    p.set_defaults(fn=cmd_coverage)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,149 @@
+"""Observability: counterexample printing, structured logs, regression files.
+
+The reference leans on QuickCheck's counterexample printer and ``collect``
+stats, and "checkpointing" is seed replay (SURVEY.md §5).  Here: a per-pid
+timeline printer for minimal counterexamples, one-JSON-line-per-trial
+structured logging, and persisted regression files carrying everything needed
+to replay a failure — (model, impl, seed key, config, program, history).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import IO, Optional
+
+from ..core.generator import ProgOp, Program
+from ..core.history import History, Op
+from ..core.property import Counterexample, PropertyConfig
+from ..core.spec import Spec
+
+
+# ---------------------------------------------------------------------------
+# Counterexample pretty-printer
+# ---------------------------------------------------------------------------
+
+def _op_label(spec: Spec, op: Op) -> str:
+    sig = spec.CMDS[op.cmd]
+    arg = f"({op.arg})" if sig.n_args > 1 else "()"
+    resp = "?" if op.is_pending else str(op.resp)
+    return f"{sig.name}{arg} -> {resp}"
+
+
+def format_history(spec: Spec, history: History, width: int = 60) -> str:
+    """Per-pid timeline: one row per operation, bars showing the real-time
+    interval — overlap between rows is exactly the concurrency the
+    lineariser had to untangle."""
+    if not history.ops:
+        return "(empty history)"
+    t_max = max(o.response_time for o in history.ops
+                if not o.is_pending) if history.n_pending < len(
+                    history.ops) else max(o.invoke_time for o in history.ops)
+    t_max = max(t_max, 1)
+    scale = max(1.0, t_max / width)
+    lines = []
+    for o in history.ops:
+        lo = int(o.invoke_time / scale)
+        hi = (int(min(o.response_time, t_max) / scale)
+              if not o.is_pending else width)
+        hi = max(hi, lo + 1)
+        bar = " " * lo + "[" + "=" * (hi - lo - 1) + ("]" if not o.is_pending
+                                                      else ">")
+        lines.append(f"  pid {o.pid}  {_op_label(spec, o):24s} |{bar}")
+    return "\n".join(lines)
+
+
+def format_counterexample(spec: Spec, cx: Counterexample) -> str:
+    head = (f"counterexample after trial {cx.trial} "
+            f"(seed {cx.trial_seed!r}, {cx.shrink_steps} shrink steps, "
+            f"{len(cx.program)} ops):")
+    return head + "\n" + format_history(spec, cx.history)
+
+
+# ---------------------------------------------------------------------------
+# Structured logging — one JSON line per event
+# ---------------------------------------------------------------------------
+
+class JsonlLogger:
+    """Minimal structured logger: ``log.emit("trial", trial=3, ok=True)``
+    writes one self-contained JSON line (SURVEY.md §5 metrics/logging)."""
+
+    def __init__(self, stream: Optional[IO] = None, path: Optional[str] = None):
+        assert not (stream and path)
+        self._own = open(path, "a") if path else None
+        self.stream = self._own or stream
+
+    def emit(self, event: str, **fields) -> None:
+        if self.stream is None:
+            return
+        rec = {"ts": round(time.time(), 3), "event": event, **fields}
+        self.stream.write(json.dumps(rec) + "\n")
+        self.stream.flush()
+
+    def close(self) -> None:
+        if self._own:
+            self._own.close()
+
+
+# ---------------------------------------------------------------------------
+# Regression files — persisted failing triples
+# ---------------------------------------------------------------------------
+
+def faults_to_doc(fp) -> Optional[dict]:
+    """JSON-safe FaultPlan serialization (sets become sorted lists)."""
+    if fp is None:
+        return None
+    return {"p_drop": fp.p_drop, "p_duplicate": fp.p_duplicate,
+            "partitions": [sorted(g) for g in fp.partitions],
+            "crash_at": dict(fp.crash_at),
+            "protected": sorted(fp.protected)}
+
+
+def faults_from_doc(doc: Optional[dict]):
+    from ..sched.scheduler import FaultPlan
+
+    if doc is None:
+        return None
+    return FaultPlan(p_drop=doc["p_drop"], p_duplicate=doc["p_duplicate"],
+                     partitions=[set(g) for g in doc["partitions"]],
+                     crash_at=doc["crash_at"],
+                     protected=set(doc["protected"]))
+
+
+def save_regression(path: str, model: str, impl: str, spec: Spec,
+                    cfg: PropertyConfig, cx: Counterexample) -> None:
+    """Persist a failure as a self-contained replayable JSON file."""
+    doc = {
+        "model": model,
+        "impl": impl,
+        "spec": spec.name,
+        "config": {
+            **{k: v for k, v in dataclasses.asdict(cfg).items()
+               if k != "faults"},
+            "faults": faults_to_doc(cfg.faults)},
+        "trial": cx.trial,
+        "trial_seed": cx.trial_seed,
+        "shrink_steps": cx.shrink_steps,
+        "program": {"n_pids": cx.program.n_pids,
+                    "ops": [[o.pid, o.cmd, o.arg] for o in cx.program.ops]},
+        "history": [[o.pid, o.cmd, o.arg, o.resp, o.invoke_time,
+                     o.response_time] for o in cx.history.ops],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+
+
+def load_regression(path: str):
+    """(model, impl, trial_seed, program, history, faults) from a
+    regression file; ``faults`` is the FaultPlan the failure was found
+    under (replay must reuse it or the schedule diverges)."""
+    with open(path) as f:
+        doc = json.load(f)
+    prog = Program(tuple(ProgOp(p, c, a) for p, c, a in doc["program"]["ops"]),
+                   n_pids=doc["program"]["n_pids"])
+    hist = History([Op(pid=p, cmd=c, arg=a, resp=r, invoke_time=i,
+                       response_time=t)
+                    for p, c, a, r, i, t in doc["history"]])
+    faults = faults_from_doc(doc["config"].get("faults"))
+    return doc["model"], doc["impl"], doc["trial_seed"], prog, hist, faults

@@ -1,0 +1,128 @@
+"""Fault injection end-to-end (SURVEY.md §5 failure detection): histories
+with dropped/duplicated messages and crashed pids flow through the full
+generate→execute→check pipeline; a correct SUT stays linearizable (pending
+ops complete/prune), and verdict parity holds on faulty histories."""
+
+from qsm_tpu import (FaultPlan, PropertyConfig, Recv, Send, WingGongCPU,
+                     generate_program, prop_concurrent, run_concurrent)
+from qsm_tpu.models.register import AtomicRegisterSUT, RegisterSpec
+from qsm_tpu.models.cas import AtomicCasSUT, CasSpec, RacyCasSUT
+from qsm_tpu.ops.jax_kernel import JaxTPU
+from qsm_tpu.utils.report import faults_from_doc, faults_to_doc
+
+SPEC = RegisterSpec()
+
+
+def test_prop_concurrent_atomic_register_under_message_loss():
+    """Drops make ops pending, never wrong: the atomic register must still
+    pass — a pending op may or may not have taken effect and the checker
+    tries both (SURVEY.md §3.2 complete/prune)."""
+    faults = FaultPlan(p_drop=0.15, protected=set())
+    cfg = PropertyConfig(n_trials=60, n_pids=2, max_ops=10, seed=21,
+                         faults=faults)
+    res = prop_concurrent(SPEC, AtomicRegisterSUT(), cfg)
+    assert res.ok, res.counterexample
+    assert res.undecided == 0
+
+
+class TaggedRegisterSUT:
+    """Duplicate-tolerant register, the at-least-once RPC discipline on
+    both ends: requests carry a per-pid sequence number; the server dedupes
+    by (client, seq) — a late duplicate re-sends the cached response instead
+    of re-applying the write — and the client discards responses whose tag
+    doesn't match its outstanding request."""
+
+    def setup(self, sched):
+        self.store = {"v": 0}
+        self.seq = {}
+        applied = {}  # src -> (max applied seq, its cached response)
+
+        def server():
+            while True:
+                msg = yield Recv()
+                kind, arg, seq = msg.payload
+                last_seq, last_resp = applied.get(msg.src, (0, None))
+                if seq <= last_seq:
+                    # stale duplicate (clients have one outstanding request,
+                    # seqs strictly increase): do NOT re-apply; re-respond
+                    yield Send(msg.src, (seq, last_resp))
+                    continue
+                if kind == "write":
+                    self.store["v"] = arg
+                    resp = 0
+                else:
+                    resp = self.store["v"]
+                applied[msg.src] = (seq, resp)
+                yield Send(msg.src, (seq, resp))
+
+        sched.spawn("server", server(), daemon=True)
+
+    def perform(self, pid, cmd, arg):
+        from qsm_tpu.models.register import READ
+
+        seq = self.seq[pid] = self.seq.get(pid, 0) + 1
+        yield Send("server", ("read" if cmd == READ else "write", arg, seq))
+        while True:
+            msg = yield Recv()
+            got_seq, result = msg.payload
+            if got_seq == seq:
+                return result  # stale duplicate responses are discarded
+
+
+def test_duplication_breaks_untagged_protocol_and_tagging_fixes_it():
+    """A duplicated request yields a second response that the naive client
+    misattributes to its NEXT operation — a real protocol bug the checker
+    must catch; the seq-tagged client is immune."""
+    faults = FaultPlan(p_duplicate=0.25)
+    cfg = PropertyConfig(n_trials=60, n_pids=2, max_ops=10, seed=22,
+                         faults=faults)
+    res = prop_concurrent(SPEC, AtomicRegisterSUT(), cfg)
+    assert not res.ok, "response misattribution went undetected"
+    res = prop_concurrent(SPEC, TaggedRegisterSUT(), cfg)
+    assert res.ok, res.counterexample
+
+
+def test_prop_concurrent_with_pid_crash():
+    faults = FaultPlan(crash_at={"client:0": 2})
+    cfg = PropertyConfig(n_trials=40, n_pids=2, max_ops=10, seed=23,
+                         faults=faults)
+    res = prop_concurrent(SPEC, AtomicRegisterSUT(), cfg)
+    assert res.ok, res.counterexample
+
+
+def test_racy_cas_still_caught_under_faults():
+    """Faults must not mask real bugs."""
+    spec = CasSpec()
+    faults = FaultPlan(p_drop=0.05, protected=set())
+    cfg = PropertyConfig(n_trials=80, n_pids=8, max_ops=32, seed=5,
+                         faults=faults)
+    res = prop_concurrent(spec, RacyCasSUT(spec), cfg)
+    assert not res.ok
+
+
+def test_backend_parity_on_faulty_histories():
+    from conftest import assert_backend_parity
+
+    spec = CasSpec()
+    faults = FaultPlan(p_drop=0.1, protected=set())
+    hists = []
+    for seed in range(30):
+        prog = generate_program(spec, seed=seed, n_pids=4, max_ops=10)
+        for sut in (AtomicCasSUT(spec), RacyCasSUT(spec)):
+            hists.append(run_concurrent(sut, prog, seed=f"f{seed}",
+                                        faults=faults))
+    assert any(h.n_pending for h in hists), "fault sample vacuous"
+    assert_backend_parity(spec, hists, JaxTPU(spec),
+                          expect_violations=False)
+
+
+def test_fault_plan_doc_roundtrip():
+    fp = FaultPlan(p_drop=0.1, p_duplicate=0.2,
+                   partitions=[{"a", "b"}], crash_at={"client:0": 3},
+                   protected={"server"})
+    fp2 = faults_from_doc(faults_to_doc(fp))
+    assert (fp2.p_drop, fp2.p_duplicate) == (0.1, 0.2)
+    assert fp2.partitions == [{"a", "b"}]
+    assert fp2.crash_at == {"client:0": 3}
+    assert fp2.protected == {"server"}
+    assert faults_from_doc(faults_to_doc(None)) is None

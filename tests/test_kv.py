@@ -1,0 +1,117 @@
+"""Multi-key KV map (config #5, BASELINE.json:11): P-compositionality split
+checks 16-pid/64-op histories key-by-key; pcomp verdicts must equal direct
+whole-history verdicts wherever the direct search is feasible (PAPERS.md:5
+soundness), and the racy stale-cache impl must be caught."""
+
+import numpy as np
+import pytest
+
+from qsm_tpu import (PropertyConfig, Verdict, WingGongCPU, check_one,
+                     generate_program, prop_concurrent, run_concurrent,
+                     sequential_history)
+from qsm_tpu.models.kv import GET, PUT, AtomicKvSUT, KvSpec, StaleCacheKvSUT
+from qsm_tpu.ops.jax_kernel import JaxTPU
+from qsm_tpu.ops.pcomp import PComp, split_history
+
+SPEC = KvSpec(n_keys=4, n_values=4)
+
+
+def test_step_jax_matches_py():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    step = jax.jit(SPEC.step_jax)
+    for _ in range(200):
+        state = [int(v) for v in rng.integers(0, SPEC.n_values, SPEC.n_keys)]
+        cmd = int(rng.integers(0, 2))
+        arg = int(rng.integers(0, SPEC.CMDS[cmd].n_args))
+        resp = int(rng.integers(0, SPEC.CMDS[cmd].n_resps))
+        py_s, py_ok = SPEC.step_py(state, cmd, arg, resp)
+        jx_s, jx_ok = step(jnp.asarray(state, jnp.int32),
+                           jnp.int32(cmd), jnp.int32(arg), jnp.int32(resp))
+        assert list(map(int, jx_s)) == list(py_s)
+        assert bool(jx_ok) == py_ok
+
+
+def test_split_history_projects_to_register():
+    h = sequential_history([
+        (0, PUT, SPEC.put_arg(2, 3), 0),
+        (1, GET, 2, 3),
+        (1, GET, 0, 0),
+    ])
+    subs = split_history(SPEC, h)
+    assert set(subs) == {0, 2}
+    k2 = subs[2]
+    assert [(o.cmd, o.arg, o.resp) for o in k2.ops] == [(1, 3, 0), (0, 0, 3)]
+    # timestamps preserved: real-time order within the key is induced
+    assert [o.invoke_time for o in k2.ops] == [0, 2]
+
+
+def test_pcomp_agrees_with_direct_oracle():
+    """Soundness spot-check: pcomp(WingGongCPU) == direct WingGongCPU on
+    whole KV histories small enough to search directly."""
+    spec = KvSpec(n_keys=2, n_values=4)  # concentrate ops per key
+    direct = WingGongCPU()
+    pcomp = PComp(spec)
+    hists = []
+    for seed in range(40):
+        prog = generate_program(spec, seed=seed, n_pids=4, max_ops=12)
+        for sut in (AtomicKvSUT(spec), StaleCacheKvSUT(spec)):
+            hists.append(run_concurrent(sut, prog, seed=f"kv{seed}"))
+    d = direct.check_histories(spec, hists)
+    p = pcomp.check_histories(spec, hists)
+    assert (d == p).all(), list(zip(d.tolist(), p.tolist()))
+    assert (d == Verdict.VIOLATION).any(), "sample vacuous: no violations"
+
+
+def test_pcomp_device_parity_at_scale():
+    """16 pids × up to 64 ops (the config-#5 scale): pcomp over the device
+    kernel equals pcomp over the CPU oracle, after BUDGET_EXCEEDED verdicts
+    are resolved the way the property layer resolves them (SURVEY.md §7
+    hard-parts #5 — the device budget is bounded, never a guess)."""
+    cpu = PComp(SPEC)
+    dev = PComp(SPEC, lambda pspec: JaxTPU(pspec, budget=100_000))
+    hists = []
+    for seed in range(20):
+        prog = generate_program(SPEC, seed=seed, n_pids=16, max_ops=64)
+        for sut in (AtomicKvSUT(SPEC), StaleCacheKvSUT(SPEC)):
+            hists.append(run_concurrent(sut, prog, seed=f"K{seed}"))
+    c = cpu.check_histories(SPEC, hists)
+    d = dev.check_histories(SPEC, hists)
+    undecided = d == Verdict.BUDGET_EXCEEDED
+    resolved = np.where(undecided, c, d)
+    assert (c == resolved).all(), list(zip(c.tolist(), d.tolist()))
+    # the budget must not be doing all the work: most verdicts decided on
+    # device, both outcomes present
+    assert undecided.mean() < 0.25, f"{undecided.sum()} of {len(hists)}"
+    assert (d == Verdict.VIOLATION).any()
+    assert (d == Verdict.LINEARIZABLE).any()
+
+
+def test_atomic_kv_passes():
+    cfg = PropertyConfig(n_trials=40, n_pids=16, max_ops=64, seed=13)
+    res = prop_concurrent(SPEC, AtomicKvSUT(SPEC), cfg,
+                          backend=PComp(SPEC), oracle=WingGongCPU())
+    assert res.ok, res.counterexample
+
+
+def test_stale_cache_kv_fails_and_shrinks():
+    cfg = PropertyConfig(n_trials=40, n_pids=16, max_ops=64, seed=13)
+    res = prop_concurrent(SPEC, StaleCacheKvSUT(SPEC), cfg,
+                          backend=PComp(SPEC), oracle=WingGongCPU())
+    assert not res.ok, "stale reads were never caught"
+    cx = res.counterexample
+    assert check_one(PComp(SPEC), SPEC, cx.history) == Verdict.VIOLATION
+    # minimal counterexample must still mix a PUT and a GET
+    cmds = {op.cmd for op in cx.program.ops}
+    assert cmds == {GET, PUT}, cx.program
+
+
+def test_pcomp_refuses_non_decomposable_spec():
+    from qsm_tpu.models import CasSpec
+
+    cas = CasSpec()
+    h = sequential_history([(0, 0, 0, 0)])
+    with pytest.raises(ValueError, match="partition_key"):
+        split_history(cas, h)
