@@ -29,9 +29,19 @@ chosen branchlessly:
 * first candidate via ``argmax`` of the bool mask (same canonical op order as
   the CPU oracle, so explored trees — and therefore verdicts — agree)
 
-Worst-case blowups are cut by an iteration budget: the kernel reports
-BUDGET_EXCEEDED honestly and the property layer resolves those via the CPU
-oracle, keeping CPU/TPU verdicts bit-identical (hard-parts #5).
+Two mechanisms tame worst-case blowups:
+
+* an **in-kernel memoisation cache** (Lowe-style): configurations
+  ``(taken-set, state)`` proven non-linearizable-from are inserted into a
+  per-lane hash table on subtree exhaustion and pruned on re-entry — the
+  device analog of ``WingGongCPU(memo=True)``, collapsing violating
+  histories from millions of iterations to ~the number of distinct
+  configurations (see ``build_kernel``);
+* an **iteration budget** with a two-phase rescue: the main batch runs with
+  a bounded budget (flat latency); undecided lanes are re-run in small
+  batches with a large cache and budget.  Anything still undecided reports
+  BUDGET_EXCEEDED honestly and the property layer resolves it via the CPU
+  oracle, keeping CPU/TPU verdicts bit-identical (hard-parts #5).
 
 Pending (crash/fault) ops are expanded host-side into complete histories —
 every prune/complete×response combination (SURVEY.md §3.2 complete/prune) —
@@ -71,18 +81,75 @@ def _batch_bucket(b: int) -> int:
     return _BATCH_BUCKETS[-1]
 
 
-def build_kernel(spec: Spec, n_ops: int, budget: int):
+def make_hash_slot(key_words: int, cache_slots: int):
+    """The kernel's slot hash: murmur3-fmix-style word mixer.
+
+    NOT FNV-1a: FNV is byte-oriented and over 32-bit words its small
+    multiplier never propagates high bits downward, so keys differing only
+    in high taken-bits all collide in the low slot-index bits (regression:
+    tests/test_cache.py).  The xor-shifts here fold high bits into the low
+    bits each round.
+    """
+    import jax.numpy as jnp
+
+    def hash_slot(key):
+        h = jnp.uint32(0x9E3779B9)
+        for i in range(key_words):  # static unroll
+            h = h ^ key[i]
+            h = h * jnp.uint32(0x85EBCA6B)
+            h = h ^ (h >> 16)
+            h = h * jnp.uint32(0xC2B2AE35)
+            h = h ^ (h >> 13)
+        return (h & jnp.uint32(cache_slots - 1)).astype(jnp.int32)
+
+    return hash_slot
+
+
+def build_kernel(spec: Spec, n_ops: int, budget: int,
+                 cache_slots: int = 0):
     """Build the single-history while-loop checker for one (spec, N) shape.
 
     Returned function signature (all jnp arrays):
         (cmd[N], arg[N], resp[N], valid[N], precedes[N,N], init_state[S])
         -> (status: int32, iters: int32)
+
+    ``cache_slots`` > 0 enables the in-kernel memoisation cache (Lowe-style,
+    after the "just-in-time linearizability" cache): a per-history hash
+    table of configurations ``(taken-set, model-state)`` proven
+    non-linearizable-from.  A configuration is inserted when its subtree is
+    exhausted without success, and a child configuration already in the
+    table is pruned without descending.  Single-slot open addressing with
+    FULL key comparison: collisions only lose pruning opportunities, never
+    soundness.  This is what keeps violating histories (which must exhaust
+    the whole tree) out of the exponential regime, exactly like the CPU
+    oracle's ``memo=True``; verdicts are unchanged, only iteration counts.
+
+    Default is OFF: callers must stay inside the verified-safe
+    (batch x cache_slots) region — see :class:`JaxTPU`, which enables the
+    cache only for its small-batch rescue pass.
     """
     import jax
     import jax.numpy as jnp
 
     iota = jnp.arange(n_ops, dtype=jnp.int32)
     iota1 = jnp.arange(n_ops + 1, dtype=jnp.int32)
+
+    n_words = (n_ops + 31) // 32  # taken-bitmask words
+    key_words = n_words + spec.STATE_DIM
+    use_cache = cache_slots > 0
+    assert cache_slots == 0 or (cache_slots & (cache_slots - 1)) == 0, \
+        "cache_slots must be a power of two"
+    shift = jnp.arange(32, dtype=jnp.uint32)
+
+    def pack_key(taken, state):
+        """(taken bool[N], state int32[S]) -> uint32[key_words], exact."""
+        pad = jnp.concatenate(
+            [taken, jnp.zeros(n_words * 32 - n_ops, bool)])
+        words = jnp.sum(
+            pad.reshape(n_words, 32).astype(jnp.uint32) << shift, axis=1)
+        return jnp.concatenate([words, state.astype(jnp.uint32)])
+
+    hash_slot = make_hash_slot(key_words, cache_slots) if use_cache else None
 
     # NOTE: all stack updates below are branchless one-hot mask arithmetic,
     # deliberately avoiding jnp .at[].set scatters.  Besides being the
@@ -113,35 +180,67 @@ def build_kernel(spec: Spec, n_ops: int, budget: int):
             cand = untaken & ~blocked & ok & (iota > chosen[d])
             has = jnp.any(cand)
             j = jnp.argmax(cand).astype(jnp.int32)
+            child_state = nxt[j].astype(jnp.int32)
+            success = has & (d + 1 == n_req)
+
+            if use_cache:
+                # child configuration already proven failed? prune: keep
+                # depth, move the cursor past j.  (A success child can
+                # never be cached — full configs never fail — so `success`
+                # needs no priority carve-out; kept explicit for clarity.)
+                key_child = pack_key(taken | (iota == j), child_state)
+                slot_c = hash_slot(key_child)
+                hit = (c["occ"][slot_c] == 1) & \
+                    jnp.all(c["keys"][slot_c] == key_child)
+                prune = has & hit & ~success
+            else:
+                prune = jnp.bool_(False)
+            descend = has & ~prune
 
             # -- descend: take op j, push state, open cursor at d+1 ------
+            # -- prune: cursor past j, stay put --------------------------
             # -- backtrack: untake op below, keep its cursor -------------
             d_back = jnp.maximum(d - 1, 0)
             prev = jnp.maximum(chosen[d_back], 0)
             taken_new = jnp.where(
-                has, taken | (iota == j),
-                taken & ~((iota == prev) & (d > 0)))
+                descend, taken | (iota == j),
+                jnp.where(prune, taken,
+                          taken & ~((iota == prev) & (d > 0))))
             chosen_desc = jnp.where(iota1 == d, j,
                                     jnp.where(iota1 == d + 1, -1, chosen))
+            chosen_prune = jnp.where(iota1 == d, j, chosen)
             states_desc = jnp.where((iota1 == d + 1)[:, None],
-                                    nxt[j][None, :].astype(jnp.int32),
-                                    states)
+                                    child_state[None, :], states)
 
-            d_new = jnp.where(has, d + 1, d_back)
+            d_new = jnp.where(descend, d + 1, jnp.where(prune, d, d_back))
             status = jnp.where(
-                has & (d + 1 == n_req), SUCCESS,
+                success, SUCCESS,
                 jnp.where((~has) & (d == 0), FAILURE, RUNNING))
             iters = c["iters"] + 1
             status = jnp.where((status == RUNNING) & (iters >= budget),
                                BUDGET, status)
-            return {
+            out = {
                 "d": d_new,
                 "taken": taken_new,
-                "chosen": jnp.where(has, chosen_desc, chosen),
-                "states": jnp.where(has, states_desc, states),
+                "chosen": jnp.where(descend, chosen_desc,
+                                    jnp.where(prune, chosen_prune, chosen)),
+                "states": jnp.where(descend, states_desc, states),
                 "status": status.astype(jnp.int32),
                 "iters": iters,
             }
+            if use_cache:
+                # exhausted (no candidates left): this configuration is
+                # proven non-linearizable-from — insert before backtracking.
+                # One-hot masked write, NOT a scatter: vmapped scatters with
+                # batched indices crash/corrupt on this stack (see module
+                # NOTE above); the masked select fuses cleanly on TPU.
+                key_cur = pack_key(taken, state)
+                slot_cur = hash_slot(key_cur)
+                row_mask = (jnp.arange(cache_slots) == slot_cur) & ~has
+                out["keys"] = jnp.where(row_mask[:, None],
+                                        key_cur[None, :], c["keys"])
+                out["occ"] = jnp.where(row_mask, 1, c["occ"])
+            return out
 
         init = {
             "d": jnp.int32(0),
@@ -153,6 +252,9 @@ def build_kernel(spec: Spec, n_ops: int, budget: int):
                                 RUNNING).astype(jnp.int32),
             "iters": jnp.int32(0),
         }
+        if use_cache:
+            init["keys"] = jnp.zeros((cache_slots, key_words), jnp.uint32)
+            init["occ"] = jnp.zeros(cache_slots, jnp.int32)
         out = jax.lax.while_loop(cond, body, init)
         return out["status"], out["iters"]
 
@@ -170,25 +272,51 @@ class JaxTPU:
 
     name = "jax_tpu"
 
+    # empirical safe region for (batch x cache_slots) on the axon TPU
+    # stack: 256x1024 lane-slots crashes the worker, 256x512 and 64x4096
+    # are fine; large batches with even tiny caches are pathologically slow
+    # (the per-iteration cache rewrite stops being in-place).  So: the MAIN
+    # pass always runs cache-less, and the memo cache lives only in the
+    # small-batch rescue pass, capped to the verified-safe product.
+    MAX_LANE_SLOTS = 1 << 17
+    # 16 would pad to the 64 batch bucket anyway; run full 64-lane rescues
+    RESCUE_BATCH = 64
+
     def __init__(self, spec: Spec, budget: int = 200_000,
                  max_expansions: int = 128,
-                 sharding=None):
+                 sharding=None,
+                 rescue_budget: int = 500_000,
+                 rescue_slots: int = 8192):
         self.spec = spec
         self.budget = budget
         self.max_expansions = max_expansions
         self.sharding = sharding  # optional NamedSharding for the batch axis
-        self._compiled: Dict[Tuple[int, int], object] = {}
+        # lanes still undecided after the cache-less main pass are re-run
+        # in small batches with a large memo cache — the two-phase rescue
+        # that keeps batch latency flat AND decides the hard tail on device
+        # instead of deferring it to the CPU oracle
+        self.rescue_budget = rescue_budget
+        self.rescue_slots = rescue_slots
+        self._compiled: Dict[Tuple[int, int, int, int], object] = {}
         self.batches_run = 0
         self.device_histories = 0
+        self.rescued = 0
 
     # -- compilation cache -------------------------------------------------
-    def _kernel(self, n_ops: int, batch: int):
+    def _safe_slots(self, batch: int, want: int) -> int:
+        slots = want
+        while slots > 0 and batch * slots > self.MAX_LANE_SLOTS:
+            slots //= 2
+        return slots
+
+    def _kernel(self, n_ops: int, batch: int, slots: int, budget: int):
         import jax
 
-        key = (n_ops, batch)
+        key = (n_ops, batch, slots, budget)
         fn = self._compiled.get(key)
         if fn is None:
-            single = build_kernel(self.spec, n_ops, self.budget)
+            single = build_kernel(self.spec, n_ops, budget,
+                                  cache_slots=slots)
             batched = jax.vmap(single, in_axes=(0, 0, 0, 0, 0, None))
             fn = jax.jit(batched)
             self._compiled[key] = fn
@@ -271,8 +399,25 @@ class JaxTPU:
             return np.concatenate([
                 self._run_device(flat[i:i + top])
                 for i in range(0, len(flat), top)])
+        status = self._run_pass(flat, self.budget, 0)
+        # two-phase rescue: re-run undecided lanes in small batches with a
+        # large memo cache and budget (decides the hard tail on device;
+        # anything still BUDGET after this goes to the CPU oracle as usual)
+        todo = [i for i, s in enumerate(status) if s == BUDGET]
+        if todo and self.rescue_budget > 0 and self.rescue_slots > 0:
+            for lo in range(0, len(todo), self.RESCUE_BATCH):
+                idx = todo[lo:lo + self.RESCUE_BATCH]
+                sub = self._run_pass([flat[i] for i in idx],
+                                     self.rescue_budget, self.rescue_slots)
+                status[idx] = sub
+                self.rescued += int((sub != BUDGET).sum())
+        return status
+
+    def _run_pass(self, flat: Sequence[History], budget: int,
+                  want_slots: int) -> np.ndarray:
         n_ops = bucket_for(max(len(h) for h in flat) or 1)
         batch = _batch_bucket(len(flat))
+        slots = self._safe_slots(batch, want_slots)
         enc = encode_batch(flat, self.spec.initial_state(), max_ops=n_ops)
         b = len(flat)
         cmd = np.zeros((batch, n_ops), np.int32)
@@ -292,10 +437,10 @@ class JaxTPU:
             args = tuple(
                 jax.device_put(a, s) for a, s in
                 zip(args, self._arg_shardings()))
-        status, _iters = self._kernel(n_ops, batch)(*args)
+        status, _iters = self._kernel(n_ops, batch, slots, budget)(*args)
         self.batches_run += 1
         self.device_histories += b
-        return np.asarray(status)[:b]
+        return np.asarray(status)[:b].copy()
 
     def _arg_shardings(self):
         """Batch-axis sharding for each kernel argument (replicated init)."""

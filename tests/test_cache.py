@@ -1,0 +1,68 @@
+"""In-kernel memoisation cache (Lowe-style): verdicts unchanged, iteration
+counts collapse on violating histories; hash regression for the high-bit
+collision bug (FNV-1a over words degenerates — murmur-style mixer required)."""
+
+import jax
+import numpy as np
+
+from qsm_tpu import generate_program, run_concurrent
+from qsm_tpu.core.history import bucket_for, encode_batch
+from qsm_tpu.models import AtomicCasSUT, CasSpec, RacyCasSUT
+from qsm_tpu.ops.jax_kernel import build_kernel
+from qsm_tpu.utils.corpus import build_corpus
+
+SPEC = CasSpec()
+
+
+def _hard_violating_history():
+    """bench-corpus history #35: WingGongCPU(memo) needs ~7k nodes, the
+    cache-less kernel millions of iterations."""
+    corpus = build_corpus(SPEC, (AtomicCasSUT, RacyCasSUT), n=36, n_pids=8,
+                          max_ops=32, seed_base=1000, seed_prefix="bench")
+    return corpus[35]
+
+
+def _run(h, budget, slots):
+    n = bucket_for(len(h))
+    enc = encode_batch([h], SPEC.initial_state(), max_ops=n)
+    single = build_kernel(SPEC, n, budget=budget, cache_slots=slots)
+    fn = jax.jit(jax.vmap(single, in_axes=(0, 0, 0, 0, 0, None)))
+    s, it = fn(enc.ops[:, :, 1], enc.ops[:, :, 2], enc.ops[:, :, 3],
+               enc.valid, enc.precedes(), enc.init_state)
+    return int(s[0]), int(it[0])
+
+
+def test_cache_collapses_iterations_same_verdict():
+    h = _hard_violating_history()
+    s_cache, it_cache = _run(h, budget=500_000, slots=4096)
+    assert s_cache == 2  # FAILURE (= violation), decided
+    assert it_cache < 50_000, it_cache
+    # without the cache the same budget is exhausted undecided
+    s_plain, it_plain = _run(h, budget=500_000, slots=0)
+    assert s_plain == 3 and it_plain == 500_000  # BUDGET
+    assert it_cache * 10 < it_plain
+
+
+def test_cache_verdicts_match_plain_on_easy_corpus():
+    corpus = build_corpus(SPEC, (AtomicCasSUT, RacyCasSUT), n=24, n_pids=4,
+                          max_ops=12, seed_base=7, seed_prefix="cc")
+    for h in corpus:
+        s_cache, _ = _run(h, budget=200_000, slots=1024)
+        s_plain, _ = _run(h, budget=200_000, slots=0)
+        assert s_cache == s_plain
+
+
+def test_hash_spreads_high_bit_keys():
+    """Regression: keys differing only in high taken-bits must not collide.
+    FNV-1a over 32-bit words mapped ALL of these to one slot (its small
+    multiplier never propagates high bits into the low slot-index bits).
+    Exercises the kernel's OWN hash (make_hash_slot), not a copy."""
+    import jax.numpy as jnp
+
+    from qsm_tpu.ops.jax_kernel import make_hash_slot
+
+    hash_slot = make_hash_slot(key_words=2, cache_slots=4096)
+    keys = [(0x01FFFFFF, 0), (0x00FFFFFF, 0), (0x01FBFFFF, 0),
+            (0x00FBFFFF, 0), (0x017BFFFF, 0), (0x007BFFFF, 0)]
+    out = {int(hash_slot(jnp.asarray(k, jnp.uint32))) for k in keys}
+    assert len(out) == len(keys), out
