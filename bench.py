@@ -1,52 +1,72 @@
 """Headline benchmark — histories/sec linearized at 32 ops × 8 pids.
 
-Measures the batched ``JaxTPU`` Wing–Gong kernel against the ``WingGongCPU``
-oracle (the reference's checker reimplemented faithfully — the denominator
-defined in BASELINE.md; the Haskell original published no numbers).
+Measures the batched ``JaxTPU`` Wing–Gong kernel against two host checkers:
+
+* ``WingGongCPU`` (memo-less) — the reference's checker reimplemented
+  faithfully, the baseline denominator defined in BASELINE.md (the Haskell
+  original published no numbers);
+* ``WingGongCPU(memo=True)`` — OUR best host checker (Lowe-style cache).
+  ``vs_best_cpu`` is the honest headline: the device must beat this one,
+  not just the naive oracle (VERDICT.md round 1, "What's weak" #2).
 
 Prints ONE JSON line:
-    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
-``value`` is device throughput (histories/sec); ``vs_baseline`` is the
-speedup over the CPU oracle on the same corpus (target ≥100×, BASELINE.json).
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...,
+     "vs_best_cpu": ..., "extras": {...}}
+
+Robustness contract (VERDICT.md round 1, "Next round" #1): this script must
+never hang and never die with a raw traceback.  The real chip is probed from
+a subprocess with a bounded timeout; if the probe fails (wedged tunnel), the
+same kernel is measured on the JAX CPU platform at reduced scale and the JSON
+line says so honestly (``extras.device_fallback``).  Unexpected errors emit a
+diagnostic JSON line with ``"error"`` and exit 1.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import sys
 import time
 
 import numpy as np
 
 N_PIDS = 8
 N_OPS = 32
-N_UNIQUE = 512          # distinct scheduler-produced histories
-DEVICE_BATCH = 4096     # corpus tiled up to one full device batch
-CPU_SAMPLE = 64         # oracle timed on a subset (it is ~1000x slower)
-CPU_TIMEBOX_S = 90.0    # cap the oracle measurement wall-clock
-REPS = 3
 
 
-def build_corpus(spec):
+def _scale(on_tpu: bool) -> dict:
+    """Benchmark scale: full on the real chip, reduced on the CPU fallback
+    (the lockstep vmapped while-loop is orders of magnitude slower on host —
+    an unreduced run would take hours, which is its own kind of hang)."""
+    if on_tpu:
+        return dict(n_unique=512, device_batch=4096, cpu_sample=64,
+                    cpu_timebox_s=90.0, reps=3, budget=2_000)
+    return dict(n_unique=128, device_batch=256, cpu_sample=24,
+                cpu_timebox_s=45.0, reps=1, budget=2_000)
+
+
+def build_corpus(spec, n_unique: int):
     from qsm_tpu.models import AtomicCasSUT, RacyCasSUT
     from qsm_tpu.utils.corpus import build_corpus as shared
 
-    return shared(spec, (AtomicCasSUT, RacyCasSUT), n=N_UNIQUE,
+    return shared(spec, (AtomicCasSUT, RacyCasSUT), n=n_unique,
                   n_pids=N_PIDS, max_ops=N_OPS, seed_base=1000,
                   seed_prefix="bench")
 
 
-def main():
+def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None):
     from qsm_tpu.models import CasSpec
     from qsm_tpu.ops.jax_kernel import JaxTPU
     from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
 
+    sc = _scale(on_tpu)
     spec = CasSpec()
     t0 = time.perf_counter()
-    corpus = build_corpus(spec)
+    corpus = build_corpus(spec, sc["n_unique"])
     gen_s = time.perf_counter() - t0
 
-    reps = (DEVICE_BATCH + N_UNIQUE - 1) // N_UNIQUE
-    device_corpus = (corpus * reps)[:DEVICE_BATCH]
+    reps = (sc["device_batch"] + len(corpus) - 1) // len(corpus)
+    device_corpus = (corpus * reps)[:sc["device_batch"]]
 
     # --- CPU oracle (baseline denominator), time-boxed -------------------
     # One history at a time so a single pathological interleaving search
@@ -54,61 +74,125 @@ def main():
     # one at a time too (SURVEY.md §3.5), so per-history timing is faithful.
     oracle = WingGongCPU(node_budget=20_000_000)
     cpu_verdicts = []
+    cpu_times = []
     t0 = time.perf_counter()
-    for h in corpus[:CPU_SAMPLE]:
+    for h in corpus[:sc["cpu_sample"]]:
+        t1 = time.perf_counter()
         cpu_verdicts.append(oracle.check_histories(spec, [h])[0])
-        if time.perf_counter() - t0 > CPU_TIMEBOX_S:
+        cpu_times.append(time.perf_counter() - t1)
+        if time.perf_counter() - t0 > sc["cpu_timebox_s"]:
             break
     cpu_s = time.perf_counter() - t0
     cpu_verdicts = np.asarray(cpu_verdicts)
     cpu_rate = len(cpu_verdicts) / cpu_s
+
+    # --- memoised CPU oracle (our best host checker) ---------------------
+    memo = WingGongCPU(memo=True)
+    t0 = time.perf_counter()
+    memo_verdicts = memo.check_histories(spec, corpus)
+    memo_rate = len(corpus) / (time.perf_counter() - t0)
 
     # --- device kernel ---------------------------------------------------
     # Bounded per-history iteration budget keeps batch latency flat; the
     # rare blowups report BUDGET_EXCEEDED and are excluded from the decided
     # count (the property layer resolves them via the oracle — SURVEY.md §7
     # hard-parts #5), so the headline rate only counts decided verdicts.
-    backend = JaxTPU(spec, budget=200_000)
+    backend = JaxTPU(spec, budget=sc["budget"])
     backend.check_histories(spec, device_corpus)  # warmup: compile + run
+    if profile_dir:
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
-    for _ in range(REPS):
+    for _ in range(sc["reps"]):
         dev_verdicts = backend.check_histories(spec, device_corpus)
     dev_s = time.perf_counter() - t0
-    budget = int(np.sum(dev_verdicts == 2))  # Verdict.BUDGET_EXCEEDED
-    dev_rate = REPS * (len(device_corpus) - budget) / dev_s
+    if profile_dir:
+        import jax
 
-    # --- memoised CPU oracle (our improved checker, for honesty) ---------
-    memo = WingGongCPU(memo=True)
-    t0 = time.perf_counter()
-    memo.check_histories(spec, corpus)
-    memo_rate = len(corpus) / (time.perf_counter() - t0)
+        jax.profiler.stop_trace()
+    budget_exceeded = int(np.sum(dev_verdicts == 2))
+    dev_rate = sc["reps"] * (len(device_corpus) - budget_exceeded) / dev_s
 
-    # --- parity on the timed sample (trust, but verify) ------------------
-    # Only count *wrong verdicts*: positions where both sides decided and
-    # disagree.  BUDGET_EXCEEDED on either side is honest indecision.
-    both = min(len(cpu_verdicts), len(dev_verdicts))
-    c, d = cpu_verdicts[:both], dev_verdicts[:both]
-    decided = (c != 2) & (d != 2)
-    mismatches = int(np.sum(c[decided] != d[decided]))
+    # --- parity (trust, but verify) --------------------------------------
+    # Device vs BOTH host checkers.  Only count *wrong verdicts*: positions
+    # where both sides decided and disagree; BUDGET_EXCEEDED on either side
+    # is honest indecision, not a wrong answer.
+    def wrong(host, dev):
+        both = min(len(host), len(dev))
+        hh, dd = np.asarray(host)[:both], np.asarray(dev)[:both]
+        bad = (hh != 2) & (dd != 2) & (hh != dd)
+        return set(np.nonzero(bad)[0].tolist())
+
+    # union, not sum: a device verdict disagreeing with both host checkers
+    # is ONE wrong verdict
+    mismatches = len(wrong(cpu_verdicts, dev_verdicts)
+                     | wrong(memo_verdicts, dev_verdicts))
 
     import jax
-    print(json.dumps({
+    return {
         "metric": f"histories_per_sec_linearized_{N_OPS}ops_x_{N_PIDS}pids",
         "value": round(dev_rate, 1),
         "unit": "histories/sec",
         "vs_baseline": round(dev_rate / cpu_rate, 2),
+        "vs_best_cpu": round(dev_rate / memo_rate, 2),
         "extras": {
             "cpu_oracle_rate": round(cpu_rate, 3),
+            "cpu_oracle_median_s": round(float(np.median(cpu_times)), 4),
             "cpu_memo_oracle_rate": round(memo_rate, 1),
             "cpu_sample": len(cpu_verdicts),
+            "corpus_unique": len(corpus),
             "device": str(jax.devices()[0]),
-            "device_batch": DEVICE_BATCH,
-            "budget_exceeded": budget,
+            "device_fallback": None if on_tpu else "cpu",
+            "tpu_probe": probe_detail,
+            "device_batch": sc["device_batch"],
+            "device_budget": sc["budget"],
+            "budget_exceeded": budget_exceeded,
+            "rescued": backend.rescued,
             "wrong_verdicts_on_sample": mismatches,
             "corpus_gen_sec": round(gen_s, 1),
         },
-    }))
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--probe-timeout", type=float, default=60.0,
+                    help="seconds to wait for the TPU backend probe")
+    ap.add_argument("--force-cpu", action="store_true",
+                    help="skip the probe and bench on the CPU platform")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of the timed device "
+                         "passes into DIR")
+    args = ap.parse_args(argv)
+
+    from qsm_tpu.utils.device import force_cpu_platform, probe_default_backend
+
+    if args.force_cpu:
+        probe_detail = "skipped (--force-cpu)"
+        on_tpu = False
+    else:
+        probe = probe_default_backend(args.probe_timeout)
+        probe_detail = probe.detail
+        on_tpu = probe.is_device
+    if not on_tpu:
+        force_cpu_platform()
+
+    try:
+        result = run_bench(on_tpu, probe_detail, args.profile)
+    except Exception as e:  # noqa: BLE001 — diagnostic JSON, never a bare crash
+        print(json.dumps({
+            "metric": f"histories_per_sec_linearized_{N_OPS}ops_x_{N_PIDS}"
+                      "pids",
+            "value": 0, "unit": "histories/sec", "vs_baseline": 0,
+            "error": f"{type(e).__name__}: {e}",
+            "extras": {"tpu_probe": probe_detail,
+                       "device_fallback": None if on_tpu else "cpu"},
+        }))
+        return 1
+    print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

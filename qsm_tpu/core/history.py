@@ -74,6 +74,14 @@ class History:
         return History([o for o in self.ops if not o.is_pending],
                        seed=self.seed, program_id=self.program_id)
 
+    def fingerprint(self) -> tuple:
+        """Hashable identity of the observable history (one canonical site:
+        distinct-schedule counting, replay bit-identity checks, and tests
+        all compare THIS, so an Op field added later changes them together).
+        """
+        return tuple((o.pid, o.cmd, o.arg, o.resp, o.invoke_time,
+                      o.response_time) for o in self.ops)
+
     def precedes_matrix(self) -> np.ndarray:
         """bool[n, n]: strict real-time precedence (resp_i < inv_j)."""
         n = len(self.ops)

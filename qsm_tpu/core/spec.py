@@ -116,6 +116,25 @@ class Spec:
             out.append(arg // 2)
         return out
 
+    # -- kernel acceleration ----------------------------------------------
+    def scalar_state_bound(self, n_ops: int) -> Optional[int]:
+        """Exclusive upper bound on reachable scalar model states, or None.
+
+        Only meaningful for ``STATE_DIM == 1`` specs.  When a bound ``S`` is
+        declared, every state reachable through an ok step from the initial
+        state must lie in ``[0, S)`` — for histories whose **args** are in
+        the declared command domains but whose **resps** are arbitrary ints
+        (SUTs can return anything; args come from the generator, which
+        respects the domains).  ``JaxTPU`` enforces the arg side host-side
+        and defers out-of-domain histories to the oracle.  The device kernel
+        precomputes a per-history ``[S, n_ops]`` step table ONCE and
+        replaces the per-iteration vmapped ``step_jax`` sweep with a single
+        dynamic row gather (VERDICT.md round 1, "Next round" #2).  ``n_ops``
+        is provided for specs whose state grows with history length (ticket
+        dispenser: bound ``n_ops + 1`` — an ok-TAKE chain gains 1 per op).
+        """
+        return None
+
     # -- decomposition ----------------------------------------------------
     def partition_key(self, cmd: int, arg: int) -> Optional[int]:
         """Key for P-compositionality decomposition, or None if the spec is

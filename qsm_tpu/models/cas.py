@@ -43,6 +43,9 @@ class CasSpec(Spec):
     def cas_arg(self, old: int, new: int) -> int:
         return old * self.n_values + new
 
+    def scalar_state_bound(self, n_ops):
+        return self.n_values  # state is always a stored value
+
     def step_py(self, state, cmd, arg, resp):
         value = state[0]
         if cmd == READ:

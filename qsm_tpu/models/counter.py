@@ -43,6 +43,16 @@ class TicketSpec(Spec):
     def precondition(self, state, cmd, arg) -> bool:
         return cmd != TAKE or state[0] < self.n_tickets
 
+    def scalar_state_bound(self, n_ops):
+        # Every ok TAKE requires resp == state and moves state up by one;
+        # RESET moves it to 0.  A chain of ok steps in an n_ops history can
+        # therefore never push the state past n_ops, REGARDLESS of what
+        # response values the SUT actually produced (a buggy SUT may hand
+        # out tickets beyond n_tickets; the oracle accepts resp == state
+        # with no cap, so the table must cover those states too — bounding
+        # by n_tickets here was unsound).
+        return n_ops + 1
+
     def step_py(self, state, cmd, arg, resp):
         nxt = state[0]
         if cmd == TAKE:

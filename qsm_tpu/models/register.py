@@ -37,6 +37,9 @@ class RegisterSpec(Spec):
     def initial_state(self) -> np.ndarray:
         return np.zeros(1, np.int32)
 
+    def scalar_state_bound(self, n_ops):
+        return self.n_values  # state is always a stored value
+
     def step_py(self, state, cmd, arg, resp):
         value = state[0]
         if cmd == READ:

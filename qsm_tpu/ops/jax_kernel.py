@@ -37,11 +37,13 @@ Two mechanisms tame worst-case blowups:
   device analog of ``WingGongCPU(memo=True)``, collapsing violating
   histories from millions of iterations to ~the number of distinct
   configurations (see ``build_kernel``);
-* an **iteration budget** with a two-phase rescue: the main batch runs with
-  a bounded budget (flat latency); undecided lanes are re-run in small
-  batches with a large cache and budget.  Anything still undecided reports
-  BUDGET_EXCEEDED honestly and the property layer resolves it via the CPU
-  oracle, keeping CPU/TPU verdicts bit-identical (hard-parts #5).
+* an **iteration budget** with a rescue ladder: the main batch runs
+  cache-less at a LOW budget (most lanes decide in tens of iterations;
+  lockstep vmap means a high budget just makes everyone wait on the worst
+  lane); undecided lanes are re-run in progressively smaller batches with
+  progressively larger caches and budgets.  Anything still undecided
+  reports BUDGET_EXCEEDED honestly and the property layer resolves it via
+  the CPU oracle, keeping CPU/TPU verdicts bit-identical (hard-parts #5).
 
 Pending (crash/fault) ops are expanded host-side into complete histories —
 every prune/complete×response combination (SURVEY.md §3.2 complete/prune) —
@@ -106,7 +108,7 @@ def make_hash_slot(key_words: int, cache_slots: int):
 
 
 def build_kernel(spec: Spec, n_ops: int, budget: int,
-                 cache_slots: int = 0):
+                 cache_slots: int = 0, cache_write: str = "onehot"):
     """Build the single-history while-loop checker for one (spec, N) shape.
 
     Returned function signature (all jnp arrays):
@@ -134,11 +136,28 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
     iota = jnp.arange(n_ops, dtype=jnp.int32)
     iota1 = jnp.arange(n_ops + 1, dtype=jnp.int32)
 
+    # Scalar-state specs declare a bound on reachable states; the kernel
+    # then tabulates step(s, op_j) for every (state, op) pair ONCE per
+    # history (outside the while loop) and the loop body replaces the
+    # vmapped step_jax sweep over all ops with a single dynamic row gather
+    # — the dominant per-iteration cost in the v1 kernel (VERDICT.md round
+    # 1, "Next round" #2).  Sound because ok-children of tabulated steps
+    # are exactly the states the DFS can reach (the bound's contract).
+    state_bound = (spec.scalar_state_bound(n_ops)
+                   if spec.STATE_DIM == 1 else None)
+
     n_words = (n_ops + 31) // 32  # taken-bitmask words
     key_words = n_words + spec.STATE_DIM
     use_cache = cache_slots > 0
-    assert cache_slots == 0 or (cache_slots & (cache_slots - 1)) == 0, \
-        "cache_slots must be a power of two"
+    # public-parameter validation: a non-power-of-two silently biases the
+    # `h & (slots-1)` masking (dead slots), so refuse loudly — and not via
+    # assert, which `python -O` strips (ADVICE.md round 1)
+    if cache_slots < 0 or (cache_slots & (cache_slots - 1)) != 0:
+        raise ValueError(
+            f"cache_slots must be 0 or a power of two, got {cache_slots}")
+    if cache_write not in ("onehot", "dus"):
+        raise ValueError(
+            f"cache_write must be 'onehot' or 'dus', got {cache_write!r}")
     shift = jnp.arange(32, dtype=jnp.uint32)
 
     def pack_key(taken, state):
@@ -162,6 +181,18 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
     def check_one(cmd, arg, resp, valid, precedes, init_state):
         n_req = jnp.sum(valid.astype(jnp.int32))
 
+        if state_bound is not None:
+            # per-history step table: [state_bound, n_ops] next-state / ok
+            def _tab_row(s):
+                st = jnp.full((1,), s, jnp.int32)
+                nxt_s, ok_s = jax.vmap(
+                    lambda cc, aa, rr: spec.step_jax(st, cc, aa, rr),
+                    out_axes=(0, 0))(cmd, arg, resp)
+                return nxt_s.reshape(n_ops), ok_s.reshape(n_ops)
+
+            nxt_tab, ok_tab = jax.vmap(_tab_row)(
+                jnp.arange(state_bound, dtype=jnp.int32))
+
         def cond(c):
             return c["status"] == RUNNING
 
@@ -172,11 +203,17 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
             untaken = valid & ~taken
             # minimality: op j is blocked if some untaken op precedes it
             blocked = jnp.any(untaken[:, None] & precedes, axis=0)
-            # vectorised transition+postcondition from the current state
-            nxt, ok = jax.vmap(
-                lambda cc, aa, rr: spec.step_jax(state, cc, aa, rr),
-                out_axes=(0, 0))(cmd, arg, resp)
-            ok, nxt = ok.reshape(n_ops), nxt.reshape(n_ops, -1)
+            if state_bound is not None:
+                # one dynamic row gather instead of n_ops step evaluations
+                s0 = jnp.clip(state[0], 0, state_bound - 1)
+                nxt = nxt_tab[s0][:, None]
+                ok = ok_tab[s0]
+            else:
+                # vectorised transition+postcondition from the current state
+                nxt, ok = jax.vmap(
+                    lambda cc, aa, rr: spec.step_jax(state, cc, aa, rr),
+                    out_axes=(0, 0))(cmd, arg, resp)
+                ok, nxt = ok.reshape(n_ops), nxt.reshape(n_ops, -1)
             cand = untaken & ~blocked & ok & (iota > chosen[d])
             has = jnp.any(cand)
             j = jnp.argmax(cand).astype(jnp.int32)
@@ -231,15 +268,36 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
             if use_cache:
                 # exhausted (no candidates left): this configuration is
                 # proven non-linearizable-from — insert before backtracking.
-                # One-hot masked write, NOT a scatter: vmapped scatters with
-                # batched indices crash/corrupt on this stack (see module
-                # NOTE above); the masked select fuses cleanly on TPU.
                 key_cur = pack_key(taken, state)
                 slot_cur = hash_slot(key_cur)
-                row_mask = (jnp.arange(cache_slots) == slot_cur) & ~has
-                out["keys"] = jnp.where(row_mask[:, None],
-                                        key_cur[None, :], c["keys"])
-                out["occ"] = jnp.where(row_mask, 1, c["occ"])
+                if cache_write == "dus":
+                    # O(key_words) read-modify-write via dynamic_update_slice
+                    # — the conditional insert is expressed by writing the
+                    # existing row back when no insert happens, so no scatter
+                    # and no O(slots) one-hot sweep per iteration.  Verdicts
+                    # identical to onehot (tests/test_cache.py) but measured
+                    # NO faster on the XLA CPU backend (the vmapped update
+                    # becomes a full copy) and UNVERIFIED on the axon TPU
+                    # stack, so it is opt-in, not the default.
+                    cur_row = jax.lax.dynamic_slice(
+                        c["keys"], (slot_cur, jnp.int32(0)), (1, key_words))
+                    new_row = jnp.where(has, cur_row, key_cur[None, :])
+                    out["keys"] = jax.lax.dynamic_update_slice(
+                        c["keys"], new_row, (slot_cur, jnp.int32(0)))
+                    cur_occ = jax.lax.dynamic_slice(
+                        c["occ"], (slot_cur,), (1,))
+                    new_occ = jnp.where(has, cur_occ, 1)
+                    out["occ"] = jax.lax.dynamic_update_slice(
+                        c["occ"], new_occ, (slot_cur,))
+                else:
+                    # O(slots) one-hot masked write — the DEFAULT: it is the
+                    # form the round-1 safe-region points were verified with
+                    # on the real chip (masked selects are the most
+                    # conservative lowering; no scatter — module NOTE above)
+                    row_mask = (jnp.arange(cache_slots) == slot_cur) & ~has
+                    out["keys"] = jnp.where(row_mask[:, None],
+                                            key_cur[None, :], c["keys"])
+                    out["occ"] = jnp.where(row_mask, 1, c["occ"])
             return out
 
         init = {
@@ -272,41 +330,68 @@ class JaxTPU:
 
     name = "jax_tpu"
 
-    # empirical safe region for (batch x cache_slots) on the axon TPU
-    # stack: 256x1024 lane-slots crashes the worker, 256x512 and 64x4096
-    # are fine; large batches with even tiny caches are pathologically slow
-    # (the per-iteration cache rewrite stops being in-place).  So: the MAIN
-    # pass always runs cache-less, and the memo cache lives only in the
-    # small-batch rescue pass, capped to the verified-safe product.
-    MAX_LANE_SLOTS = 1 << 17
+    # Empirical safe region for (batch x cache_slots) on the axon TPU
+    # stack — NOT a pure lane-slot product (ADVICE.md round 1): 64x4096 and
+    # 256x512 are verified fine, yet 256x1024 (same product as 64x4096)
+    # crashes the worker.  Model it as a per-batch-bucket slot cap: the two
+    # verified points stand as-is; unverified buckets are capped so that
+    # batch*slots <= 1<<17, the largest product seen safe at batch >= 256.
+    # Large batches with even tiny caches are also pathologically slow (the
+    # per-iteration cache rewrite stops being in-place), so the MAIN pass
+    # always runs cache-less and the memo cache lives only in the
+    # small-batch rescue pass.  The cap actually applied is exposed via
+    # ``effective_rescue_slots``.
+    MAX_SLOTS_FOR_BATCH = {8: 8192, 64: 4096, 256: 512, 1024: 128, 4096: 32}
     # 16 would pad to the 64 batch bucket anyway; run full 64-lane rescues
     RESCUE_BATCH = 64
 
-    def __init__(self, spec: Spec, budget: int = 200_000,
+    def __init__(self, spec: Spec, budget: int = 2_000,
                  max_expansions: int = 128,
                  sharding=None,
                  rescue_budget: int = 500_000,
-                 rescue_slots: int = 8192):
+                 rescue_slots: int = 4096,
+                 mid_budget: int = 50_000,
+                 mid_slots: int = 512,
+                 cache_write: str = "onehot"):
         self.spec = spec
         self.budget = budget
         self.max_expansions = max_expansions
         self.sharding = sharding  # optional NamedSharding for the batch axis
-        # lanes still undecided after the cache-less main pass are re-run
-        # in small batches with a large memo cache — the two-phase rescue
-        # that keeps batch latency flat AND decides the hard tail on device
-        # instead of deferring it to the CPU oracle
+        # Rescue LADDER (measured iteration distribution, CAS 32x8 corpus:
+        # p50 = 57 iters, p90 = 35k cache-less but ~1k with a 512-slot
+        # cache, p99 ~ 8k): the cache-less main pass runs at a LOW budget —
+        # most lanes decide almost immediately and a high budget only makes
+        # the whole lockstep batch wait on its worst lane.  Survivors climb
+        # the ladder: medium batches with a small cache, then small batches
+        # with a big cache.  Anything still undecided reports
+        # BUDGET_EXCEEDED honestly (the property layer resolves via the
+        # oracle).  Slot counts per stage stay inside the verified-safe
+        # region (MAX_SLOTS_FOR_BATCH).
         self.rescue_budget = rescue_budget
         self.rescue_slots = rescue_slots
+        self.mid_budget = mid_budget
+        self.mid_slots = mid_slots
+        self.cache_write = cache_write
         self._compiled: Dict[Tuple[int, int, int, int], object] = {}
+        # Step-table specs guarantee their state bound only for histories
+        # whose ARGS are in the declared command domains (resps may be
+        # arbitrary — SUTs can return anything; args come from the
+        # generator).  Out-of-domain histories are deferred to the oracle
+        # (BUDGET_EXCEEDED) instead of risking a table/oracle divergence.
+        self._uses_table = (spec.STATE_DIM == 1
+                            and spec.scalar_state_bound(1) is not None)
+        self.deferred_out_of_domain = 0
         self.batches_run = 0
         self.device_histories = 0
         self.rescued = 0
+        self.effective_rescue_slots: Optional[int] = None  # last cap applied
 
     # -- compilation cache -------------------------------------------------
     def _safe_slots(self, batch: int, want: int) -> int:
-        slots = want
-        while slots > 0 and batch * slots > self.MAX_LANE_SLOTS:
-            slots //= 2
+        cap = self.MAX_SLOTS_FOR_BATCH.get(batch, 32)
+        slots = min(want, cap)
+        if want > 0:
+            self.effective_rescue_slots = slots
         return slots
 
     def _kernel(self, n_ops: int, batch: int, slots: int, budget: int):
@@ -316,11 +401,17 @@ class JaxTPU:
         fn = self._compiled.get(key)
         if fn is None:
             single = build_kernel(self.spec, n_ops, budget,
-                                  cache_slots=slots)
+                                  cache_slots=slots,
+                                  cache_write=self.cache_write)
             batched = jax.vmap(single, in_axes=(0, 0, 0, 0, 0, None))
             fn = jax.jit(batched)
             self._compiled[key] = fn
         return fn
+
+    def _args_in_domain(self, h: History) -> bool:
+        cmds = self.spec.CMDS
+        return all(0 <= o.cmd < len(cmds)
+                   and 0 <= o.arg < cmds[o.cmd].n_args for o in h.ops)
 
     # -- pending-op expansion ---------------------------------------------
     def _expand(self, h: History) -> Optional[List[History]]:
@@ -370,6 +461,11 @@ class JaxTPU:
         flat: List[History] = []
         overflow: List[int] = []
         for idx, h in enumerate(histories):
+            if self._uses_table and not self._args_in_domain(h):
+                self.deferred_out_of_domain += 1
+                overflow.append(idx)
+                groups.append((len(flat), 0))
+                continue
             exp = self._expand(h)
             if exp is None:
                 overflow.append(idx)
@@ -400,15 +496,18 @@ class JaxTPU:
                 self._run_device(flat[i:i + top])
                 for i in range(0, len(flat), top)])
         status = self._run_pass(flat, self.budget, 0)
-        # two-phase rescue: re-run undecided lanes in small batches with a
-        # large memo cache and budget (decides the hard tail on device;
-        # anything still BUDGET after this goes to the CPU oracle as usual)
-        todo = [i for i, s in enumerate(status) if s == BUDGET]
-        if todo and self.rescue_budget > 0 and self.rescue_slots > 0:
-            for lo in range(0, len(todo), self.RESCUE_BATCH):
-                idx = todo[lo:lo + self.RESCUE_BATCH]
-                sub = self._run_pass([flat[i] for i in idx],
-                                     self.rescue_budget, self.rescue_slots)
+        # rescue ladder: undecided lanes climb to smaller batches with
+        # bigger caches and budgets (decides the hard tail on device;
+        # anything still BUDGET at the top goes to the CPU oracle as usual)
+        ladder = ((256, self.mid_slots, self.mid_budget),
+                  (self.RESCUE_BATCH, self.rescue_slots, self.rescue_budget))
+        for stage_batch, slots, budget in ladder:
+            todo = [i for i, s in enumerate(status) if s == BUDGET]
+            if not todo or budget <= 0 or slots <= 0:
+                continue
+            for lo in range(0, len(todo), stage_batch):
+                idx = todo[lo:lo + stage_batch]
+                sub = self._run_pass([flat[i] for i in idx], budget, slots)
                 status[idx] = sub
                 self.rescued += int((sub != BUDGET).sum())
         return status

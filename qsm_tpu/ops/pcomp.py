@@ -61,15 +61,18 @@ class PComp:
 
     def __init__(self, spec: Spec, make_inner=None):
         """``make_inner(projected_spec) -> LineariseBackend``; defaults to
-        the CPU oracle.  A factory (not an instance) because device backends
-        bind to one spec at construction (compile cache per spec)."""
+        the memoised CPU oracle — the framework-wide default resolution
+        oracle (one construction site; the memo-less oracle exists only for
+        parity tests and the bench denominator).  A factory (not an
+        instance) because device backends bind to one spec at construction
+        (compile cache per spec)."""
         from .wing_gong_cpu import WingGongCPU
 
         self.spec = spec
         self.projected = spec.projected_spec()
         self.inner: LineariseBackend = (
             make_inner(self.projected) if make_inner is not None
-            else WingGongCPU())
+            else WingGongCPU(memo=True))
         self.name = f"pcomp({self.inner.name})"
 
     def check_histories(self, spec: Spec, histories: Sequence[History]

@@ -1,0 +1,102 @@
+"""Bounded TPU-availability probing and platform forcing.
+
+The image registers the single-chip axon TPU plugin in every interpreter via
+``sitecustomize``; when the chip tunnel is wedged, the *first* ``jax.devices()``
+call blocks forever — it cannot be interrupted or timed out in-process once
+backend initialization has started.  Everything that wants the real chip must
+therefore probe from a **subprocess with a wall-clock timeout** first, and only
+initialize the in-process backend after the probe succeeds (VERDICT.md round 1:
+"Wedged-backend hangs in the CLI", "bench.py must fail fast").
+
+Two entry points:
+
+* :func:`probe_default_backend` — subprocess probe of whatever backend the
+  default environment provides (the axon TPU plugin, normally), bounded by a
+  timeout.  Never hangs the caller.
+* :func:`force_cpu_platform` — the conftest dance: make THIS process use the
+  CPU platform (optionally with a virtual multi-device mesh) even though the
+  plugin is registered.  A plain ``JAX_PLATFORMS=cpu`` env var is ignored once
+  the plugin registered; ``jax.config.update`` after import wins as long as no
+  backend has initialized yet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import subprocess
+import sys
+from typing import Optional
+
+_PROBE_SNIPPET = (
+    "import jax\n"
+    "ds = jax.devices()\n"
+    "import jax.numpy as jnp\n"
+    "x = int(jnp.arange(8).sum())\n"
+    "assert x == 28, x\n"
+    "print(ds[0].platform, len(ds), ds[0])\n"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Probe:
+    """Result of a bounded backend probe."""
+
+    ok: bool          # backend initialized AND ran a tiny computation
+    platform: str     # e.g. "tpu", "cpu"; "none" if init failed
+    detail: str       # device string on success, diagnostic on failure
+
+    @property
+    def is_device(self) -> bool:
+        """True when a real accelerator (not host CPU) answered."""
+        return self.ok and self.platform not in ("cpu", "none")
+
+
+def probe_default_backend(timeout_s: float = 45.0) -> Probe:
+    """Probe the environment's default JAX backend from a subprocess.
+
+    The subprocess inherits the default platform selection (axon plugin) —
+    explicit CPU overrides a caller may have exported are stripped so the
+    probe answers "is the real chip reachable", not "is anything reachable".
+    Bounded: a wedged tunnel yields ``ok=False`` after ``timeout_s`` seconds
+    instead of hanging forever.
+    """
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        return Probe(False, "none",
+                     f"backend init exceeded {timeout_s:.0f}s "
+                     "(chip tunnel wedged?)")
+    except OSError as e:  # e.g. fork failure
+        return Probe(False, "none", f"probe subprocess failed: {e!r}")
+    if r.returncode != 0:
+        tail = (r.stderr or r.stdout).strip().splitlines()[-5:]
+        return Probe(False, "none", " | ".join(tail)[-400:])
+    parts = r.stdout.split(maxsplit=2)
+    if len(parts) < 3:
+        return Probe(False, "none", f"unexpected probe output {r.stdout!r}")
+    return Probe(True, parts[0], r.stdout.strip())
+
+
+def force_cpu_platform(n_devices: Optional[int] = None) -> None:
+    """Force THIS process onto the JAX CPU platform (before any device use).
+
+    Must run before the first ``jax.devices()`` / first traced computation;
+    afterwards the backend is already bound.  ``n_devices`` materializes a
+    virtual multi-device CPU mesh (sharding tests / dryruns).
+    """
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", "")).strip()
+    if n_devices is not None:
+        flags = (f"{flags} --xla_force_host_platform_device_count="
+                 f"{n_devices}").strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -45,7 +45,6 @@ def schedule_coverage(sut_factory, program: Program, seeds: Iterable,
         sched.run()
         schedules.add(tuple(sched.trace))
         h = rec.history()
-        histories.add(tuple((o.pid, o.cmd, o.arg, o.resp, o.invoke_time,
-                             o.response_time) for o in h.ops))
+        histories.add(h.fingerprint())
     return CoverageStats(seeds=n, distinct_schedules=len(schedules),
                          distinct_histories=len(histories))

@@ -16,23 +16,16 @@ Two environment quirks make this trickier than setting ``JAX_PLATFORMS``:
   TPU availability (bench.py owns the real-chip path).
 """
 
-import os
-import re
-
 N_DEVICES = 8
 
-# Replace any pre-existing (possibly smaller) count rather than respecting it:
-# this file's contract is "at least an 8-device mesh", not "whatever the
-# caller exported".
-_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                os.environ.get("XLA_FLAGS", "")).strip()
-os.environ["XLA_FLAGS"] = (
-    f"{_flags} --xla_force_host_platform_device_count={N_DEVICES}").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+# One construction site for the force-cpu dance (env flags + config update);
+# it replaces any pre-existing (possibly smaller) device count: this file's
+# contract is "at least an 8-device mesh", not "whatever the caller exported".
+from qsm_tpu.utils.device import force_cpu_platform  # noqa: E402
 
-import jax  # noqa: E402  (must follow the env setup above)
+force_cpu_platform(N_DEVICES)
 
-jax.config.update("jax_platforms", "cpu")
+import jax  # noqa: E402  (must follow the platform forcing above)
 
 assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= N_DEVICES, (
     "conftest failed to materialize the 8-device virtual CPU mesh; "

@@ -66,3 +66,26 @@ def test_hash_spreads_high_bit_keys():
             (0x00FBFFFF, 0), (0x017BFFFF, 0), (0x007BFFFF, 0)]
     out = {int(hash_slot(jnp.asarray(k, jnp.uint32))) for k in keys}
     assert len(out) == len(keys), out
+
+
+def test_dus_cache_write_matches_onehot():
+    """The O(1) dynamic_update_slice cache write must produce the SAME
+    verdicts as the conservative one-hot masked write (regression guard for
+    the alternate lowering; the upstream vmapped-boolean-scatter bug this
+    kernel works around does not involve dynamic_update_slice, but trust is
+    earned, not assumed)."""
+    corpus = build_corpus(SPEC, (AtomicCasSUT, RacyCasSUT), n=32, n_pids=8,
+                          max_ops=24, seed_base=77, seed_prefix="dus")
+    n = bucket_for(max(len(h) for h in corpus))
+    enc = encode_batch(corpus, SPEC.initial_state(), max_ops=n)
+    args = (enc.ops[:, :, 1], enc.ops[:, :, 2], enc.ops[:, :, 3],
+            enc.valid, enc.precedes(), enc.init_state)
+    out = {}
+    for mode in ("dus", "onehot"):
+        single = build_kernel(SPEC, n, budget=100_000, cache_slots=512,
+                              cache_write=mode)
+        fn = jax.jit(jax.vmap(single, in_axes=(0, 0, 0, 0, 0, None)))
+        s, it = fn(*args)
+        out[mode] = (np.asarray(s), np.asarray(it))
+    np.testing.assert_array_equal(out["dus"][0], out["onehot"][0])
+    np.testing.assert_array_equal(out["dus"][1], out["onehot"][1])

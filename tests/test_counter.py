@@ -33,3 +33,35 @@ def test_ticket_backend_parity():
         for sut_cls in (AtomicTicketSUT, RacyTicketSUT):
             hists.append(run_concurrent(sut_cls(), prog, seed=f"t{seed}"))
     assert_backend_parity(SPEC, hists, JaxTPU(SPEC))
+
+
+def test_ticket_resp_beyond_domain_parity():
+    """A buggy SUT can hand out tickets beyond n_tickets; the oracle accepts
+    resp == state with no cap, so the kernel's step table must cover those
+    states too (round-2 review: bounding by n_tickets+1 was unsound — the
+    sound bound is n_ops+1).  27 sequential TAKEs with resps 0..26 against
+    n_tickets=25 must be LINEARIZABLE on both backends."""
+    from qsm_tpu.core.history import sequential_history
+
+    h = sequential_history([(0, 0, 0, i) for i in range(27)])
+    cpu = check_one(WingGongCPU(), SPEC, h)
+    assert cpu == Verdict.LINEARIZABLE
+    dev = JaxTPU(SPEC).check_histories(SPEC, [h])[0]
+    assert dev == int(cpu)
+
+
+def test_out_of_domain_args_defer_to_oracle():
+    """Step-table specs defer histories with out-of-domain ARGS to the
+    oracle (BUDGET_EXCEEDED) instead of risking a silent table/oracle
+    divergence (round-2 review)."""
+    from qsm_tpu.core.history import sequential_history
+    from qsm_tpu.models.register import RegisterSpec
+
+    spec = RegisterSpec(n_values=5)
+    # WRITE(7) is outside n_args=5; oracle happily linearizes it
+    h = sequential_history([(0, 1, 7, 0), (0, 0, 0, 7)])
+    assert check_one(WingGongCPU(), spec, h) == Verdict.LINEARIZABLE
+    backend = JaxTPU(spec)
+    assert backend.check_histories(spec, [h])[0] == int(
+        Verdict.BUDGET_EXCEEDED)
+    assert backend.deferred_out_of_domain == 1

@@ -85,7 +85,7 @@ def test_batch_padding_consistency(tpu):
 def test_budget_exceeded_resolved_not_guessed():
     # rescue disabled: an exhausted budget must surface as BUDGET_EXCEEDED,
     # never a guessed verdict
-    tiny = JaxTPU(SPEC, budget=3, rescue_budget=0)
+    tiny = JaxTPU(SPEC, budget=3, rescue_budget=0, mid_budget=0)
     h = sequential_history([(0, WRITE, i % 5, 0) for i in range(10)])
     v = tiny.check_histories(SPEC, [h])[0]
     assert v == Verdict.BUDGET_EXCEEDED
