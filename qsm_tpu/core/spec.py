@@ -142,6 +142,18 @@ class Spec:
         keys are independent (PAPERS.md:5)."""
         return None
 
+    # -- persistence ------------------------------------------------------
+    def spec_kwargs(self) -> dict:
+        """Constructor kwargs that reproduce this spec exactly.
+
+        Persisted in regression files so a failure captured against a
+        non-default spec (e.g. ``KvSpec(n_keys=8)``) replays against the
+        SAME spec instead of silently rebuilding registry defaults
+        (ADVICE.md round 1).  Subclasses with constructor parameters MUST
+        override.
+        """
+        return {}
+
     # -- derived ----------------------------------------------------------
     @property
     def n_cmds(self) -> int:

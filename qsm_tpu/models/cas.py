@@ -46,6 +46,9 @@ class CasSpec(Spec):
     def scalar_state_bound(self, n_ops):
         return self.n_values  # state is always a stored value
 
+    def spec_kwargs(self):
+        return {"n_values": self.n_values}
+
     def step_py(self, state, cmd, arg, resp):
         value = state[0]
         if cmd == READ:

@@ -40,6 +40,9 @@ class TicketSpec(Spec):
     def initial_state(self) -> np.ndarray:
         return np.zeros(1, np.int32)
 
+    def spec_kwargs(self):
+        return {"n_tickets": self.n_tickets}
+
     def precondition(self, state, cmd, arg) -> bool:
         return cmd != TAKE or state[0] < self.n_tickets
 

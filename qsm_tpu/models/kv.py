@@ -44,6 +44,9 @@ class KvSpec(Spec):
     def put_arg(self, key: int, value: int) -> int:
         return key * self.n_values + value
 
+    def spec_kwargs(self):
+        return {"n_keys": self.n_keys, "n_values": self.n_values}
+
     def step_py(self, state, cmd, arg, resp):
         state = list(state)
         if cmd == GET:

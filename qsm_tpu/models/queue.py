@@ -49,6 +49,9 @@ class QueueSpec(Spec):
     def initial_state(self) -> np.ndarray:
         return np.zeros(self.STATE_DIM, np.int32)
 
+    def spec_kwargs(self):
+        return {"capacity": self.capacity, "n_values": self.n_values}
+
     def step_py(self, state, cmd, arg, resp):
         length = state[0]
         slots = list(state[1:])

@@ -57,8 +57,11 @@ MODELS: Dict[str, ModelEntry] = {
 }
 
 
-def make(model: str, impl: str):
-    """(spec, sut) for a registry entry."""
+def make(model: str, impl: str, spec_kwargs: dict = None):
+    """(spec, sut) for a registry entry.
+
+    ``spec_kwargs`` reproduces a non-default spec (regression replay must
+    not silently rebuild registry defaults — ADVICE.md round 1)."""
     entry = MODELS[model]
-    spec = entry.make_spec()
+    spec = entry.make_spec(**(spec_kwargs or {}))
     return spec, entry.impls[impl](spec)

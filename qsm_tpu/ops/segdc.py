@@ -1,0 +1,187 @@
+"""Decrease-and-conquer segmentation — long histories split at quiescent cuts.
+
+After the decrease-and-conquer linearizability-monitoring idea (PAPERS.md:9;
+SURVEY.md §5 long-context row, third mechanism): the exponential cost of the
+interleaving search is in the HISTORY LENGTH, so decompose the history into
+independent, shorter problems wherever the real-time order allows it.
+
+A **quiescent cut** is a position where every earlier operation's response
+precedes every later operation's invocation.  Any linearization must order
+the two sides as blocks (every cross-cut pair is precedence-ordered), so:
+
+    H = S1 · S2 · … · Sk   (cut at quiescent points)
+    H linearizable from s0
+        ⟺  ∃ s1 ∈ endstates(S1, s0): ∃ s2 ∈ endstates(S2, s1): … Sk sat.
+
+Unlike P-compositionality (per-key independence, a SPEC property), cuts are
+a property of each individual HISTORY — concurrency-dense histories may
+have none, in which case the inner backend decides them whole.  The two
+combinators compose: ``PComp`` splits per key, per-key sub-histories are
+sparser, so they cut more often.
+
+Segment checking threads a FRONTIER of model states:
+
+* middle segments (never contain pending ops — a pending op's missing
+  response forbids any later cut) are exhaustively searched per frontier
+  state, memoised on (taken-set, state), collecting the set of reachable
+  end states;
+* the final segment (pending ops allowed) only needs satisfiability, which
+  is exactly the oracle's search started from a frontier state
+  (``WingGongCPU.check_from``).
+
+Exactness: verdicts equal the plain oracle's on every history (the block
+decomposition above is an iff), with BUDGET_EXCEEDED when the node budget
+runs out — never a guess.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core.history import History, Op
+from ..core.spec import Spec
+from .backend import LineariseBackend, Verdict
+from .wing_gong_cpu import WingGongCPU
+
+
+def split_at_quiescent_cuts(history: History) -> List[List[Op]]:
+    """Invoke-ordered segments; a cut sits before op i iff every earlier
+    op's response_time < op i's invoke_time.  Pending ops (sentinel
+    response_time) forbid all later cuts, so they always land in the final
+    segment."""
+    ops = sorted(history.ops, key=lambda o: o.invoke_time)
+    segments: List[List[Op]] = []
+    current: List[Op] = []
+    max_resp = -1
+    for op in ops:
+        if current and max_resp < op.invoke_time:
+            segments.append(current)
+            current = []
+        current.append(op)
+        max_resp = max(max_resp, op.response_time)
+    if current:
+        segments.append(current)
+    return segments
+
+
+class _Budget:
+    __slots__ = ("left",)
+
+    def __init__(self, n: int):
+        self.left = n
+
+
+def _end_states(spec: Spec, ops: List[Op], starts: Set[Tuple[int, ...]],
+                budget: _Budget) -> Optional[Set[Tuple[int, ...]]]:
+    """All model states reachable by SOME complete valid linearization of
+    ``ops`` (no pending ops) from any state in ``starts``; None on budget
+    exhaustion.  Memoised on (taken-mask, state) per start so shared
+    subtrees are walked once."""
+    n = len(ops)
+    prec_pairs: List[List[int]] = [
+        [i for i in range(n) if ops[i].response_time < ops[j].invoke_time]
+        for j in range(n)
+    ]
+    full = (1 << n) - 1
+    out: Set[Tuple[int, ...]] = set()
+    for start in starts:
+        seen: Set[Tuple[int, Tuple[int, ...]]] = set()
+
+        def dfs(taken: int, state: Tuple[int, ...]) -> bool:
+            """Explore; returns False on budget exhaustion."""
+            if taken == full:
+                out.add(state)
+                return True
+            if (taken, state) in seen:
+                return True
+            seen.add((taken, state))
+            for j in range(n):
+                if taken & (1 << j):
+                    continue
+                if any(not taken & (1 << i) for i in prec_pairs[j]):
+                    continue
+                budget.left -= 1
+                if budget.left <= 0:
+                    return False
+                op = ops[j]
+                new_state, ok = spec.step_py(list(state), op.cmd, op.arg,
+                                             op.resp)
+                if not ok:
+                    continue
+                if not dfs(taken | (1 << j),
+                           tuple(int(v) for v in new_state)):
+                    return False
+            return True
+
+        if not dfs(0, start):
+            return None
+    return out
+
+
+class SegDC:
+    """Backend combinator: split each history at quiescent cuts, thread the
+    frontier of reachable model states through the segments; histories with
+    no cuts go to the inner backend whole (the combinator never makes a
+    history harder)."""
+
+    def __init__(self, spec: Spec,
+                 make_inner: Optional[Callable] = None,
+                 node_budget: int = 10_000_000,
+                 oracle: Optional[WingGongCPU] = None):
+        self.spec = spec
+        self.inner: LineariseBackend = (
+            make_inner(spec) if make_inner is not None
+            else WingGongCPU(memo=True))
+        # final-segment satisfiability needs a start-state-parameterised
+        # search, which is the oracle's (device backends start from
+        # spec.initial_state() only)
+        self.oracle = oracle or WingGongCPU(memo=True)
+        self.node_budget = node_budget
+        self.name = f"segdc({self.inner.name})"
+        self.segments_split = 0    # histories that actually cut
+        self.segments_total = 0    # segments across them
+
+    def check_histories(self, spec: Spec, histories: Sequence[History]
+                        ) -> np.ndarray:
+        assert spec is self.spec, "SegDC is bound to one spec"
+        out = np.empty(len(histories), np.int8)
+        whole: List[int] = []   # indices delegated to the inner backend
+        for i, h in enumerate(histories):
+            segs = split_at_quiescent_cuts(h)
+            if len(segs) <= 1:
+                whole.append(i)
+                continue
+            self.segments_split += 1
+            self.segments_total += len(segs)
+            out[i] = int(self._check_segmented(spec, h, segs))
+        if whole:
+            sub = self.inner.check_histories(
+                spec, [histories[i] for i in whole])
+            for i, v in zip(whole, sub):
+                out[i] = v
+        return out
+
+    def _check_segmented(self, spec: Spec, h: History,
+                         segs: List[List[Op]]) -> Verdict:
+        budget = _Budget(self.node_budget)
+        frontier: Set[Tuple[int, ...]] = {
+            tuple(int(v) for v in spec.initial_state())}
+        for seg in segs[:-1]:
+            nxt = _end_states(spec, seg, frontier, budget)
+            if nxt is None:
+                return Verdict.BUDGET_EXCEEDED
+            if not nxt:
+                return Verdict.VIOLATION
+            frontier = nxt
+        last = History(segs[-1], seed=h.seed, program_id=h.program_id)
+        saw_budget = False
+        for state in frontier:
+            v = self.oracle.check_from(spec, last, np.asarray(state))
+            if v == Verdict.LINEARIZABLE:
+                return v
+            if v == Verdict.BUDGET_EXCEEDED:
+                saw_budget = True
+        return (Verdict.BUDGET_EXCEEDED if saw_budget
+                else Verdict.VIOLATION)

@@ -54,7 +54,16 @@ class WingGongCPU:
         return out
 
     # ------------------------------------------------------------------
-    def _check(self, spec: Spec, history: History) -> Verdict:
+    def check_from(self, spec: Spec, history: History,
+                   init_state) -> Verdict:
+        """Linearizability from an explicit model state (used by the
+        decrease-and-conquer segmentation combinator, which threads frontier
+        states through quiescent cuts — ops/segdc.py)."""
+        return self._check(spec, history, init_state=init_state)
+
+    # ------------------------------------------------------------------
+    def _check(self, spec: Spec, history: History,
+               init_state=None) -> Verdict:
         ops = history.ops
         n = len(ops)
         if n == 0:
@@ -66,7 +75,8 @@ class WingGongCPU:
         ]
         pending = [o.is_pending for o in ops]
         n_required = sum(1 for p in pending if not p)
-        init = tuple(int(v) for v in spec.initial_state())
+        init = tuple(int(v) for v in (spec.initial_state()
+                                      if init_state is None else init_state))
 
         taken = [False] * n
         budget = [self.node_budget]

@@ -1,3 +1,4 @@
 """Mesh/sharding for batch-parallel checking at scale (SURVEY.md §2b, §5)."""
 
-from .mesh import batch_sharding, make_mesh, replicated_sharding
+from .mesh import (batch_sharding, init_distributed, make_mesh, make_mesh_2d,
+                   replicated_sharding)

@@ -19,6 +19,44 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Multi-host entry: initialize ``jax.distributed`` when configured.
+
+    The reference scales out via Cloud Haskell actor messaging over TCP
+    (SURVEY.md §5 comm backend); our checker plane scales out via JAX's
+    multi-process runtime instead — each host runs this same program,
+    ``jax.devices()`` then spans ALL hosts, and the batch axis shards over a
+    (host, device) mesh with DCN between hosts and ICI within (the hot loop
+    is collective-free, so DCN only carries the final verdict gather).
+
+    Reads ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` when args are omitted; returns False (no-op) when
+    unset, so single-host runs need no configuration.  NOTE: this image is
+    single-host with one tunnel chip, so the multi-process path cannot be
+    exercised here — the sharding side is validated by
+    ``__graft_entry__.dryrun_multichip``'s 2-D (host, device) virtual mesh,
+    which compiles and runs the identical program a real 2-host deployment
+    would.
+    """
+    import os
+
+    import jax
+
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if not coordinator_address:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes
+                          or os.environ.get("JAX_NUM_PROCESSES", "1")),
+        process_id=int(process_id
+                       or os.environ.get("JAX_PROCESS_ID", "0")))
+    return True
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "batch"):
     """A 1-D device mesh over the first ``n_devices`` devices (all by
     default).  The single axis is the history-batch axis."""
@@ -36,11 +74,34 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "batch"):
     return Mesh(np.asarray(devs), (axis,))
 
 
+def make_mesh_2d(n_hosts: int, per_host: int,
+                 axes: Sequence[str] = ("host", "batch")):
+    """A (host, device) mesh: dim 0 maps hosts (DCN between real hosts),
+    dim 1 the devices within a host (ICI).  Works identically over virtual
+    CPU devices, which is how the dryrun validates the multi-host program
+    shape without a pod."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    need = n_hosts * per_host
+    if len(devs) < need:
+        raise ValueError(f"requested {n_hosts}x{per_host} devices, "
+                         f"have {len(devs)}")
+    return Mesh(np.asarray(devs[:need]).reshape(n_hosts, per_host),
+                tuple(axes))
+
+
 def batch_sharding(mesh, axis: Optional[str] = None):
-    """NamedSharding placing dim 0 (the batch) over the mesh axis."""
+    """NamedSharding placing dim 0 (the batch) over the mesh axis — or over
+    ALL mesh axes for a multi-axis (host, device) mesh: the batch divides
+    into n_hosts x per_host shards, hierarchically."""
     import jax
     from jax.sharding import PartitionSpec as P
 
+    if axis is None and len(mesh.axis_names) > 1:
+        return jax.NamedSharding(mesh, P(tuple(mesh.axis_names)))
     return jax.NamedSharding(mesh, P(axis or mesh.axis_names[0]))
 
 

@@ -72,14 +72,23 @@ class FaultPlan:
     runs stay replayable from the seed.
     """
 
-    DELIVER, DROP, DUPLICATE = "deliver", "drop", "duplicate"
+    DELIVER, DROP, DUPLICATE, DELAY = "deliver", "drop", "duplicate", "delay"
 
     def __init__(self, p_drop: float = 0.0, p_duplicate: float = 0.0,
+                 p_delay: float = 0.0, delay_steps: int = 3,
                  partitions: Optional[List[set]] = None,
                  crash_at: Optional[Dict[str, int]] = None,
                  protected: Optional[set] = None):
         self.p_drop = p_drop
         self.p_duplicate = p_duplicate
+        # Explicit delay (SURVEY.md §5 drop/DELAY/duplicate/partition): a
+        # delayed message is held out of the deliverable set for the next
+        # ``delay_steps`` delivery choices, forcing interleavings where it
+        # arrives strictly later than everything sent meanwhile — strictly
+        # more than the implicit reordering the pool already allows (which
+        # can never push a message past one sent AFTER its competitors).
+        self.p_delay = p_delay
+        self.delay_steps = delay_steps
         self.partitions = partitions or []
         self.crash_at = dict(crash_at or {})
         # processes whose messages are never dropped (e.g. history plumbing)
@@ -97,6 +106,8 @@ class FaultPlan:
             return self.DROP
         if r < self.p_drop + self.p_duplicate:
             return self.DUPLICATE
+        if r < self.p_drop + self.p_duplicate + self.p_delay:
+            return self.DELAY
         return self.DELIVER
 
 
@@ -106,6 +117,20 @@ class FaultPlan:
 
 class DeadlockError(RuntimeError):
     pass
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """A pooled message plus its fault bookkeeping.
+
+    ``ready_at`` is the delivery-choice count before which a delayed
+    message is ineligible; ``decided`` marks that the fault plan already
+    ruled on it (a delayed message is delivered when its hold expires — it
+    is not re-rolled, which could delay forever)."""
+
+    msg: Message
+    ready_at: int = 0
+    decided: bool = False
 
 
 @dataclasses.dataclass
@@ -129,9 +154,10 @@ class Scheduler:
         self.faults = faults
         self.max_steps = max_steps
         self.procs: Dict[str, _Proc] = {}
-        self.pool: List[Message] = []  # in-flight messages
+        self.pool: List[_InFlight] = []  # in-flight messages
         self.clock = 0  # logical event clock (history timestamps)
         self.trace: List[int] = []  # delivered message uids, in order
+        self.n_delivered = 0  # delivery choices made (delays count too)
         self._uid = 0
         self._steps = 0
 
@@ -179,8 +205,9 @@ class Scheduler:
             p.send_value = None
             if isinstance(eff, Send):
                 self._uid += 1
-                self.pool.append(Message(src=p.name, dst=eff.to,
-                                         payload=eff.payload, uid=self._uid))
+                self.pool.append(_InFlight(Message(
+                    src=p.name, dst=eff.to,
+                    payload=eff.payload, uid=self._uid)))
                 continue  # async send: sender keeps running
             if isinstance(eff, Recv):
                 if p.mailbox:
@@ -195,15 +222,28 @@ class Scheduler:
         # Deliveries count against max_steps too: duplication faults can
         # otherwise spin the pool forever with no process ever runnable.
         self._bump_steps()
-        idx = self.rng.randrange(len(self.pool))
-        msg = self.pool.pop(idx)
+        eligible = [i for i, f in enumerate(self.pool)
+                    if f.ready_at <= self.n_delivered]
+        if not eligible:
+            # every message is held: nothing else can interleave before the
+            # holds expire, so delivering early is history-equivalent —
+            # and avoids wedging the run on a pure bookkeeping state
+            eligible = list(range(len(self.pool)))
+        inf = self.pool.pop(eligible[self.rng.randrange(len(eligible))])
+        msg = inf.msg
         action = (self.faults.decide(msg, self.rng)
-                  if self.faults else FaultPlan.DELIVER)
+                  if self.faults and not inf.decided else FaultPlan.DELIVER)
         if action == FaultPlan.DROP:
+            return
+        if action == FaultPlan.DELAY:
+            inf.decided = True  # one ruling per message: no re-rolls
+            inf.ready_at = self.n_delivered + self.faults.delay_steps
+            self.pool.append(inf)
             return
         if action == FaultPlan.DUPLICATE:
             self._uid += 1
-            self.pool.append(dataclasses.replace(msg, uid=self._uid))
+            self.pool.append(
+                _InFlight(dataclasses.replace(msg, uid=self._uid)))
         dst = self.procs.get(msg.dst)
         if dst is None or dst.done:
             return  # message to dead/unknown process: dropped
@@ -220,7 +260,6 @@ class Scheduler:
         system wedges (clients blocked, nothing in flight) the run simply
         ends — unresponded operations surface as *pending* ops in the
         history, which the lineariser complete/prunes (SURVEY.md §3.2)."""
-        n_delivered = 0
         fired_crashes = set()  # scheduler-local: never mutate the shared plan
         while True:
             runnable = self._runnable()
@@ -230,7 +269,7 @@ class Scheduler:
                 continue
             if self.faults:
                 for name, at in self.faults.crash_at.items():
-                    if n_delivered >= at and name not in fired_crashes:
+                    if self.n_delivered >= at and name not in fired_crashes:
                         self.crash(name)
                         fired_crashes.add(name)
             clients_left = [p for p in self.procs.values()
@@ -240,4 +279,4 @@ class Scheduler:
             if not self.pool:
                 return  # wedged: pending ops recorded by the runner
             self._deliver_one()
-            n_delivered += 1
+            self.n_delivered += 1

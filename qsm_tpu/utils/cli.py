@@ -72,7 +72,32 @@ def _make_backend(name: str, spec):
         from ..ops.pcomp import PComp
 
         return PComp(spec, lambda pspec: JaxTPU(pspec))
+    if name == "segdc":
+        from ..ops.segdc import SegDC
+
+        return SegDC(spec)
+    if name == "segdc-tpu":
+        _ensure_device_reachable()
+        from ..ops.jax_kernel import JaxTPU
+        from ..ops.segdc import SegDC
+
+        return SegDC(spec, lambda s: JaxTPU(s))
     raise SystemExit(f"unknown backend {name!r}")
+
+
+def _add_fault_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--p-drop", type=float, default=0.0)
+    p.add_argument("--p-duplicate", type=float, default=0.0)
+    p.add_argument("--p-delay", type=float, default=0.0)
+    p.add_argument("--delay-steps", type=int, default=3,
+                   help="delivery choices a delayed message is held for")
+
+
+def _faults_from_args(args):
+    if not (args.p_drop or args.p_duplicate or args.p_delay):
+        return None
+    return FaultPlan(p_drop=args.p_drop, p_duplicate=args.p_duplicate,
+                     p_delay=args.p_delay, delay_steps=args.delay_steps)
 
 
 def _add_run_args(p: argparse.ArgumentParser) -> None:
@@ -85,9 +110,9 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--schedules", type=int, default=4,
                    help="seeded schedules per generated program")
     p.add_argument("--backend", default="cpu",
-                   choices=["cpu", "tpu", "pcomp", "pcomp-tpu"])
-    p.add_argument("--p-drop", type=float, default=0.0)
-    p.add_argument("--p-duplicate", type=float, default=0.0)
+                   choices=["cpu", "tpu", "pcomp", "pcomp-tpu", "segdc",
+                            "segdc-tpu"])
+    _add_fault_args(p)
     p.add_argument("--log", default=None, help="JSONL log path")
     p.add_argument("--save-regression", default=None,
                    help="write failing counterexample to this JSON file")
@@ -96,9 +121,7 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
 def cmd_run(args) -> int:
     entry = MODELS[args.model]
     spec, sut = make(args.model, args.impl)
-    faults = None
-    if args.p_drop or args.p_duplicate:
-        faults = FaultPlan(p_drop=args.p_drop, p_duplicate=args.p_duplicate)
+    faults = _faults_from_args(args)
     cfg = PropertyConfig(
         n_trials=args.trials,
         n_pids=args.pids or entry.default_pids,
@@ -136,7 +159,9 @@ def cmd_run(args) -> int:
     fault_flags = ""
     if faults is not None:
         fault_flags = (f" --p-drop {args.p_drop}"
-                       f" --p-duplicate {args.p_duplicate}")
+                       f" --p-duplicate {args.p_duplicate}"
+                       f" --p-delay {args.p_delay}"
+                       f" --delay-steps {args.delay_steps}")
     print(f"replay: python -m qsm_tpu replay --model {args.model} "
           f"--impl {args.impl} --trial-seed '{cx.trial_seed}' "
           f"--pids {cfg.n_pids} --ops {cfg.max_ops} "
@@ -150,9 +175,9 @@ def cmd_run(args) -> int:
 
 def cmd_replay(args) -> int:
     if args.regression:
-        model, impl, seed_key, prog, hist, faults = \
+        model, impl, seed_key, prog, hist, faults, spec_kwargs = \
             load_regression(args.regression)
-        spec, sut = make(model, impl)
+        spec, sut = make(model, impl, spec_kwargs)
         print(f"replaying {model}/{impl} trial seed {seed_key!r}")
         h = run_concurrent(sut, prog, seed=seed_key, faults=faults)
         same = h.fingerprint() == hist.fingerprint()
@@ -164,10 +189,7 @@ def cmd_replay(args) -> int:
                 "--model and --trial-seed")
         spec, sut = make(args.model, args.impl)
         entry = MODELS[args.model]
-        faults = None
-        if args.p_drop or args.p_duplicate:
-            faults = FaultPlan(p_drop=args.p_drop,
-                               p_duplicate=args.p_duplicate)
+        faults = _faults_from_args(args)
         cfg = PropertyConfig(n_trials=args.trials,
                              n_pids=args.pids or entry.default_pids,
                              max_ops=args.ops or entry.default_ops,
@@ -240,14 +262,14 @@ def main(argv=None) -> int:
     p.add_argument("--pids", type=int, default=None)
     p.add_argument("--ops", type=int, default=None)
     p.add_argument("--trials", type=int, default=100)
-    p.add_argument("--p-drop", type=float, default=0.0)
-    p.add_argument("--p-duplicate", type=float, default=0.0)
+    _add_fault_args(p)
     p.set_defaults(fn=cmd_replay)
 
     p = sub.add_parser("bench", help="checker throughput on one model")
     p.add_argument("--model", default="cas", choices=sorted(MODELS))
     p.add_argument("--backend", default="cpu",
-                   choices=["cpu", "tpu", "pcomp", "pcomp-tpu"])
+                   choices=["cpu", "tpu", "pcomp", "pcomp-tpu", "segdc",
+                            "segdc-tpu"])
     p.add_argument("--pids", type=int, default=None)
     p.add_argument("--ops", type=int, default=None)
     p.add_argument("--corpus", type=int, default=256)

@@ -95,6 +95,7 @@ def faults_to_doc(fp) -> Optional[dict]:
     if fp is None:
         return None
     return {"p_drop": fp.p_drop, "p_duplicate": fp.p_duplicate,
+            "p_delay": fp.p_delay, "delay_steps": fp.delay_steps,
             "partitions": [sorted(g) for g in fp.partitions],
             "crash_at": dict(fp.crash_at),
             "protected": sorted(fp.protected)}
@@ -106,6 +107,8 @@ def faults_from_doc(doc: Optional[dict]):
     if doc is None:
         return None
     return FaultPlan(p_drop=doc["p_drop"], p_duplicate=doc["p_duplicate"],
+                     p_delay=doc.get("p_delay", 0.0),
+                     delay_steps=doc.get("delay_steps", 3),
                      partitions=[set(g) for g in doc["partitions"]],
                      crash_at=doc["crash_at"],
                      protected=set(doc["protected"]))
@@ -118,6 +121,7 @@ def save_regression(path: str, model: str, impl: str, spec: Spec,
         "model": model,
         "impl": impl,
         "spec": spec.name,
+        "spec_kwargs": spec.spec_kwargs(),
         "config": {
             **{k: v for k, v in dataclasses.asdict(cfg).items()
                if k != "faults"},
@@ -135,9 +139,12 @@ def save_regression(path: str, model: str, impl: str, spec: Spec,
 
 
 def load_regression(path: str):
-    """(model, impl, trial_seed, program, history, faults) from a
-    regression file; ``faults`` is the FaultPlan the failure was found
-    under (replay must reuse it or the schedule diverges)."""
+    """(model, impl, trial_seed, program, history, faults, spec_kwargs)
+    from a regression file; ``faults`` is the FaultPlan the failure was
+    found under (replay must reuse it or the schedule diverges), and
+    ``spec_kwargs`` rebuilds the exact spec the failure was captured
+    against (missing in pre-round-2 files: empty dict = registry
+    defaults, which is what those files were in fact captured with)."""
     with open(path) as f:
         doc = json.load(f)
     prog = Program(tuple(ProgOp(p, c, a) for p, c, a in doc["program"]["ops"]),
@@ -146,4 +153,5 @@ def load_regression(path: str):
                        response_time=t)
                     for p, c, a, r, i, t in doc["history"]])
     faults = faults_from_doc(doc["config"].get("faults"))
-    return doc["model"], doc["impl"], doc["trial_seed"], prog, hist, faults
+    return (doc["model"], doc["impl"], doc["trial_seed"], prog, hist, faults,
+            doc.get("spec_kwargs", {}))

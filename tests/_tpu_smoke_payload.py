@@ -1,0 +1,56 @@
+"""Real-chip smoke payload — run BY tests/test_tpu_smoke.py in a subprocess.
+
+Runs on whatever backend the default environment provides (the axon TPU
+plugin); tests/conftest.py forces the parent test process onto the CPU
+platform, so chip work must happen out-of-process.  Prints one line
+``TPU_SMOKE_OK {...}`` on success; any assertion/exception makes the
+subprocess exit nonzero and the parent test fail with the captured output.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")  # repo root (subprocess cwd)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+device = jax.devices()[0]
+assert device.platform != "cpu", f"payload ran on {device} — not a chip"
+
+from qsm_tpu.core.history import sequential_history  # noqa: E402
+from qsm_tpu.models import AtomicCasSUT, CasSpec, RacyCasSUT  # noqa: E402
+from qsm_tpu.ops.jax_kernel import JaxTPU  # noqa: E402
+from qsm_tpu.ops.wing_gong_cpu import WingGongCPU  # noqa: E402
+from qsm_tpu.utils.corpus import build_corpus  # noqa: E402
+
+spec = CasSpec()
+backend = JaxTPU(spec)
+oracle = WingGongCPU(memo=True)
+
+# 1. golden pair: one linearizable, one violating (the kernel must
+#    discriminate, not just run)
+golden = [
+    sequential_history([(0, 1, 1, 0), (1, 0, 0, 1)]),  # write 1; read 1: ok
+    sequential_history([(0, 1, 1, 0), (1, 0, 0, 0)]),  # write 1; read 0: bad
+]
+gv = backend.check_histories(spec, golden)
+assert gv.tolist() == [1, 0], f"golden verdicts wrong: {gv.tolist()}"
+
+# 2. one real 256-history batch (8 pids x 32 ops, the bench shape) with
+#    full parity against the host oracle
+corpus = build_corpus(spec, (AtomicCasSUT, RacyCasSUT), n=256, n_pids=8,
+                      max_ops=32, seed_base=4242, seed_prefix="smoke")
+dev = backend.check_histories(spec, corpus)
+cpu = oracle.check_histories(spec, corpus)
+decided = (dev != 2) & (cpu != 2)
+wrong = int(np.sum(dev[decided] != cpu[decided]))
+assert wrong == 0, f"{wrong} verdict mismatches on the smoke corpus"
+
+print("TPU_SMOKE_OK " + json.dumps({
+    "device": str(device),
+    "batch": len(corpus),
+    "undecided_device": int(np.sum(dev == 2)),
+    "rescued": backend.rescued,
+}))

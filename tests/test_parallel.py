@@ -45,3 +45,25 @@ def test_make_mesh_subset():
     mesh = make_mesh(4)
     assert mesh.devices.shape == (4,)
     assert mesh.axis_names == ("batch",)
+
+
+def test_make_mesh_2d_and_hierarchical_batch_sharding():
+    import jax
+
+    from qsm_tpu.parallel import make_mesh_2d
+
+    mesh = make_mesh_2d(2, 4)
+    assert mesh.devices.shape == (2, 4)
+    assert mesh.axis_names == ("host", "batch")
+    sharding = batch_sharding(mesh)  # dim 0 over BOTH axes
+    arr = jax.device_put(np.zeros((64, 12), np.int32), sharding)
+    assert len(arr.sharding.device_set) == 8
+    # each device holds a 64/8 slice of the batch
+    assert arr.addressable_shards[0].data.shape == (8, 12)
+
+
+def test_init_distributed_noop_without_coordinator(monkeypatch):
+    from qsm_tpu.parallel import init_distributed
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert init_distributed() is False  # single-host: no-op by design

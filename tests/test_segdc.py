@@ -1,0 +1,94 @@
+"""Decrease-and-conquer segmentation (PAPERS.md:9; SURVEY.md §5 long-context
+row): quiescent-cut splitting, frontier threading across ambiguous segment
+end states, pending ops in the final segment, and full verdict parity with
+the oracle on the queue-48 bench corpus."""
+
+import numpy as np
+
+from qsm_tpu import Verdict, WingGongCPU, check_one, overlapping_history
+from qsm_tpu.core.history import sequential_history
+from qsm_tpu.models import QueueSpec
+from qsm_tpu.models.queue import AtomicQueueSUT, RacyTwoPhaseQueueSUT
+from qsm_tpu.models.register import (AtomicRegisterSUT,
+                                     RacyCachedRegisterSUT, RegisterSpec)
+from qsm_tpu.ops.segdc import SegDC, split_at_quiescent_cuts
+from qsm_tpu.utils.corpus import build_corpus
+
+RSPEC = RegisterSpec(n_values=5)
+READ, WRITE = 0, 1
+
+
+def test_split_at_quiescent_cuts():
+    # two sequential ops cut; two overlapping ops don't
+    h = sequential_history([(0, WRITE, 3, 0), (1, READ, 0, 3)])
+    assert [len(s) for s in split_at_quiescent_cuts(h)] == [1, 1]
+    h = overlapping_history([(0, WRITE, 3, 0, 0, 5), (1, READ, 0, 3, 1, 2)])
+    assert [len(s) for s in split_at_quiescent_cuts(h)] == [2]
+    # a pending op forbids all later cuts
+    h = overlapping_history([(0, WRITE, 3, -1, 0, 1 << 30),
+                             (1, READ, 0, 3, 5, 6), (1, READ, 0, 3, 8, 9)])
+    assert [len(s) for s in split_at_quiescent_cuts(h)] == [3]
+
+
+def test_frontier_threads_ambiguous_segment_state():
+    """Two concurrent writes (1 and 2) leave an ambiguous end state; a later
+    quiescent read of EITHER value must pass, of a third value must fail —
+    exactly the frontier-set semantics."""
+    seg1 = [(0, WRITE, 1, 0, 0, 10), (1, WRITE, 2, 0, 1, 9)]
+    for read_val, expect in ((1, Verdict.LINEARIZABLE),
+                             (2, Verdict.LINEARIZABLE),
+                             (3, Verdict.VIOLATION)):
+        h = overlapping_history(seg1 + [(0, READ, 0, read_val, 20, 21)])
+        assert len(split_at_quiescent_cuts(h)) == 2
+        backend = SegDC(RSPEC)
+        got = backend.check_histories(RSPEC, [h])[0]
+        assert got == int(expect), (read_val, got)
+        assert backend.segments_split == 1
+        # and the oracle agrees (exactness)
+        assert check_one(WingGongCPU(), RSPEC, h) == expect
+
+
+def test_pending_op_in_final_segment():
+    """A pending write after a cut may or may not have taken effect; reads
+    of both the old and the new value must pass."""
+    base = [(0, WRITE, 1, 0, 0, 1)]
+    pend = [(0, WRITE, 4, -1, 10, 1 << 30)]
+    for read_val, expect in ((1, Verdict.LINEARIZABLE),
+                             (4, Verdict.LINEARIZABLE),
+                             (2, Verdict.VIOLATION)):
+        h = overlapping_history(base + pend + [(1, READ, 0, read_val,
+                                                20, 21)])
+        assert len(split_at_quiescent_cuts(h)) >= 2
+        got = SegDC(RSPEC).check_histories(RSPEC, [h])[0]
+        assert got == int(expect), (read_val, got)
+
+
+def test_queue48_corpus_parity_zero_undecided():
+    """The round-1 verdict's done-criterion: the queue-48 bench corpus is
+    decided with 0 BUDGET_EXCEEDED and verdicts equal to the oracle's."""
+    spec = QueueSpec()
+    corpus = build_corpus(spec, (AtomicQueueSUT, RacyTwoPhaseQueueSUT),
+                          n=64, n_pids=8, max_ops=48, seed_base=1000,
+                          seed_prefix="bench")
+    backend = SegDC(spec)
+    oracle = WingGongCPU(memo=True)
+    got = backend.check_histories(spec, corpus)
+    want = oracle.check_histories(spec, corpus)
+    np.testing.assert_array_equal(got, want)
+    assert int((got == int(Verdict.BUDGET_EXCEEDED)).sum()) == 0
+    assert (got == int(Verdict.VIOLATION)).any()
+    assert (got == int(Verdict.LINEARIZABLE)).any()
+
+
+def test_low_concurrency_register_corpus_parity():
+    """2-pid histories cut often; segmented verdicts must equal the
+    oracle's everywhere, including violations."""
+    corpus = build_corpus(RSPEC, (lambda _s: AtomicRegisterSUT(),
+                                  lambda _s: RacyCachedRegisterSUT()),
+                          n=48, n_pids=2, max_ops=12, seed_base=5,
+                          seed_prefix="segdc")
+    backend = SegDC(RSPEC)
+    got = backend.check_histories(RSPEC, corpus)
+    want = WingGongCPU(memo=True).check_histories(RSPEC, corpus)
+    np.testing.assert_array_equal(got, want)
+    assert backend.segments_split > 0  # splitting actually happened

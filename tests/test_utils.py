@@ -43,9 +43,11 @@ def test_regression_roundtrip(tmp_path):
     res = _failing_result()
     path = str(tmp_path / "reg.json")
     save_regression(path, "register", "racy", SPEC, CFG, res.counterexample)
-    model, impl, seed_key, prog, hist, faults = load_regression(path)
+    model, impl, seed_key, prog, hist, faults, spec_kwargs = \
+        load_regression(path)
     assert (model, impl) == ("register", "racy")
     assert faults is None
+    assert spec_kwargs == SPEC.spec_kwargs() and spec_kwargs  # non-empty
     assert seed_key == res.counterexample.trial_seed
     assert prog == res.counterexample.program
     assert [(o.pid, o.resp) for o in hist.ops] == \
@@ -93,3 +95,16 @@ def test_cli_run_atomic_ok(capsys):
                    "--trials", "20"])
     assert rc == 0
     assert "OK" in capsys.readouterr().out
+
+
+def test_regression_nondefault_spec_replays_same_spec(tmp_path):
+    """A regression captured against a non-default spec must rebuild THAT
+    spec on load, not registry defaults (ADVICE.md round 1)."""
+    spec = RegisterSpec(n_values=9)
+    res = prop_concurrent(spec, RacyCachedRegisterSUT(), CFG)
+    assert not res.ok
+    path = str(tmp_path / "reg9.json")
+    save_regression(path, "register", "racy", spec, CFG, res.counterexample)
+    model, impl, _, _, _, _, spec_kwargs = load_regression(path)
+    spec2, _ = make(model, impl, spec_kwargs)
+    assert spec2.n_values == 9
