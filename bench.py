@@ -24,7 +24,9 @@ diagnostic JSON line with ``"error"`` and exit 1.
 from __future__ import annotations
 
 import argparse
+import datetime
 import json
+import os
 import sys
 import time
 
@@ -32,6 +34,44 @@ import numpy as np
 
 N_PIDS = 8
 N_OPS = 32
+
+# Round-long probe attempts (tools/probe_watcher.py appends one JSON line
+# per bounded probe).  The BENCH artifact must reflect the best probe of the
+# round, not one instant (VERDICT.md round 2, "Next round" #1).
+PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "probe_log.jsonl")
+
+
+def _append_probe_log(probe) -> None:
+    try:
+        with open(PROBE_LOG, "a") as f:
+            f.write(json.dumps({
+                "ts": round(time.time(), 1),
+                "iso": datetime.datetime.now(
+                    datetime.timezone.utc).isoformat(timespec="seconds"),
+                "ok": probe.ok, "is_device": probe.is_device,
+                "platform": probe.platform, "detail": probe.detail[:300],
+                "source": "bench"}) + "\n")
+    except OSError:
+        pass
+
+
+def _probe_attempts_summary() -> dict | None:
+    """Summarize every probe attempt of the round for extras."""
+    try:
+        with open(PROBE_LOG) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+    except (OSError, ValueError):
+        return None
+    if not recs:
+        return None
+    return {
+        "n": len(recs),
+        "device_ok": sum(1 for r in recs if r.get("is_device")),
+        "first_iso": recs[0].get("iso"),
+        "last_iso": recs[-1].get("iso"),
+        "last_detail": recs[-1].get("detail"),
+    }
 
 
 def _scale(on_tpu: bool) -> dict:
@@ -164,6 +204,10 @@ def main(argv=None) -> int:
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the timed device "
                          "passes into DIR")
+    ap.add_argument("--retries", type=int, default=2,
+                    help="extra spaced probe attempts if the first fails")
+    ap.add_argument("--retry-interval", type=float, default=30.0,
+                    help="seconds between probe retries")
     args = ap.parse_args(argv)
 
     from qsm_tpu.utils.device import force_cpu_platform, probe_default_backend
@@ -173,8 +217,21 @@ def main(argv=None) -> int:
         on_tpu = False
     else:
         probe = probe_default_backend(args.probe_timeout)
+        _append_probe_log(probe)
         probe_detail = probe.detail
         on_tpu = probe.is_device
+        if not on_tpu and args.retries > 0:
+            # the tunnel has healed mid-round before; a couple of spaced
+            # re-probes at bench time are cheap relative to forfeiting the
+            # round's only real-chip window
+            for _ in range(args.retries):
+                time.sleep(args.retry_interval)
+                probe = probe_default_backend(args.probe_timeout)
+                _append_probe_log(probe)
+                probe_detail = probe.detail
+                on_tpu = probe.is_device
+                if on_tpu:
+                    break
     if not on_tpu:
         force_cpu_platform()
 
@@ -187,9 +244,11 @@ def main(argv=None) -> int:
             "value": 0, "unit": "histories/sec", "vs_baseline": 0,
             "error": f"{type(e).__name__}: {e}",
             "extras": {"tpu_probe": probe_detail,
-                       "device_fallback": None if on_tpu else "cpu"},
+                       "device_fallback": None if on_tpu else "cpu",
+                       "probe_attempts": _probe_attempts_summary()},
         }))
         return 1
+    result["extras"]["probe_attempts"] = _probe_attempts_summary()
     print(json.dumps(result))
     return 0
 

@@ -204,11 +204,19 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
             # minimality: op j is blocked if some untaken op precedes it
             blocked = jnp.any(untaken[:, None] & precedes, axis=0)
             if state_bound is not None:
-                # one dynamic row gather instead of n_ops step evaluations
+                # one dynamic row gather instead of n_ops step evaluations.
+                # A state outside [0, bound) means the spec's
+                # scalar_state_bound contract is broken (not true of the
+                # current specs, all tested) — the gathered row would be
+                # garbage, so flag it and degrade the lane to
+                # BUDGET_EXCEEDED below: honest oracle deferral instead of
+                # a silently wrong verdict (ADVICE.md round 2).
+                oob = (state[0] < 0) | (state[0] >= state_bound)
                 s0 = jnp.clip(state[0], 0, state_bound - 1)
                 nxt = nxt_tab[s0][:, None]
                 ok = ok_tab[s0]
             else:
+                oob = jnp.bool_(False)
                 # vectorised transition+postcondition from the current state
                 nxt, ok = jax.vmap(
                     lambda cc, aa, rr: spec.step_jax(state, cc, aa, rr),
@@ -256,6 +264,7 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
             iters = c["iters"] + 1
             status = jnp.where((status == RUNNING) & (iters >= budget),
                                BUDGET, status)
+            status = jnp.where(oob, BUDGET, status)
             out = {
                 "d": d_new,
                 "taken": taken_new,

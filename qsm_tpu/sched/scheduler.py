@@ -261,6 +261,19 @@ class Scheduler:
         ends — unresponded operations surface as *pending* ops in the
         history, which the lineariser complete/prunes (SURVEY.md §3.2)."""
         fired_crashes = set()  # scheduler-local: never mutate the shared plan
+        # Per-run state: a Scheduler reused for a second run() must not
+        # inherit the previous run's bookkeeping (ADVICE.md round 2 —
+        # crash_at would fire against a stale delivery count, and a
+        # half-reset is worse than none: stale _steps would turn a long
+        # second run into a phantom DeadlockError, and a wedged first run's
+        # undelivered pool would leak into the second).  Only the RNG
+        # persists — reuse continues the seeded stream, which stays
+        # deterministic for (seed, spawn-sequence) pairs.
+        self.n_delivered = 0
+        self._steps = 0
+        self.clock = 0
+        self.pool.clear()
+        self.trace.clear()
         while True:
             runnable = self._runnable()
             if runnable:

@@ -28,19 +28,36 @@ def _ensure_device_reachable(timeout_s: float = 45.0) -> None:
 
     A wedged chip tunnel blocks the first in-process ``jax.devices()``
     forever (VERDICT.md round 1, "What's weak" #5), so probe from a bounded
-    subprocess first.  Skipped when this process is already pinned to the
-    CPU platform — host backend init cannot hang.
+    subprocess first.  A process pinned to the CPU platform is REFUSED, not
+    waved through (ADVICE.md round 2): a device backend on a cpu-pinned
+    process would run the lockstep kernel pathologically slowly on host
+    while looking like a TPU result.  ``jax.config.jax_platforms`` is the
+    pinning mechanism that actually wins on this image (the env var is
+    ignored once the plugin has registered), but an exported
+    ``JAX_PLATFORMS=cpu`` is refused too — where it IS effective the same
+    silent-host-run hazard applies, and where it isn't the caller's intent
+    was still a CPU run.
     """
     import os
     import sys as _sys
 
-    if (os.environ.get("JAX_PLATFORMS") or "").strip() == "cpu":
-        return
+    _refuse = SystemExit(
+        "this process is pinned to the CPU platform; a device backend here "
+        "would run the lockstep kernel on host CPU.\n"
+        "use --backend cpu/pcomp/segdc, or clear JAX_PLATFORMS")
+
+    def _cpu_first(platforms: str) -> bool:
+        # "cpu,tpu" selects cpu first too — a plain == "cpu" would wave the
+        # comma form through into the same silent-host-run hazard
+        return (platforms or "").strip().split(",")[0].strip() == "cpu"
+
     if "jax" in _sys.modules:
         import jax
 
-        if jax.config.jax_platforms == "cpu":
-            return
+        if _cpu_first(jax.config.jax_platforms or ""):
+            raise _refuse
+    if _cpu_first(os.environ.get("JAX_PLATFORMS", "")):
+        raise _refuse
     from .device import probe_default_backend
 
     timeout_s = float(os.environ.get("QSM_TPU_PROBE_TIMEOUT", timeout_s))

@@ -39,6 +39,14 @@ _PROBE_SNIPPET = (
 )
 
 
+def _strip_host_platform_flag(flags: str) -> str:
+    """Remove only the virtual-CPU-mesh forcing flag from an XLA_FLAGS
+    string; every other (operator chip-tuning) flag must survive, or probe
+    and real init would validate different XLA configurations."""
+    return re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                  flags).strip()
+
+
 @dataclasses.dataclass(frozen=True)
 class Probe:
     """Result of a bounded backend probe."""
@@ -59,11 +67,19 @@ def probe_default_backend(timeout_s: float = 45.0) -> Probe:
     The subprocess inherits the default platform selection (axon plugin) —
     explicit CPU overrides a caller may have exported are stripped so the
     probe answers "is the real chip reachable", not "is anything reachable".
+    Only the host-platform forcing flag is stripped from ``XLA_FLAGS``
+    (ADVICE.md round 2): operator chip-tuning flags must stay, or the probe
+    would validate a different XLA configuration than the in-process
+    backend actually initializes with.
     Bounded: a wedged tunnel yields ``ok=False`` after ``timeout_s`` seconds
     instead of hanging forever.
     """
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    flags = _strip_host_platform_flag(env.get("XLA_FLAGS", ""))
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
     try:
         r = subprocess.run(
             [sys.executable, "-c", _PROBE_SNIPPET],
@@ -90,8 +106,7 @@ def force_cpu_platform(n_devices: Optional[int] = None) -> None:
     afterwards the backend is already bound.  ``n_devices`` materializes a
     virtual multi-device CPU mesh (sharding tests / dryruns).
     """
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   os.environ.get("XLA_FLAGS", "")).strip()
+    flags = _strip_host_platform_flag(os.environ.get("XLA_FLAGS", ""))
     if n_devices is not None:
         flags = (f"{flags} --xla_force_host_platform_device_count="
                  f"{n_devices}").strip()

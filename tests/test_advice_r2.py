@@ -1,0 +1,140 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md).
+
+One test per finding:
+
+* scheduler reuse — ``n_delivered`` is per-run state, not instance-lifetime;
+* probe env — ``probe_default_backend`` strips only the host-platform flag
+  from ``XLA_FLAGS``, keeping operator chip-tuning flags;
+* cpu-pinned refusal — ``_ensure_device_reachable`` refuses device backends
+  in a cpu-pinned process instead of silently running the kernel on host;
+* broken ``scalar_state_bound`` — an out-of-bound model state degrades the
+  lane to BUDGET_EXCEEDED (oracle deferral) instead of a silently wrong
+  verdict from a clamped step-table gather.
+"""
+
+import pytest
+
+from qsm_tpu import Verdict, WingGongCPU, sequential_history
+from qsm_tpu.models.cas import WRITE, CasSpec
+from qsm_tpu.ops.jax_kernel import JaxTPU
+
+
+def test_scheduler_reuse_resets_delivery_clock():
+    """A FaultPlan crash_at counts deliveries; a second run() on a reused
+    Scheduler must start counting from zero again (ADVICE: stale
+    n_delivered made crashes fire immediately on reuse)."""
+    from qsm_tpu.sched.scheduler import Recv, Scheduler, Send
+
+    def ping(n):
+        for _ in range(n):
+            yield Send("echo", "hi")
+            yield Recv()
+
+    def echo():
+        while True:
+            msg = yield Recv()
+            yield Send(msg.src, msg.payload)
+
+    sched = Scheduler(seed=1)
+    sched.spawn("client", ping(3))
+    sched.spawn("echo", echo(), daemon=True)
+    sched.run()
+    first = sched.n_delivered
+    assert first > 0
+    # reuse the SAME scheduler instance for a fresh pair of processes
+    sched.procs.clear()
+    sched.spawn("client", ping(3))
+    sched.spawn("echo", echo(), daemon=True)
+    sched.run()
+    assert sched.n_delivered == first  # counted from 0, not from `first`
+
+
+def test_probe_env_keeps_operator_xla_flags(monkeypatch):
+    """probe_default_backend must pass through operator XLA_FLAGS minus only
+    the host-platform forcing flag (ADVICE: wholesale stripping made the
+    probe validate a different XLA config than the real init uses)."""
+    import subprocess
+
+    from qsm_tpu.utils import device as device_mod
+
+    captured = {}
+
+    def fake_run(cmd, capture_output, text, timeout, env):
+        captured["env"] = env
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(device_mod.subprocess, "run", fake_run)
+    monkeypatch.setenv(
+        "XLA_FLAGS",
+        "--xla_tpu_foo=1 --xla_force_host_platform_device_count=8 "
+        "--xla_tpu_bar=2")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    p = device_mod.probe_default_backend(timeout_s=0.01)
+    assert not p.ok
+    env = captured["env"]
+    assert "JAX_PLATFORMS" not in env
+    assert env["XLA_FLAGS"] == "--xla_tpu_foo=1  --xla_tpu_bar=2"
+
+
+def test_probe_env_drops_empty_xla_flags(monkeypatch):
+    import subprocess
+
+    from qsm_tpu.utils import device as device_mod
+
+    captured = {}
+
+    def fake_run(cmd, capture_output, text, timeout, env):
+        captured["env"] = env
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(device_mod.subprocess, "run", fake_run)
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    device_mod.probe_default_backend(timeout_s=0.01)
+    assert "XLA_FLAGS" not in captured["env"]
+
+
+def test_cli_refuses_device_backend_when_cpu_pinned():
+    """This test process IS cpu-pinned (conftest forces the virtual CPU
+    mesh), so the device-backend guard must refuse, not return silently
+    (ADVICE: a silent return runs the lockstep kernel on host while looking
+    like a TPU result)."""
+    from qsm_tpu.utils.cli import _ensure_device_reachable
+
+    with pytest.raises(SystemExit, match="pinned to the CPU platform"):
+        _ensure_device_reachable()
+
+
+class BrokenBoundCasSpec(CasSpec):
+    """CAS spec whose declared scalar_state_bound is a lie: reachable
+    states go up to n_values-1 but the bound claims 2."""
+
+    def scalar_state_bound(self, n_ops):
+        return 2
+
+
+def test_broken_state_bound_defers_instead_of_wrong_verdict():
+    spec = BrokenBoundCasSpec()
+    # write(3) then read -> 0: under the TRUE spec this is a VIOLATION
+    # (the read must see 3).  With bound=2 the old clamped gather read the
+    # step-table row for state 1 instead of 3 and could answer wrongly;
+    # now the out-of-bound lane must report BUDGET_EXCEEDED.
+    h = sequential_history([
+        (0, WRITE, 3, 0),
+        (0, 0, 0, 0),  # read -> 0 (stale)
+    ])
+    v = JaxTPU(spec).check_histories(spec, [h])
+    assert v[0] == int(Verdict.BUDGET_EXCEEDED)
+    # the honest deferral path resolves it correctly via the oracle
+    assert WingGongCPU().check_histories(spec, [h])[0] == int(
+        Verdict.VIOLATION)
+
+
+def test_correct_state_bound_unaffected():
+    spec = CasSpec()
+    h = sequential_history([
+        (0, WRITE, 3, 0),
+        (0, 0, 0, 3),  # read -> 3
+    ])
+    assert JaxTPU(spec).check_histories(spec, [h])[0] == int(
+        Verdict.LINEARIZABLE)
