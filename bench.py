@@ -139,6 +139,8 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None):
     # hard-parts #5), so the headline rate only counts decided verdicts.
     backend = JaxTPU(spec, budget=sc["budget"])
     backend.check_histories(spec, device_corpus)  # warmup: compile + run
+    backend.lockstep_cost = 0   # count only the timed passes below
+    backend.rounds_run = 0
     if profile_dir:
         import jax
 
@@ -189,6 +191,14 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None):
             "device_budget": sc["budget"],
             "budget_exceeded": budget_exceeded,
             "rescued": backend.rescued,
+            "lockstep_iters": backend.lockstep_cost // sc["reps"],  # per pass
+            "chunk_rounds": backend.rounds_run // sc["reps"],
+            # measured once on the CPU-scale corpus (256 lanes, seed_base
+            # 1000) with the round-2 rescue-ladder driver; only comparable
+            # to the CPU-fallback run of THIS corpus, so omitted elsewhere
+            "lockstep_iters_r2_ladder": (
+                3_769_248 if not on_tpu and sc["device_batch"] == 256
+                else None),
             "wrong_verdicts_on_sample": mismatches,
             "corpus_gen_sec": round(gen_s, 1),
         },

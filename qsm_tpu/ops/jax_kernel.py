@@ -29,28 +29,43 @@ chosen branchlessly:
 * first candidate via ``argmax`` of the bool mask (same canonical op order as
   the CPU oracle, so explored trees — and therefore verdicts — agree)
 
-Two mechanisms tame worst-case blowups:
+Taming worst-case blowups — the CHUNKED, LANE-COMPACTING driver (round 3)
+-------------------------------------------------------------------------
+The DFS state above is a **resumable carry**: ``build_stepper`` exposes the
+loop as ``init`` + ``run(carry, …, chunk=K)``, where one call advances every
+lane by at most ``K`` iterations and returns the exact mid-search state.
+:class:`JaxTPU` drives batches in escalating chunks:
 
-* an **in-kernel memoisation cache** (Lowe-style): configurations
-  ``(taken-set, state)`` proven non-linearizable-from are inserted into a
-  per-lane hash table on subtree exhaustion and pruned on re-entry — the
-  device analog of ``WingGongCPU(memo=True)``, collapsing violating
-  histories from millions of iterations to ~the number of distinct
-  configurations (see ``build_kernel``);
-* an **iteration budget** with a rescue ladder: the main batch runs
-  cache-less at a LOW budget (most lanes decide in tens of iterations;
-  lockstep vmap means a high budget just makes everyone wait on the worst
-  lane); undecided lanes are re-run in progressively smaller batches with
-  progressively larger caches and budgets.  Anything still undecided
-  reports BUDGET_EXCEEDED honestly and the property layer resolves it via
-  the CPU oracle, keeping CPU/TPU verdicts bit-identical (hard-parts #5).
+1. run a chunk; lanes that decided leave the batch;
+2. survivors are **compacted** into the smallest batch bucket that holds
+   them (a vmapped while-loop is lockstep — decided lanes otherwise idle at
+   full batch width while the worst lane spins; compaction is the fix the
+   round-2 verdict demanded);
+3. as the batch shrinks, the per-lane **memoisation cache** (Lowe-style:
+   configurations ``(taken-set, state)`` proven non-linearizable-from,
+   inserted on subtree exhaustion, pruned on re-entry) GROWS within the
+   empirically verified-safe (batch × cache_slots) region; existing entries
+   are re-hashed host-side into the larger table (``hash_slots_np`` is the
+   numpy mirror of the in-kernel mixer), so no pruning knowledge is lost;
+4. a lane whose cumulative iterations reach the total budget reports
+   BUDGET_EXCEEDED honestly and the property layer resolves it via the CPU
+   oracle, keeping CPU/TPU verdicts bit-identical (hard-parts #5).
+
+Unlike the round-2 rescue ladder, a rescue never restarts a search from
+iteration zero — the carry resumes exactly where the previous chunk
+stopped, and the whole schedule wastes at most one chunk of lockstep
+spinning per decided lane.
 
 Pending (crash/fault) ops are expanded host-side into complete histories —
 every prune/complete×response combination (SURVEY.md §3.2 complete/prune) —
 so the kernel itself only ever sees complete histories with static shapes.
 
 Batching: ``vmap`` over histories (≥1024 per call — BASELINE.json:9); batch
-sizes and op counts are bucketed to bound recompilation.
+sizes and op counts are bucketed to bound recompilation.  Histories may
+carry **per-lane initial states** (``check_histories(..., init_states=…)``,
+or ``check_from`` for one) — that is what lets the decrease-and-conquer
+segmentation combinator (ops/segdc.py) decide final segments from frontier
+states on the device.
 """
 
 from __future__ import annotations
@@ -107,13 +122,36 @@ def make_hash_slot(key_words: int, cache_slots: int):
     return hash_slot
 
 
-def build_kernel(spec: Spec, n_ops: int, budget: int,
-                 cache_slots: int = 0, cache_write: str = "onehot"):
-    """Build the single-history while-loop checker for one (spec, N) shape.
+def hash_slots_np(keys: np.ndarray, cache_slots: int) -> np.ndarray:
+    """Numpy mirror of :func:`make_hash_slot` over rows of ``keys``
+    (uint32[M, key_words] -> int32[M]).  Used to re-hash surviving cache
+    entries host-side when the compacting driver grows the table; MUST stay
+    bit-identical to the kernel's mixer (tests/test_cache.py pins this)."""
+    keys = np.asarray(keys, np.uint32)
+    h = np.full(keys.shape[0], 0x9E3779B9, np.uint32)
+    for i in range(keys.shape[1]):
+        h = h ^ keys[:, i]
+        h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+        h = h ^ (h >> np.uint32(16))
+        h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+        h = h ^ (h >> np.uint32(13))
+    return (h & np.uint32(cache_slots - 1)).astype(np.int32)
 
-    Returned function signature (all jnp arrays):
-        (cmd[N], arg[N], resp[N], valid[N], precedes[N,N], init_state[S])
-        -> (status: int32, iters: int32)
+
+def build_stepper(spec: Spec, n_ops: int, budget: int,
+                  cache_slots: int = 0, cache_write: str = "onehot"):
+    """Build the resumable single-history checker for one (spec, N) shape.
+
+    Returns ``(init_one, run_one)``:
+
+    * ``init_one(valid[N] bool, init_state[S]) -> carry`` — fresh DFS state
+      (status SUCCESS immediately for empty histories);
+    * ``run_one(carry, cmd[N], arg[N], resp[N], valid[N], precedes[N,N],
+      chunk=None) -> carry`` — advance the search until it decides, the
+      cumulative iteration count reaches ``budget`` (status BUDGET), or —
+      when ``chunk`` is a static int — at most ``chunk`` more iterations
+      ran.  Resuming with another ``run_one`` call continues the exact same
+      search: the carry is the complete DFS state.
 
     ``cache_slots`` > 0 enables the in-kernel memoisation cache (Lowe-style,
     after the "just-in-time linearizability" cache): a per-history hash
@@ -125,10 +163,6 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
     soundness.  This is what keeps violating histories (which must exhaust
     the whole tree) out of the exponential regime, exactly like the CPU
     oracle's ``memo=True``; verdicts are unchanged, only iteration counts.
-
-    Default is OFF: callers must stay inside the verified-safe
-    (batch x cache_slots) region — see :class:`JaxTPU`, which enables the
-    cache only for its small-batch rescue pass.
     """
     import jax
     import jax.numpy as jnp
@@ -138,7 +172,7 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
 
     # Scalar-state specs declare a bound on reachable states; the kernel
     # then tabulates step(s, op_j) for every (state, op) pair ONCE per
-    # history (outside the while loop) and the loop body replaces the
+    # chunk call (outside the while loop) and the loop body replaces the
     # vmapped step_jax sweep over all ops with a single dynamic row gather
     # — the dominant per-iteration cost in the v1 kernel (VERDICT.md round
     # 1, "Next round" #2).  Sound because ok-children of tabulated steps
@@ -178,7 +212,24 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
     # dimension is >= 1024 — on both CPU and TPU backends.  Regression
     # coverage: tests/test_parity.py::test_large_batch_parity.
 
-    def check_one(cmd, arg, resp, valid, precedes, init_state):
+    def init_one(valid, init_state):
+        n_req = jnp.sum(valid.astype(jnp.int32))
+        carry = {
+            "d": jnp.int32(0),
+            "taken": jnp.zeros(n_ops, bool),
+            "chosen": jnp.full(n_ops + 1, -1, jnp.int32),
+            "states": jnp.zeros((n_ops + 1, spec.STATE_DIM),
+                                jnp.int32).at[0].set(init_state),
+            "status": jnp.where(n_req == 0, SUCCESS,
+                                RUNNING).astype(jnp.int32),
+            "iters": jnp.int32(0),
+        }
+        if use_cache:
+            carry["keys"] = jnp.zeros((cache_slots, key_words), jnp.uint32)
+            carry["occ"] = jnp.zeros(cache_slots, jnp.int32)
+        return carry
+
+    def run_one(carry, cmd, arg, resp, valid, precedes, chunk=None):
         n_req = jnp.sum(valid.astype(jnp.int32))
 
         if state_bound is not None:
@@ -192,9 +243,6 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
 
             nxt_tab, ok_tab = jax.vmap(_tab_row)(
                 jnp.arange(state_bound, dtype=jnp.int32))
-
-        def cond(c):
-            return c["status"] == RUNNING
 
         def body(c):
             d, taken = c["d"], c["taken"]
@@ -309,20 +357,38 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
                     out["occ"] = jnp.where(row_mask, 1, c["occ"])
             return out
 
-        init = {
-            "d": jnp.int32(0),
-            "taken": jnp.zeros(n_ops, bool),
-            "chosen": jnp.full(n_ops + 1, -1, jnp.int32),
-            "states": jnp.zeros((n_ops + 1, spec.STATE_DIM),
-                                jnp.int32).at[0].set(init_state),
-            "status": jnp.where(n_req == 0, SUCCESS,
-                                RUNNING).astype(jnp.int32),
-            "iters": jnp.int32(0),
-        }
-        if use_cache:
-            init["keys"] = jnp.zeros((cache_slots, key_words), jnp.uint32)
-            init["occ"] = jnp.zeros(cache_slots, jnp.int32)
-        out = jax.lax.while_loop(cond, body, init)
+        if chunk is None:
+            def cond(c):
+                return c["status"] == RUNNING
+        else:
+            start = carry["iters"]
+
+            def cond(c):
+                return (c["status"] == RUNNING) & (c["iters"] - start < chunk)
+
+        return jax.lax.while_loop(cond, body, carry)
+
+    return init_one, run_one
+
+
+def build_kernel(spec: Spec, n_ops: int, budget: int,
+                 cache_slots: int = 0, cache_write: str = "onehot"):
+    """Build the run-to-completion single-history checker (one while-loop).
+
+    Returned function signature (all jnp arrays):
+        (cmd[N], arg[N], resp[N], valid[N], precedes[N,N], init_state[S])
+        -> (status: int32, iters: int32)
+
+    Thin composition of :func:`build_stepper` (init + unchunked run); kept
+    as the stable entry point for tests and the driver's compile checks.
+    """
+    init_one, run_one = build_stepper(spec, n_ops, budget,
+                                      cache_slots=cache_slots,
+                                      cache_write=cache_write)
+
+    def check_one(cmd, arg, resp, valid, precedes, init_state):
+        carry = init_one(valid, init_state)
+        out = run_one(carry, cmd, arg, resp, valid, precedes)
         return out["status"], out["iters"]
 
     return check_one
@@ -331,10 +397,18 @@ def build_kernel(spec: Spec, n_ops: int, budget: int,
 class JaxTPU:
     """Batched device backend implementing :class:`LineariseBackend`.
 
-    One compiled executable per (max_ops bucket, batch bucket); host code
-    pads batches into those shapes.  ``check_histories`` returns verdicts
-    bit-compatible with ``WingGongCPU`` (BUDGET_EXCEEDED when the iteration
-    budget ran out — never a guess).
+    One compiled executable per (max_ops bucket, batch bucket, cache slots,
+    chunk); host code pads batches into those shapes.  ``check_histories``
+    returns verdicts bit-compatible with ``WingGongCPU`` (BUDGET_EXCEEDED
+    when the iteration budget ran out — never a guess).
+
+    The driver is chunked and lane-compacting (module docstring): every
+    batch starts in the largest needed bucket with a small cache, survivors
+    are periodically compacted into smaller buckets with bigger caches, and
+    each lane's total iterations are capped at ``budget + mid_budget +
+    rescue_budget`` (the three knobs are kept for API compatibility with
+    the round-2 rescue ladder; ``budget`` alone also still means "a lane
+    decided after more than this many iterations counts as rescued").
     """
 
     name = "jax_tpu"
@@ -345,14 +419,15 @@ class JaxTPU:
     # crashes the worker.  Model it as a per-batch-bucket slot cap: the two
     # verified points stand as-is; unverified buckets are capped so that
     # batch*slots <= 1<<17, the largest product seen safe at batch >= 256.
-    # Large batches with even tiny caches are also pathologically slow (the
-    # per-iteration cache rewrite stops being in-place), so the MAIN pass
-    # always runs cache-less and the memo cache lives only in the
-    # small-batch rescue pass.  The cap actually applied is exposed via
-    # ``effective_rescue_slots``.
     MAX_SLOTS_FOR_BATCH = {8: 8192, 64: 4096, 256: 512, 1024: 128, 4096: 32}
-    # 16 would pad to the 64 batch bucket anyway; run full 64-lane rescues
-    RESCUE_BATCH = 64
+    # Chunk escalation: small first chunks harvest the easy majority with
+    # little lockstep waste; later chunks grow so the hard tail is not
+    # host-sync bound.  The last entry repeats until budget exhaustion.
+    # Tuned on the CAS 32x8 bench corpus (CPU platform, 256 lanes):
+    #   (512,2048,8192,32768,65536) -> 300k lockstep iters, 112 h/s
+    #   (256,2048,16384,65536)      -> 235k lockstep iters, 140+ h/s
+    # (the round-2 rescue ladder paid 3.77M on the same corpus).
+    CHUNK_SCHEDULE = (256, 2048, 16384, 65536)
 
     def __init__(self, spec: Spec, budget: int = 2_000,
                  max_expansions: int = 128,
@@ -366,22 +441,18 @@ class JaxTPU:
         self.budget = budget
         self.max_expansions = max_expansions
         self.sharding = sharding  # optional NamedSharding for the batch axis
-        # Rescue LADDER (measured iteration distribution, CAS 32x8 corpus:
-        # p50 = 57 iters, p90 = 35k cache-less but ~1k with a 512-slot
-        # cache, p99 ~ 8k): the cache-less main pass runs at a LOW budget —
-        # most lanes decide almost immediately and a high budget only makes
-        # the whole lockstep batch wait on its worst lane.  Survivors climb
-        # the ladder: medium batches with a small cache, then small batches
-        # with a big cache.  Anything still undecided reports
-        # BUDGET_EXCEEDED honestly (the property layer resolves via the
-        # oracle).  Slot counts per stage stay inside the verified-safe
-        # region (MAX_SLOTS_FOR_BATCH).
         self.rescue_budget = rescue_budget
         self.rescue_slots = rescue_slots
         self.mid_budget = mid_budget
-        self.mid_slots = mid_slots
+        self.mid_slots = mid_slots  # unused by the chunked driver; kept for
+        # API compatibility with round-2 callers
         self.cache_write = cache_write
-        self._compiled: Dict[Tuple[int, int, int, int], object] = {}
+        # total per-lane iteration cap — the sum of what the round-2 ladder
+        # would have granted across its three stages, so existing callers'
+        # budget expectations (tests, bench) are preserved exactly
+        self.total_budget = budget + mid_budget + rescue_budget
+        self._steppers: Dict[Tuple[int, int], tuple] = {}
+        self._compiled: Dict[Tuple, object] = {}
         # Step-table specs guarantee their state bound only for histories
         # whose ARGS are in the declared command domains (resps may be
         # arbitrary — SUTs can return anything; args come from the
@@ -393,27 +464,57 @@ class JaxTPU:
         self.batches_run = 0
         self.device_histories = 0
         self.rescued = 0
-        self.effective_rescue_slots: Optional[int] = None  # last cap applied
+        self.rounds_run = 0
+        self.compactions = 0   # batch-shrink / cache-growth events
+        # Σ (while-loop trip count × padded batch) over all chunk calls:
+        # the honest lockstep cost of a batch (what every lane PAYS, not
+        # what it needed) — the round-3 iteration-efficiency metric.
+        self.lockstep_cost = 0
+        self.effective_rescue_slots: Optional[int] = None  # largest cache
 
     # -- compilation cache -------------------------------------------------
-    def _safe_slots(self, batch: int, want: int) -> int:
-        cap = self.MAX_SLOTS_FOR_BATCH.get(batch, 32)
-        slots = min(want, cap)
-        if want > 0:
-            self.effective_rescue_slots = slots
+    def _slots_for(self, batch: int) -> int:
+        slots = min(self.MAX_SLOTS_FOR_BATCH.get(batch, 32),
+                    self.rescue_slots)
+        if slots > 0:
+            self.effective_rescue_slots = max(
+                self.effective_rescue_slots or 0, slots)
         return slots
 
-    def _kernel(self, n_ops: int, batch: int, slots: int, budget: int):
+    def _stepper(self, n_ops: int, slots: int):
+        key = (n_ops, slots)
+        fns = self._steppers.get(key)
+        if fns is None:
+            fns = build_stepper(self.spec, n_ops, self.total_budget,
+                                cache_slots=slots,
+                                cache_write=self.cache_write)
+            self._steppers[key] = fns
+        return fns
+
+    def _init_fn(self, n_ops: int, batch: int, slots: int):
         import jax
 
-        key = (n_ops, batch, slots, budget)
+        key = ("init", n_ops, batch, slots)
         fn = self._compiled.get(key)
         if fn is None:
-            single = build_kernel(self.spec, n_ops, budget,
-                                  cache_slots=slots,
-                                  cache_write=self.cache_write)
-            batched = jax.vmap(single, in_axes=(0, 0, 0, 0, 0, None))
-            fn = jax.jit(batched)
+            init_one, _ = self._stepper(n_ops, slots)
+            fn = jax.jit(jax.vmap(init_one, in_axes=(0, 0)))
+            self._compiled[key] = fn
+        return fn
+
+    def _chunk_fn(self, n_ops: int, batch: int, slots: int, chunk: int):
+        import jax
+
+        key = ("chunk", n_ops, batch, slots, chunk)
+        fn = self._compiled.get(key)
+        if fn is None:
+            _, run_one = self._stepper(n_ops, slots)
+
+            def run_chunk(carry, cmd, arg, resp, valid, precedes):
+                return run_one(carry, cmd, arg, resp, valid, precedes,
+                               chunk=chunk)
+
+            fn = jax.jit(jax.vmap(run_chunk, in_axes=(0, 0, 0, 0, 0, 0)))
             self._compiled[key] = fn
         return fn
 
@@ -458,16 +559,23 @@ class JaxTPU:
         return out
 
     # -- main entry --------------------------------------------------------
-    def check_histories(self, spec: Spec, histories: Sequence[History]
+    def check_histories(self, spec: Spec, histories: Sequence[History],
+                        init_states: Optional[Sequence] = None
                         ) -> np.ndarray:
         assert spec is self.spec, \
             "JaxTPU is compiled per spec; construct one per spec"
         if not histories:
             return np.empty(0, np.int8)
+        # public-parameter validation: not an assert (python -O strips it)
+        if init_states is not None and len(init_states) != len(histories):
+            raise ValueError(
+                f"init_states has {len(init_states)} entries for "
+                f"{len(histories)} histories")
 
         # 1. host-side pending expansion
         groups: List[Tuple[int, int]] = []  # (start, count) per input
         flat: List[History] = []
+        flat_inits: List = []
         overflow: List[int] = []
         for idx, h in enumerate(histories):
             if self._uses_table and not self._args_in_domain(h):
@@ -482,10 +590,13 @@ class JaxTPU:
             else:
                 groups.append((len(flat), len(exp)))
                 flat.extend(exp)
+                if init_states is not None:
+                    flat_inits.extend([init_states[idx]] * len(exp))
 
         out = np.full(len(histories), int(Verdict.BUDGET_EXCEEDED), np.int8)
         if flat:
-            statuses = self._run_device(flat)
+            statuses = self._run_device(
+                flat, flat_inits if init_states is not None else None)
             for idx, (start, count) in enumerate(groups):
                 if count == 0:
                     continue
@@ -498,65 +609,202 @@ class JaxTPU:
                     out[idx] = int(Verdict.VIOLATION)
         return out
 
-    def _run_device(self, flat: Sequence[History]) -> np.ndarray:
+    def check_from(self, spec: Spec, history: History, init_state) -> Verdict:
+        """Single-history :meth:`check_histories` from an explicit model
+        state — the device counterpart of ``WingGongCPU.check_from`` (used
+        by the segmentation combinator, ops/segdc.py)."""
+        v = self.check_histories(spec, [history], init_states=[init_state])
+        return Verdict(int(v[0]))
+
+    # -- the chunked, lane-compacting driver -------------------------------
+    def _run_device(self, flat: Sequence[History],
+                    flat_inits: Optional[List] = None) -> np.ndarray:
         top = _BATCH_BUCKETS[-1]
         if len(flat) > top:
             return np.concatenate([
-                self._run_device(flat[i:i + top])
+                self._run_device(
+                    flat[i:i + top],
+                    flat_inits[i:i + top] if flat_inits else None)
                 for i in range(0, len(flat), top)])
-        status = self._run_pass(flat, self.budget, 0)
-        # rescue ladder: undecided lanes climb to smaller batches with
-        # bigger caches and budgets (decides the hard tail on device;
-        # anything still BUDGET at the top goes to the CPU oracle as usual)
-        ladder = ((256, self.mid_slots, self.mid_budget),
-                  (self.RESCUE_BATCH, self.rescue_slots, self.rescue_budget))
-        for stage_batch, slots, budget in ladder:
-            todo = [i for i, s in enumerate(status) if s == BUDGET]
-            if not todo or budget <= 0 or slots <= 0:
-                continue
-            for lo in range(0, len(todo), stage_batch):
-                idx = todo[lo:lo + stage_batch]
-                sub = self._run_pass([flat[i] for i in idx], budget, slots)
-                status[idx] = sub
-                self.rescued += int((sub != BUDGET).sum())
-        return status
 
-    def _run_pass(self, flat: Sequence[History], budget: int,
-                  want_slots: int) -> np.ndarray:
         n_ops = bucket_for(max(len(h) for h in flat) or 1)
-        batch = _batch_bucket(len(flat))
-        slots = self._safe_slots(batch, want_slots)
         enc = encode_batch(flat, self.spec.initial_state(), max_ops=n_ops)
         b = len(flat)
-        cmd = np.zeros((batch, n_ops), np.int32)
-        arg = np.zeros((batch, n_ops), np.int32)
-        resp = np.zeros((batch, n_ops), np.int32)
-        valid = np.zeros((batch, n_ops), bool)
-        prec = np.zeros((batch, n_ops, n_ops), bool)
-        cmd[:b] = enc.ops[:, :, 1]
-        arg[:b] = enc.ops[:, :, 2]
-        resp[:b] = enc.ops[:, :, 3]
-        valid[:b] = enc.valid
-        prec[:b] = enc.precedes()
-        args = (cmd, arg, resp, valid, prec,
-                enc.init_state)
-        if self.sharding is not None:
-            import jax
-            args = tuple(
-                jax.device_put(a, s) for a, s in
-                zip(args, self._arg_shardings()))
-        status, _iters = self._kernel(n_ops, batch, slots, budget)(*args)
-        self.batches_run += 1
-        self.device_histories += b
-        return np.asarray(status)[:b].copy()
+        cmd = enc.ops[:, :, 1].astype(np.int32)
+        arg = enc.ops[:, :, 2].astype(np.int32)
+        resp = enc.ops[:, :, 3].astype(np.int32)
+        valid = enc.valid.astype(bool)
+        prec = enc.precedes().astype(bool)
+        inits = np.tile(np.asarray(enc.init_state, np.int32), (b, 1))
+        if flat_inits is not None:
+            for i, s in enumerate(flat_inits):
+                inits[i] = np.asarray(s, np.int32)
 
-    def _arg_shardings(self):
-        """Batch-axis sharding for each kernel argument (replicated init)."""
+        out_status = np.full(b, BUDGET, np.int32)
+        active = np.arange(b)          # indices into the flat batch
+        carry = None                   # device carry for current bucket
+        args = None
+        lanes = np.empty(0, np.intp)   # carry row of each active element
+        cur_bucket = cur_slots = None
+        prev_iters = np.zeros(b, np.int64)
+        round_i = 0
+
+        while active.size:
+            bucket = _batch_bucket(active.size)
+            slots = self._slots_for(bucket)
+            chunk = self.CHUNK_SCHEDULE[
+                min(round_i, len(self.CHUNK_SCHEDULE) - 1)]
+
+            if carry is None:
+                carry = self._fresh_carry(active, bucket, slots, n_ops,
+                                          valid, inits)
+                args = self._pad_args(active, bucket,
+                                      cmd, arg, resp, valid, prec)
+                lanes = np.arange(active.size)
+                cur_bucket, cur_slots = bucket, slots
+            elif bucket != cur_bucket or slots != cur_slots:
+                carry = self._compact_carry(carry, lanes, bucket,
+                                            slots, cur_slots)
+                args = self._pad_args(active, bucket,
+                                      cmd, arg, resp, valid, prec)
+                lanes = np.arange(active.size)
+                cur_bucket, cur_slots = bucket, slots
+                self.compactions += 1
+
+            fn = self._chunk_fn(n_ops, bucket, slots, chunk)
+            carry = fn(carry, *args)
+            status = np.asarray(carry["status"])
+            iters = np.asarray(carry["iters"]).astype(np.int64)
+            self.batches_run += 1
+            self.rounds_run += 1
+            # lockstep cost: trips this chunk × padded width (what every
+            # lane PAYS under lockstep, not what it needed)
+            delta = iters[lanes] - prev_iters[active]
+            self.lockstep_cost += int(delta.max(initial=0)) * bucket
+            prev_iters[active] = iters[lanes]
+
+            lane_status = status[lanes]
+            done = lane_status != RUNNING
+            if done.any():
+                out_status[active[done]] = lane_status[done]
+                decided = lane_status[done] != BUDGET
+                self.rescued += int(np.sum(
+                    decided & (iters[lanes][done] > self.budget)))
+            still = ~done
+            active = active[still]
+            lanes = lanes[still]
+            round_i += 1
+
+        self.device_histories += b
+        return out_status
+
+    def _fresh_carry(self, active, bucket, slots, n_ops, valid, inits):
+        import jax.numpy as jnp
+
+        pv = np.zeros((bucket, valid.shape[1]), bool)
+        pi = np.zeros((bucket, inits.shape[1]), np.int32)
+        pv[:active.size] = valid[active]
+        pi[:active.size] = inits[active]
+        # padding rows have no valid ops -> n_req == 0 -> status SUCCESS at
+        # init, so their while-loop cond is immediately false (frozen)
+        carry = self._init_fn(n_ops, bucket, slots)(
+            jnp.asarray(pv), jnp.asarray(pi))
+        return self._shard_carry(carry)
+
+    def _compact_carry(self, carry, lanes, bucket, slots, old_slots):
+        """Gather surviving lanes' DFS state into a smaller padded batch
+        (host-side), growing the memo cache by re-hashing occupied entries
+        into the larger table.  The carry is exact: resuming it continues
+        the identical search; dropped-on-collision cache entries only lose
+        pruning opportunities, never soundness."""
+        import jax.numpy as jnp
+
+        host = {k: np.asarray(v) for k, v in carry.items()}
+        m = lanes.size
+        new = {}
+        for k, v in host.items():
+            if k in ("keys", "occ"):
+                continue
+            buf = np.zeros((bucket,) + v.shape[1:], v.dtype)
+            buf[:m] = v[lanes]
+            if k == "status":
+                buf[m:] = SUCCESS  # freeze padding lanes
+            new[k] = buf
+
+        if slots > 0:
+            key_words = host["keys"].shape[2] if "keys" in host else (
+                self._stepper_key_words())
+            keys = np.zeros((bucket, slots, key_words), np.uint32)
+            occ = np.zeros((bucket, slots), np.int32)
+            if "keys" in host and old_slots:
+                if old_slots == slots:
+                    keys[:m] = host["keys"][lanes]
+                    occ[:m] = host["occ"][lanes]
+                else:
+                    for row, lane in enumerate(lanes):
+                        filled = host["occ"][lane] == 1
+                        if not filled.any():
+                            continue
+                        kk = host["keys"][lane][filled]
+                        dest = hash_slots_np(kk, slots)
+                        keys[row, dest] = kk
+                        occ[row, dest] = 1
+            new["keys"] = keys
+            new["occ"] = occ
+        return self._shard_carry({k: jnp.asarray(v)
+                                  for k, v in new.items()})
+
+    def _shard_carry(self, carry):
+        """Every carry leaf is batch-leading; on a mesh, place it with the
+        same batch-axis sharding as the kernel args (otherwise each chunk
+        call implicitly reshards the dominant state — the carry, cache
+        included, is far larger than the inputs)."""
+        if self.sharding is None:
+            return carry
         import jax
         from jax.sharding import PartitionSpec as P
 
         mesh = self.sharding.mesh
         axis = self.sharding.spec[0] if self.sharding.spec else None
         batched = jax.NamedSharding(mesh, P(axis))
-        replicated = jax.NamedSharding(mesh, P())
-        return (batched, batched, batched, batched, batched, replicated)
+        return {k: jax.device_put(v, batched) for k, v in carry.items()}
+
+    def _stepper_key_words(self) -> int:
+        # only needed when a cache appears where none existed (old_slots=0)
+        raise AssertionError(
+            "cache slots grew from 0 mid-run; _slots_for is monotone per "
+            "bucket so this cannot happen")
+
+    def _pad_args(self, active, bucket, cmd, arg, resp, valid, prec):
+        import jax.numpy as jnp
+
+        m = active.size
+        n = cmd.shape[1]
+        pc = np.zeros((bucket, n), np.int32)
+        pa = np.zeros((bucket, n), np.int32)
+        pr = np.zeros((bucket, n), np.int32)
+        pv = np.zeros((bucket, n), bool)
+        pp = np.zeros((bucket, n, n), bool)
+        pc[:m] = cmd[active]
+        pa[:m] = arg[active]
+        pr[:m] = resp[active]
+        pv[:m] = valid[active]
+        pp[:m] = prec[active]
+        args = (jnp.asarray(pc), jnp.asarray(pa), jnp.asarray(pr),
+                jnp.asarray(pv), jnp.asarray(pp))
+        if self.sharding is not None:
+            import jax
+
+            sh = self._arg_shardings()
+            args = tuple(jax.device_put(a, s) for a, s in zip(args, sh))
+        return args
+
+    def _arg_shardings(self):
+        """Batch-axis sharding for each kernel argument."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.sharding.mesh
+        axis = self.sharding.spec[0] if self.sharding.spec else None
+        batched = jax.NamedSharding(mesh, P(axis))
+        return (batched, batched, batched, batched, batched)
