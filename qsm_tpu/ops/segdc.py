@@ -26,8 +26,12 @@ Segment checking threads a FRONTIER of model states:
   state, memoised on (taken-set, state), collecting the set of reachable
   end states;
 * the final segment (pending ops allowed) only needs satisfiability, which
-  is exactly the oracle's search started from a frontier state
-  (``WingGongCPU.check_from``).
+  is a search started from a frontier state — ``WingGongCPU.check_from`` on
+  the host, or, when the inner backend supports per-lane initial states
+  (``JaxTPU.check_histories(..., init_states=…)``), ONE batched device call
+  deciding every (final segment × frontier state) pair across the whole
+  input batch at once (VERDICT.md round 2, "Next round" #6: segments, not
+  just uncut wholes, decided on the device).
 
 Exactness: verdicts equal the plain oracle's on every history (the block
 decomposition above is an iff), with BUDGET_EXCEEDED when the node budget
@@ -36,6 +40,7 @@ runs out — never a guess.
 
 from __future__ import annotations
 
+import inspect
 from typing import Callable, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -129,25 +134,41 @@ class SegDC:
     def __init__(self, spec: Spec,
                  make_inner: Optional[Callable] = None,
                  node_budget: int = 10_000_000,
-                 oracle: Optional[WingGongCPU] = None):
+                 oracle: Optional[WingGongCPU] = None,
+                 device_final: Optional[bool] = None):
         self.spec = spec
         self.inner: LineariseBackend = (
             make_inner(spec) if make_inner is not None
             else WingGongCPU(memo=True))
         # final-segment satisfiability needs a start-state-parameterised
-        # search, which is the oracle's (device backends start from
-        # spec.initial_state() only)
+        # search: the host oracle's ``check_from``, or — when the inner
+        # backend's ``check_histories`` takes ``init_states`` (JaxTPU) —
+        # one batched device call across all (segment × frontier state)
+        # pairs.  Auto-detected from the signature; override explicitly
+        # with ``device_final``.
         self.oracle = oracle or WingGongCPU(memo=True)
+        if device_final is None:
+            try:
+                device_final = "init_states" in inspect.signature(
+                    self.inner.check_histories).parameters
+            except (TypeError, ValueError):
+                device_final = False
+        self.device_final = bool(device_final)
         self.node_budget = node_budget
         self.name = f"segdc({self.inner.name})"
         self.segments_split = 0    # histories that actually cut
         self.segments_total = 0    # segments across them
+        self.final_states_device = 0  # (segment × state) lanes sent to device
 
     def check_histories(self, spec: Spec, histories: Sequence[History]
                         ) -> np.ndarray:
         assert spec is self.spec, "SegDC is bound to one spec"
         out = np.empty(len(histories), np.int8)
         whole: List[int] = []   # indices delegated to the inner backend
+        # (index, final-segment history, sorted frontier states) triples of
+        # histories whose middle segments resolved — their final-segment
+        # satisfiability checks are batched below
+        finals: List[Tuple[int, History, List[Tuple[int, ...]]]] = []
         for i, h in enumerate(histories):
             segs = split_at_quiescent_cuts(h)
             if len(segs) <= 1:
@@ -155,7 +176,32 @@ class SegDC:
                 continue
             self.segments_split += 1
             self.segments_total += len(segs)
-            out[i] = int(self._check_segmented(spec, h, segs))
+            budget = _Budget(self.node_budget)
+            frontier: Set[Tuple[int, ...]] = {
+                tuple(int(v) for v in spec.initial_state())}
+            verdict: Optional[Verdict] = None
+            for seg in segs[:-1]:
+                nxt = _end_states(spec, seg, frontier, budget)
+                if nxt is None:
+                    verdict = Verdict.BUDGET_EXCEEDED
+                    break
+                if not nxt:
+                    verdict = Verdict.VIOLATION
+                    break
+                frontier = nxt
+            if verdict is not None:
+                out[i] = int(verdict)
+                continue
+            last = History(segs[-1], seed=h.seed, program_id=h.program_id)
+            # sorted: set order is run-dependent; the device batch layout
+            # (and so any budget-tie behavior) must be deterministic
+            finals.append((i, last, sorted(frontier)))
+        if finals:
+            if self.device_final:
+                self._resolve_finals_device(spec, finals, out)
+            else:
+                for i, last, states in finals:
+                    out[i] = int(self._final_on_oracle(spec, last, states))
         if whole:
             sub = self.inner.check_histories(
                 spec, [histories[i] for i in whole])
@@ -163,21 +209,33 @@ class SegDC:
                 out[i] = v
         return out
 
-    def _check_segmented(self, spec: Spec, h: History,
-                         segs: List[List[Op]]) -> Verdict:
-        budget = _Budget(self.node_budget)
-        frontier: Set[Tuple[int, ...]] = {
-            tuple(int(v) for v in spec.initial_state())}
-        for seg in segs[:-1]:
-            nxt = _end_states(spec, seg, frontier, budget)
-            if nxt is None:
-                return Verdict.BUDGET_EXCEEDED
-            if not nxt:
-                return Verdict.VIOLATION
-            frontier = nxt
-        last = History(segs[-1], seed=h.seed, program_id=h.program_id)
+    def _resolve_finals_device(self, spec: Spec, finals, out) -> None:
+        """ONE batched inner-backend call deciding every (final segment ×
+        frontier state) pair; linearizable-from-ANY-state wins, else any
+        budget blowup defers honestly to the oracle-resolving caller."""
+        flat_h: List[History] = []
+        flat_s: List[np.ndarray] = []
+        spans: List[Tuple[int, int]] = []
+        for _, last, states in finals:
+            spans.append((len(flat_h), len(states)))
+            flat_h.extend([last] * len(states))
+            flat_s.extend(np.asarray(s, np.int32) for s in states)
+        verdicts = self.inner.check_histories(spec, flat_h,
+                                              init_states=flat_s)
+        self.final_states_device += len(flat_h)
+        for (i, _, _), (start, count) in zip(finals, spans):
+            sub = np.asarray(verdicts[start:start + count])
+            if (sub == int(Verdict.LINEARIZABLE)).any():
+                out[i] = int(Verdict.LINEARIZABLE)
+            elif (sub == int(Verdict.BUDGET_EXCEEDED)).any():
+                out[i] = int(Verdict.BUDGET_EXCEEDED)
+            else:
+                out[i] = int(Verdict.VIOLATION)
+
+    def _final_on_oracle(self, spec: Spec, last: History,
+                         states: List[Tuple[int, ...]]) -> Verdict:
         saw_budget = False
-        for state in frontier:
+        for state in states:
             v = self.oracle.check_from(spec, last, np.asarray(state))
             if v == Verdict.LINEARIZABLE:
                 return v
