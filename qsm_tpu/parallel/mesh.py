@@ -33,12 +33,12 @@ def init_distributed(coordinator_address: Optional[str] = None,
 
     Reads ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
     ``JAX_PROCESS_ID`` when args are omitted; returns False (no-op) when
-    unset, so single-host runs need no configuration.  NOTE: this image is
-    single-host with one tunnel chip, so the multi-process path cannot be
-    exercised here — the sharding side is validated by
-    ``__graft_entry__.dryrun_multichip``'s 2-D (host, device) virtual mesh,
-    which compiles and runs the identical program a real 2-host deployment
-    would.
+    unset, so single-host runs need no configuration.  Exercised for real
+    (2 OS processes, localhost coordinator, CPU platform, sharded kernel
+    over the global mesh) by tests/test_distributed.py; the same program
+    shape on a TPU pod replaces localhost TCP with DCN.
+    ``__graft_entry__.dryrun_multichip`` additionally validates the 2-D
+    (host, device) mesh sharding single-process.
     """
     import os
 

@@ -1,0 +1,107 @@
+"""Worker for the REAL ``jax.distributed`` multi-process test.
+
+Spawned (twice) by tests/test_distributed.py with a localhost coordinator:
+each process owns 4 virtual CPU devices, ``init_distributed`` joins them
+into one 8-device global runtime, and the Wing–Gong kernel runs sharded
+over the global (host, batch) mesh — the identical program shape a real
+2-host TPU deployment executes, with DCN replaced by localhost TCP
+(SURVEY.md §5 comm backend row; VERDICT.md round 2, "Next round" #5).
+
+Importable by the parent test for the shared corpus/encoding helpers; the
+``__main__`` path is the subprocess body.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+N_PIDS = 4
+N_OPS = 16
+N_HIST = 32
+BUDGET = 500_000
+
+
+def build_inputs():
+    """Deterministic CAS corpus + kernel-ready encoding, identical in every
+    process (generation is seed-derived, no wall clock anywhere)."""
+    from qsm_tpu.core.history import bucket_for, encode_batch
+    from qsm_tpu.models import AtomicCasSUT, CasSpec, RacyCasSUT
+    from qsm_tpu.utils.corpus import build_corpus
+
+    spec = CasSpec()
+    corpus = build_corpus(spec, (AtomicCasSUT, RacyCasSUT), n=64,
+                          n_pids=N_PIDS, max_ops=N_OPS, seed_base=42,
+                          seed_prefix="dist")
+    # the raw kernel decides complete histories only (pending-op expansion
+    # is the JaxTPU driver's host-side job, not under test here)
+    corpus = [h for h in corpus if h.n_pending == 0][:N_HIST]
+    assert len(corpus) == N_HIST, len(corpus)
+    n_ops = bucket_for(max(len(h) for h in corpus))
+    enc = encode_batch(corpus, spec.initial_state(), max_ops=n_ops)
+    args = (enc.ops[:, :, 1].astype(np.int32),
+            enc.ops[:, :, 2].astype(np.int32),
+            enc.ops[:, :, 3].astype(np.int32),
+            enc.valid.astype(bool),
+            enc.precedes().astype(bool),
+            np.tile(np.asarray(enc.init_state, np.int32), (N_HIST, 1)))
+    return spec, n_ops, args
+
+
+def main(argv) -> int:
+    pid, nproc, port, out_path = (int(argv[0]), int(argv[1]), argv[2],
+                                  argv[3])
+    sys.path.insert(0, "/root/repo")
+    # a plain JAX_PLATFORMS=cpu from the parent is IGNORED once the image's
+    # sitecustomize registered the axon TPU plugin — the config update after
+    # import is what actually wins (tests/conftest.py has the same dance);
+    # without it the first device query would try to initialize the chip
+    # tunnel and hang the worker forever
+    from qsm_tpu.utils.device import force_cpu_platform
+
+    force_cpu_platform(4)
+    import jax
+
+    from qsm_tpu.ops.jax_kernel import build_kernel
+    from qsm_tpu.parallel import (batch_sharding, init_distributed,
+                                  make_mesh_2d)
+
+    ok = init_distributed(f"127.0.0.1:{port}", num_processes=nproc,
+                          process_id=pid)
+    assert ok, "init_distributed returned False with explicit coordinator"
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+    assert len(jax.local_devices()) == 4, len(jax.local_devices())
+
+    spec, n_ops, args = build_inputs()
+    mesh = make_mesh_2d(2, 4)
+    # the mesh must really span both OS processes, not 8 local devices
+    assert len({d.process_index for d in mesh.devices.flat}) == 2
+    sharding = batch_sharding(mesh)
+    garrs = [
+        jax.make_array_from_callback(a.shape, sharding,
+                                     lambda idx, a=a: a[idx])
+        for a in args
+    ]
+    fn = jax.jit(jax.vmap(build_kernel(spec, n_ops, BUDGET)))
+    status, _iters = fn(*garrs)
+    status.block_until_ready()
+
+    # every process reports its ADDRESSABLE rows; the parent unions them
+    rows = {}
+    for shard in status.addressable_shards:
+        sl = shard.index[0]
+        for off, v in enumerate(np.asarray(shard.data).ravel()):
+            rows[str(sl.start + off)] = int(v)
+    with open(out_path, "w") as f:
+        json.dump({"process_index": pid,
+                   "process_count": jax.process_count(),
+                   "global_devices": len(jax.devices()),
+                   "rows": rows}, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,90 @@
+"""REAL ``jax.distributed`` execution — 2 OS processes, localhost
+coordinator, sharded kernel over the global (host, batch) mesh, verdict
+parity with a single-process run (VERDICT.md round 2, "Next round" #5: the
+multi-host program shape actually executes; ``init_distributed`` no longer
+has only its no-op branch covered)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "_distributed_worker.py")
+
+
+def _load_worker_module():
+    spec = importlib.util.spec_from_file_location("_distributed_worker",
+                                                  WORKER)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_jax_distributed_sharded_kernel_parity(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID"):
+        env.pop(k, None)
+
+    outs = [str(tmp_path / f"worker{i}.json") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port), outs[i]],
+            env=env, cwd="/root/repo",
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    logs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            logs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert all(p.returncode == 0 for p in procs), "\n---\n".join(logs)
+
+    reports = [json.load(open(o)) for o in outs]
+    assert {r["process_index"] for r in reports} == {0, 1}
+    assert all(r["process_count"] == 2 for r in reports)
+    assert all(r["global_devices"] == 8 for r in reports)
+
+    # union of per-process addressable rows covers the whole batch
+    mod = _load_worker_module()
+    rows: dict[int, int] = {}
+    for r in reports:
+        for k, v in r["rows"].items():
+            rows[int(k)] = v
+    assert sorted(rows) == list(range(mod.N_HIST))
+
+    # single-process reference: same kernel, same budget, this process's
+    # devices (tests/conftest.py pins an 8-device virtual CPU platform)
+    import jax
+
+    from qsm_tpu.ops.jax_kernel import build_kernel
+
+    spec, n_ops, args = mod.build_inputs()
+    fn = jax.jit(jax.vmap(build_kernel(spec, n_ops, mod.BUDGET)))
+    status, _ = fn(*args)
+    want = np.asarray(status)
+    got = np.asarray([rows[i] for i in range(mod.N_HIST)])
+    np.testing.assert_array_equal(got, want)
+    # the corpus must exercise both verdicts, or parity proves nothing
+    assert (want == 1).any() and (want == 2).any()
