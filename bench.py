@@ -74,6 +74,40 @@ def _probe_attempts_summary() -> dict | None:
     }
 
 
+# Window artifact: when the round-long watcher catches the tunnel in a
+# healed window it runs this script on the real chip and caches the JSON
+# line here; if the tunnel is wedged again at bench time, that cached line
+# IS the round's headline (with full provenance in extras) — the artifact
+# reflects the best probe of the round, not one instant.
+WINDOW_ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_TPU_WINDOW.json")
+
+
+WINDOW_MAX_AGE_S = 14 * 3600.0  # a round is ~12 h; reject older leftovers
+
+
+def _load_window_artifact() -> dict | None:
+    try:
+        with open(WINDOW_ARTIFACT) as f:
+            result = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(result, dict) or "value" not in result:
+        return None
+    if result.get("extras", {}).get("device_fallback") is not None:
+        return None  # never promote a CPU-fallback line to a TPU headline
+    # staleness bound: a stray artifact from a previous round must never
+    # become THIS round's headline (the file is gitignored too, but belt
+    # and braces — an old mtime also covers hand-copied files)
+    try:
+        age = time.time() - os.path.getmtime(WINDOW_ARTIFACT)
+    except OSError:
+        return None
+    if age > WINDOW_MAX_AGE_S:
+        return None
+    return result
+
+
 def _scale(on_tpu: bool) -> dict:
     """Benchmark scale: full on the real chip, reduced on the CPU fallback
     (the lockstep vmapped while-loop is orders of magnitude slower on host —
@@ -85,6 +119,117 @@ def _scale(on_tpu: bool) -> dict:
                 cpu_timebox_s=45.0, reps=1, budget=2_000)
 
 
+def run_sweep(on_tpu: bool) -> dict:
+    """Measure "max ops solved < 60 s" (BASELINE.json:2 second metric;
+    VERDICT.md round 2, "Next round" #4): for CAS and queue, scan op
+    buckets 12→64 per backend and report the largest bucket each backend
+    decides a sample corpus at with zero BUDGET_EXCEEDED inside the 60 s
+    box (host backends: per-history p90 must beat the box too; the batched
+    device backend is timed per warm batch).  Early-exits a backend after
+    its first unsolved bucket (cost is monotone in ops)."""
+    from qsm_tpu.models import AtomicCasSUT, CasSpec, QueueSpec, RacyCasSUT
+    from qsm_tpu.models.queue import AtomicQueueSUT, RacyTwoPhaseQueueSUT
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.ops.segdc import SegDC
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+    from qsm_tpu.utils.corpus import build_corpus as shared
+
+    box_s = 60.0
+    n_sample = 16 if on_tpu else 8
+    buckets = (12, 24, 48, 64)
+
+    def host_cell(backend, spec, corpus):
+        times, verds = [], []
+        t0 = time.perf_counter()
+        for h in corpus:
+            t1 = time.perf_counter()
+            verds.append(int(backend.check_histories(spec, [h])[0]))
+            times.append(time.perf_counter() - t1)
+            if time.perf_counter() - t0 > box_s:
+                break
+        und = sum(1 for v in verds if v == 2)
+        p90 = float(np.percentile(times, 90)) if times else float("inf")
+        return {
+            "attempted": len(times), "of": len(corpus), "undecided": und,
+            "median_s": round(float(np.median(times)), 4) if times else None,
+            "p90_s": round(p90, 4) if times else None,
+            "total_s": round(time.perf_counter() - t0, 2),
+            "solved": (len(times) == len(corpus) and und == 0
+                       and p90 <= box_s),
+        }
+
+    def device_cell(make_backend, spec, corpus):
+        b = make_backend(spec)
+        # one big chunk: sweep cells sit in the smallest batch bucket, so
+        # the escalating schedule would only multiply compiles (a real
+        # concern inside a short TPU healing window); for combinators
+        # (SegDC) the JaxTPU lives at .inner — patching the wrapper would
+        # be a silent no-op
+        getattr(b, "inner", b).CHUNK_SCHEDULE = (65536,)
+        t0 = time.perf_counter()
+        b.check_histories(spec, corpus)
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        v = b.check_histories(spec, corpus)
+        warm = time.perf_counter() - t0
+        und = int((v == 2).sum())
+        return {
+            "attempted": len(corpus), "of": len(corpus), "undecided": und,
+            "batch_warm_s": round(warm, 3),
+            "batch_first_s": round(first, 2),
+            "per_history_s": round(warm / len(corpus), 4),
+            "solved": und == 0 and warm <= box_s,
+        }
+
+    # queue has no scalar step table; on the host-CPU fallback the lockstep
+    # loop pays vmapped step_jax per iteration, so cap the per-lane budget
+    # to keep cells bounded — BUDGET_EXCEEDED lanes then report honestly
+    q_kw = (dict() if on_tpu
+            else dict(budget=2_000, mid_budget=10_000, rescue_budget=100_000))
+    configs = {
+        "cas": (CasSpec, (AtomicCasSUT, RacyCasSUT), {
+            "oracle": lambda s: WingGongCPU(node_budget=5_000_000),
+            "memo": lambda s: WingGongCPU(memo=True),
+            "device": lambda s: JaxTPU(s),
+        }),
+        "queue": (QueueSpec, (AtomicQueueSUT, RacyTwoPhaseQueueSUT), {
+            "oracle": lambda s: WingGongCPU(node_budget=5_000_000),
+            "memo": lambda s: WingGongCPU(memo=True),
+            "device": lambda s: JaxTPU(s, **q_kw),
+            "segdc_device": lambda s: SegDC(
+                s, make_inner=lambda x: JaxTPU(x, **q_kw)),
+        }),
+    }
+
+    cells: dict = {}
+    solved: dict = {}
+    for cname, (mk_spec, suts, backends) in configs.items():
+        spec = mk_spec()
+        corpora = {}
+        cells[cname] = {}
+        solved[cname] = {}
+        for bname, mk in backends.items():
+            cells[cname][bname] = {}
+            best = 0
+            for ops in buckets:
+                if ops not in corpora:
+                    corpora[ops] = shared(spec, suts, n=n_sample, n_pids=8,
+                                          max_ops=ops, seed_base=1000,
+                                          seed_prefix="sweep")
+                corpus = corpora[ops]
+                is_device = bname in ("device", "segdc_device")
+                cell = (device_cell if is_device else host_cell)(
+                    mk if is_device else mk(spec), spec, corpus)
+                cells[cname][bname][str(ops)] = cell
+                if cell["solved"]:
+                    best = ops
+                else:
+                    break  # monotone: larger buckets only get harder
+            solved[cname][bname] = best
+    return {"solved": solved, "cells": cells, "sample": n_sample,
+            "box_s": box_s, "pids": 8}
+
+
 def build_corpus(spec, n_unique: int):
     from qsm_tpu.models import AtomicCasSUT, RacyCasSUT
     from qsm_tpu.utils.corpus import build_corpus as shared
@@ -94,7 +239,8 @@ def build_corpus(spec, n_unique: int):
                   seed_prefix="bench")
 
 
-def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None):
+def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
+              sweep: bool = True):
     from qsm_tpu.models import CasSpec
     from qsm_tpu.ops.jax_kernel import JaxTPU
     from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
@@ -171,6 +317,15 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None):
     mismatches = len(wrong(cpu_verdicts, dev_verdicts)
                      | wrong(memo_verdicts, dev_verdicts))
 
+    sweep_extras = {}
+    if sweep:
+        try:
+            sw = run_sweep(on_tpu)
+            sweep_extras = {"max_ops_solved_60s": sw["solved"],
+                            "max_ops_sweep": sw}
+        except Exception as e:  # noqa: BLE001 — the headline must survive
+            sweep_extras = {"sweep_error": f"{type(e).__name__}: {e}"}
+
     import jax
     return {
         "metric": f"histories_per_sec_linearized_{N_OPS}ops_x_{N_PIDS}pids",
@@ -201,6 +356,7 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None):
                 else None),
             "wrong_verdicts_on_sample": mismatches,
             "corpus_gen_sec": round(gen_s, 1),
+            **sweep_extras,
         },
     }
 
@@ -218,6 +374,8 @@ def main(argv=None) -> int:
                     help="extra spaced probe attempts if the first fails")
     ap.add_argument("--retry-interval", type=float, default=30.0,
                     help="seconds between probe retries")
+    ap.add_argument("--no-sweep", action="store_true",
+                    help="skip the max-ops-solved-60s sweep")
     args = ap.parse_args(argv)
 
     from qsm_tpu.utils.device import force_cpu_platform, probe_default_backend
@@ -243,10 +401,23 @@ def main(argv=None) -> int:
                 if on_tpu:
                     break
     if not on_tpu:
+        # the watcher may have caught a healed-tunnel window earlier in the
+        # round and cached a REAL device run; that measured line is the
+        # round's headline, with at-bench-time probe state in extras
+        window = None if args.force_cpu else _load_window_artifact()
+        if window is not None:
+            ex = window.setdefault("extras", {})
+            ex["headline_from_cached_window"] = True
+            ex["window_captured_iso"] = window.pop("captured_iso", None)
+            ex["tpu_probe_at_bench_time"] = probe_detail
+            ex["probe_attempts"] = _probe_attempts_summary()
+            print(json.dumps(window))
+            return 0
         force_cpu_platform()
 
     try:
-        result = run_bench(on_tpu, probe_detail, args.profile)
+        result = run_bench(on_tpu, probe_detail, args.profile,
+                           sweep=not args.no_sweep)
     except Exception as e:  # noqa: BLE001 — diagnostic JSON, never a bare crash
         print(json.dumps({
             "metric": f"histories_per_sec_linearized_{N_OPS}ops_x_{N_PIDS}"

@@ -48,12 +48,16 @@ def init_distributed(coordinator_address: Optional[str] = None,
                            or os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if not coordinator_address:
         return False
+    # explicit args win over env even when falsy: process_id=0 IS the
+    # coordinator's valid rank, `or` would silently hand it the env value
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
-        num_processes=int(num_processes
-                          or os.environ.get("JAX_NUM_PROCESSES", "1")),
-        process_id=int(process_id
-                       or os.environ.get("JAX_PROCESS_ID", "0")))
+        num_processes=int(num_processes),
+        process_id=int(process_id))
     return True
 
 

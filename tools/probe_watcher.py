@@ -1,16 +1,24 @@
 """Round-long TPU probe watcher (VERDICT.md round 2, "Next round" #1).
 
-The chip tunnel has been wedged at bench time in both prior rounds; a single
-probe at the end of a round forfeits any healing window.  This watcher runs in
-the background for the whole round, probing the default backend from a bounded
-subprocess every ``--interval`` seconds and appending one JSON line per
-attempt to ``probe_log.jsonl``:
+The chip tunnel has been wedged at bench time in every prior round, but it
+HEALS IN WINDOWS: round 3's first probe found a live ``TPU v5 lite0`` that
+was gone again 11 minutes later.  Logging probes is therefore not enough —
+the watcher must *seize* a window the moment one opens:
 
-    {"ts": <unix>, "iso": "...", "ok": bool, "platform": "...", "detail": "..."}
+* probe the default backend from a bounded subprocess every ``--interval``
+  seconds (default 180 s: the one observed window was shorter than the old
+  600 s interval), appending one JSON line per attempt to
+  ``probe_log.jsonl``;
+* on a successful device probe, immediately run ``python bench.py``
+  (itself probe-guarded and hang-proof) in a bounded subprocess and — if it
+  really ran on the device — save its JSON line to
+  ``BENCH_TPU_WINDOW.json``.  ``bench.py`` uses that cached artifact as the
+  round's headline when the tunnel is wedged again at bench time, with full
+  provenance in ``extras``.
 
-``bench.py`` reads this log at bench time and reports every attempt in
-``extras.probe_attempts`` so the round's BENCH artifact reflects the *best*
-probe of the round, not one instant.
+Every attempt (probe or window bench) is one JSON line in the log, so the
+round's BENCH artifact reflects the best probe of the round, not one
+instant.
 """
 
 from __future__ import annotations
@@ -18,6 +26,8 @@ from __future__ import annotations
 import argparse
 import datetime
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -25,29 +35,104 @@ sys.path.insert(0, "/root/repo")
 
 from qsm_tpu.utils.device import probe_default_backend  # noqa: E402
 
-LOG = "/root/repo/probe_log.jsonl"
+REPO = "/root/repo"
+LOG = os.path.join(REPO, "probe_log.jsonl")
+WINDOW_ARTIFACT = os.path.join(REPO, "BENCH_TPU_WINDOW.json")
+
+
+def _log(**rec) -> None:
+    rec.setdefault("ts", round(time.time(), 1))
+    rec.setdefault("iso", datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds"))
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _run_window_bench(bench_timeout: float, extra_args, label: str) -> bool:
+    """One bounded bench.py run; writes the artifact iff it really ran on
+    the device.  Returns True on a captured device line."""
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--probe-timeout", "45", "--retries", "1",
+             "--retry-interval", "15", *extra_args],
+            capture_output=True, text=True, timeout=bench_timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        _log(event=label, ok=False,
+             detail=f"bench exceeded {bench_timeout:.0f}s (window closed "
+                    "mid-run?)")
+        return False
+    line = (r.stdout or "").strip().splitlines()
+    try:
+        result = json.loads(line[-1]) if line else {}
+    except ValueError:
+        result = {}
+    # a cached-window ECHO is not a device run: when the spawned bench's
+    # own probe finds the tunnel wedged it reprints the existing artifact
+    # (rc 0, device_fallback None) — accepting that would refresh the
+    # artifact's mtime/captured_iso forever and defeat every staleness
+    # guard, so reject anything marked headline_from_cached_window
+    on_device = (r.returncode == 0 and result
+                 and result.get("extras", {}).get("device_fallback") is None
+                 and not result.get("extras", {}).get(
+                     "headline_from_cached_window")
+                 and not result.get("error"))
+    _log(event=label, ok=bool(on_device),
+         rc=r.returncode, seconds=round(time.time() - t0, 1),
+         detail=(result.get("extras", {}).get("device", "")
+                 if result else (r.stderr or "")[-200:]))
+    if on_device:
+        result["captured_iso"] = datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds")
+        with open(WINDOW_ARTIFACT, "w") as f:
+            json.dump(result, f)
+    return bool(on_device)
+
+
+def _seize_window(bench_timeout: float) -> bool:
+    """The tunnel just answered: bank a headline-only device line FIRST
+    (sweep-free, fast), then try to upgrade it with the sweep-inclusive
+    full run.  If the window closes mid-sweep the headline capture
+    survives — a killed subprocess's stdout is gone, so never stake the
+    round's only real-chip artifact on the longest run."""
+    banked = _run_window_bench(bench_timeout / 2, ["--no-sweep"],
+                               "window_bench_headline")
+    # only chase the sweep upgrade while the window is demonstrably open;
+    # a failed bank means the flicker closed — running the full sweep on
+    # the CPU fallback would block probing for up to bench_timeout
+    upgraded = banked and _run_window_bench(bench_timeout, [],
+                                            "window_bench_full")
+    return banked or upgraded
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--interval", type=float, default=600.0)
-    ap.add_argument("--timeout", type=float, default=60.0)
+    ap.add_argument("--interval", type=float, default=180.0)
+    ap.add_argument("--timeout", type=float, default=45.0)
+    ap.add_argument("--bench-timeout", type=float, default=1800.0)
     ap.add_argument("--once", action="store_true")
+    ap.add_argument("--no-bench", action="store_true",
+                    help="log probes only; never launch the window bench")
     args = ap.parse_args()
     while True:
         t0 = time.time()
         p = probe_default_backend(args.timeout)
-        rec = {
-            "ts": round(t0, 1),
-            "iso": datetime.datetime.now(datetime.timezone.utc).isoformat(
-                timespec="seconds"),
-            "ok": p.ok,
-            "is_device": p.is_device,
-            "platform": p.platform,
-            "detail": p.detail[:300],
-        }
-        with open(LOG, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        _log(ok=p.ok, is_device=p.is_device, platform=p.platform,
+             detail=p.detail[:300])
+        if p.is_device and not args.no_bench:
+            # re-bench when there is no FRESH capture: the repo (and this
+            # gitignored artifact) persists across rounds, so "exists"
+            # alone would let a previous round's file suppress this
+            # round's only seize; a ≤3 h-old capture is left alone (the
+            # first full-scale device artifact is the round's prize,
+            # later windows are logged by the probes either way)
+            try:
+                age = time.time() - os.path.getmtime(WINDOW_ARTIFACT)
+            except OSError:
+                age = float("inf")
+            if age > 3 * 3600.0:
+                _seize_window(args.bench_timeout)
         if args.once:
             return 0 if p.is_device else 1
         time.sleep(max(1.0, args.interval - (time.time() - t0)))
