@@ -278,6 +278,30 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
     memo_verdicts = memo.check_histories(spec, corpus)
     memo_rate = len(corpus) / (time.perf_counter() - t0)
 
+    # --- native C++ host checker (qsm_tpu/native) ------------------------
+    # Reported as an extra, not as vs_best_cpu's denominator: the metric
+    # table pins vs_best_cpu to the memoised Python oracle (BASELINE.md),
+    # and moving the goalpost mid-series would make rounds incomparable.
+    cpp_rate = None
+    cpp_wrong = None
+    try:
+        from qsm_tpu.native import CppOracle, native_available
+
+        if native_available():
+            cpp = CppOracle(spec)
+            cpp.check_histories(spec, corpus)  # lib build + table compile
+            t0 = time.perf_counter()
+            cpp_verdicts = cpp.check_histories(spec, corpus)
+            # a rate measured on the Python fallback is NOT a native rate —
+            # only report when the native path really decided the corpus
+            if cpp.native_histories > 0:
+                cpp_rate = round(len(corpus) / (time.perf_counter() - t0), 1)
+                cpp_wrong = int(np.sum(
+                    (cpp_verdicts != 2) & (memo_verdicts != 2)
+                    & (cpp_verdicts != memo_verdicts)))
+    except Exception:  # noqa: BLE001 — optional fast path, never the bench
+        pass
+
     # --- device kernel ---------------------------------------------------
     # Bounded per-history iteration budget keeps batch latency flat; the
     # rare blowups report BUDGET_EXCEEDED and are excluded from the decided
@@ -337,6 +361,8 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
             "cpu_oracle_rate": round(cpu_rate, 3),
             "cpu_oracle_median_s": round(float(np.median(cpu_times)), 4),
             "cpu_memo_oracle_rate": round(memo_rate, 1),
+            "cpp_oracle_rate": cpp_rate,
+            "cpp_wrong_vs_memo": cpp_wrong,
             "cpu_sample": len(cpu_verdicts),
             "corpus_unique": len(corpus),
             "device": str(jax.devices()[0]),

@@ -1,0 +1,100 @@
+"""Native (C++) host lineariser — build, bind, route.
+
+The reference is pure Haskell with no native components (SURVEY.md §2a);
+this module is OUR framework's native runtime piece, the designated C++
+fast path for the host-side hot loop the survey anticipated.  The TPU
+kernel (ops/jax_kernel.py) remains the accelerator path; this is the host
+checker plane: a drop-in ``LineariseBackend`` that decides scalar-state
+specs 1-2 orders of magnitude faster than the pure-Python oracle, used
+anywhere the oracle is hot (BUDGET_EXCEEDED resolution, SegDC final
+segments on hosts without a chip, parity sweeps).
+
+Build story: ``g++ -O2 -shared -fPIC`` at first use, cached next to the
+source keyed by a source hash; ctypes bindings (pybind11 is not in the
+image — the build instructions name ctypes as the binding path).  When no
+toolchain is available the router reports unavailability and callers fall
+back to the Python oracle — never a hard failure.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "wg.cpp")
+
+_lib = None
+_lib_error: Optional[str] = None
+
+
+def _build_lib_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(tempfile.gettempdir(),
+                        f"qsm_wg_{digest}_{sys.version_info[0]}.so")
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def native_error() -> Optional[str]:
+    get_lib()
+    return _lib_error
+
+
+def get_lib():
+    """Compile (once) and load the native lineariser; None if impossible."""
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    so_path = _build_lib_path()
+    if not os.path.exists(so_path):
+        # process-unique temp output: concurrent first-use compiles (e.g.
+        # the watcher's window bench racing an operator CLI run) must not
+        # interleave writes into one .tmp and install a corrupt library
+        tmp = f"{so_path}.{os.getpid()}.tmp"
+        try:
+            r = subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 "-o", tmp, _SRC],
+                capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            _lib_error = f"g++ unavailable: {e!r}"
+            return None
+        if r.returncode != 0:
+            _lib_error = f"compile failed: {r.stderr[-400:]}"
+            return None
+        os.replace(tmp, so_path)
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError as e:
+        _lib_error = f"dlopen failed: {e!r}"
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.wg_check_batch.restype = ctypes.c_longlong
+    lib.wg_check_batch.argtypes = [
+        ctypes.c_int, i64p,                 # n_hist, offsets
+        i32p, i32p, i32p, u8p, u64p,        # cmd, arg, resp, pending, blockers
+        i32p, u8p,                          # trans, ok
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,  # S C A R
+        i32p,                               # n_resps
+        i32p, ctypes.c_longlong, ctypes.c_int,  # init_states, budget, memo
+        i32p,                               # out_verdicts
+    ]
+    _lib = lib
+    return _lib
+
+
+from .oracle import CppOracle  # noqa: E402  (needs get_lib defined)
+
+__all__ = ["CppOracle", "get_lib", "native_available", "native_error"]

@@ -1,0 +1,169 @@
+"""``CppOracle`` — the native host checker (LineariseBackend).
+
+Routes scalar-state histories whose args are inside the declared command
+domains to the C++ Wing–Gong DFS (wg.cpp, same candidate order / budget /
+memo semantics as the Python oracle); everything else — vector-state
+specs, out-of-domain args, missing toolchain — falls back to the Python
+oracle, so verdicts are always available and always exact.
+
+Out-of-domain RESPONSES (SUTs can return anything; args come from the
+generator) are handled without fallback: a recorded response outside
+``[0, n_resps)`` can never be stepped ok by the domain table, which is
+exactly the Python oracle's outcome whenever ``step_py`` rejects every
+out-of-domain response — true of all in-tree scalar specs, and pinned by
+the parity suite (tests/test_native.py).  To stay exact for arbitrary
+future specs, such histories are routed to the fallback too.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.history import History
+from ..core.spec import Spec, compile_step_table
+from ..ops.backend import Verdict
+from ..ops.wing_gong_cpu import WingGongCPU
+
+_MAX_OPS = 64  # one uint64 taken mask; the encoder's bucket cap
+
+
+class CppOracle:
+    """Batched native Wing–Gong checker for scalar-state specs."""
+
+    name = "cpp_oracle"
+
+    def __init__(self, spec: Spec, node_budget: int = 50_000_000,
+                 memo: bool = True,
+                 fallback: Optional[WingGongCPU] = None):
+        from . import get_lib
+
+        self.spec = spec
+        self.node_budget = node_budget
+        self.memo = memo
+        self.fallback = fallback or WingGongCPU(node_budget=node_budget,
+                                                memo=memo)
+        self._lib = get_lib()
+        self._tables = {}  # state bound -> (trans, ok)
+        self.nodes_explored = 0
+        self.native_histories = 0
+        self.fallback_histories = 0
+
+    # ------------------------------------------------------------------
+    def _native_ok(self, h: History) -> bool:
+        if self._lib is None or self.spec.STATE_DIM != 1:
+            return False
+        if len(h) > _MAX_OPS:
+            return False
+        if self.spec.scalar_state_bound(max(len(h), 1)) is None:
+            return False
+        for o in h.ops:
+            sig_ok = (0 <= o.cmd < self.spec.n_cmds
+                      and 0 <= o.arg < self.spec.CMDS[o.cmd].n_args)
+            if not sig_ok:
+                return False
+            if not o.is_pending and not (
+                    0 <= o.resp < self.spec.CMDS[o.cmd].n_resps):
+                return False  # stay exact for arbitrary specs (docstring)
+        return True
+
+    def _table(self, bound: int):
+        tab = self._tables.get(bound)
+        if tab is None:
+            trans, ok = compile_step_table(self.spec, bound)
+            # clip transitions into [0, bound): a broken bound contract
+            # would otherwise index out of the table in C++; the clip makes
+            # it a wrong-but-bounded row, and the bound contract itself is
+            # pinned by tests/test_models.py-style exhaustive checks
+            trans = np.clip(np.ascontiguousarray(trans, np.int32),
+                            0, bound - 1)
+            ok = np.ascontiguousarray(ok, np.uint8)
+            self._tables[bound] = (trans, ok)
+        return self._tables[bound]
+
+    # ------------------------------------------------------------------
+    def check_histories(self, spec: Spec, histories: Sequence[History],
+                        init_states: Optional[Sequence] = None
+                        ) -> np.ndarray:
+        assert spec is self.spec, "CppOracle is bound to one spec"
+        out = np.empty(len(histories), np.int8)
+        native_idx: List[int] = []
+        fb_idx: List[int] = []
+        for i, h in enumerate(histories):
+            (native_idx if self._native_ok(h) else fb_idx).append(i)
+
+        if native_idx:
+            self._run_native(histories, native_idx, init_states, out)
+            self.native_histories += len(native_idx)
+        for i in fb_idx:
+            init = None if init_states is None else init_states[i]
+            if init is None:
+                out[i] = self.fallback.check_histories(
+                    spec, [histories[i]])[0]
+            else:
+                out[i] = int(self.fallback.check_from(
+                    spec, histories[i], np.asarray(init)))
+            self.fallback_histories += 1
+        return out
+
+    def check_from(self, spec: Spec, history: History, init_state) -> Verdict:
+        v = self.check_histories(spec, [history], init_states=[init_state])
+        return Verdict(int(v[0]))
+
+    # ------------------------------------------------------------------
+    def _run_native(self, histories, idx, init_states, out) -> None:
+        spec = self.spec
+        max_len = max(len(histories[i]) for i in idx)
+        bound = spec.scalar_state_bound(max(max_len, 1))
+        trans, ok = self._table(bound)
+        S, C, A, R = trans.shape
+
+        total = sum(len(histories[i]) for i in idx)
+        offsets = np.zeros(len(idx) + 1, np.int64)
+        cmd = np.empty(total, np.int32)
+        arg = np.empty(total, np.int32)
+        resp = np.empty(total, np.int32)
+        pending = np.empty(total, np.uint8)
+        blockers = np.empty(total, np.uint64)
+        inits = np.empty(len(idx), np.int32)
+        default_init = int(np.asarray(spec.initial_state())[0])
+        pos = 0
+        for k, i in enumerate(idx):
+            h = histories[i]
+            n = len(h)
+            offsets[k + 1] = pos + n
+            bit = np.uint64(1) << np.arange(n, dtype=np.uint64)
+            prec = h.precedes_matrix().astype(bool)
+            for j, o in enumerate(h.ops):
+                cmd[pos + j] = o.cmd
+                arg[pos + j] = o.arg
+                resp[pos + j] = 0 if o.is_pending else o.resp
+                pending[pos + j] = 1 if o.is_pending else 0
+                blockers[pos + j] = np.bitwise_or.reduce(
+                    bit[prec[:, j]]) if prec[:, j].any() else np.uint64(0)
+            inits[k] = (default_init if init_states is None
+                        or init_states[i] is None
+                        else int(np.asarray(init_states[i])[0]))
+            pos += n
+
+        n_resps = np.asarray([c.n_resps for c in spec.CMDS], np.int32)
+        verdicts = np.empty(len(idx), np.int32)
+
+        def p(a, ty):
+            return a.ctypes.data_as(ctypes.POINTER(ty))
+
+        nodes = self._lib.wg_check_batch(
+            len(idx), p(offsets, ctypes.c_int64),
+            p(cmd, ctypes.c_int32), p(arg, ctypes.c_int32),
+            p(resp, ctypes.c_int32), p(pending, ctypes.c_uint8),
+            p(blockers, ctypes.c_uint64),
+            p(trans, ctypes.c_int32), p(ok, ctypes.c_uint8),
+            S, C, A, R, p(n_resps, ctypes.c_int32),
+            p(inits, ctypes.c_int32),
+            self.node_budget, 1 if self.memo else 0,
+            p(verdicts, ctypes.c_int32))
+        self.nodes_explored += int(nodes)
+        for k, i in enumerate(idx):
+            out[i] = verdicts[k]

@@ -1,0 +1,154 @@
+// Native Wing–Gong lineariser — the C++ fast path of the host checker
+// plane (SURVEY.md §2a names a C++ extension as the designated fallback for
+// host-side hot loops; the reference itself is pure Haskell with no native
+// code, so this is OUR runtime component, not a port).
+//
+// Faithful to qsm_tpu/ops/wing_gong_cpu.py::WingGongCPU._check: identical
+// candidate order (ops ascending, responses ascending), identical node
+// budget accounting (one unit per step evaluation), identical memo
+// semantics (configurations (taken-set, state) proven non-linearizable-
+// from), identical pending-op treatment (a pending op may linearise with
+// ANY response in its command's domain, or never).  Verdict codes match
+// ops/backend.py::Verdict: 0 VIOLATION, 1 LINEARIZABLE, 2 BUDGET_EXCEEDED.
+//
+// Scope: scalar-state specs with a declared state bound (the step function
+// arrives as the dense [S][C][A][R] domain table compiled by
+// core/spec.py::compile_step_table).  Vector-state specs stay on the
+// Python oracle — the Python side routes them (native/__init__.py).
+//
+// Histories are capped at 64 ops (the encoder's bucket cap), so the taken
+// set is one uint64 and precedence is a per-op blocker bitmask.
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_set>
+#include <utility>
+
+namespace {
+
+// Exact memo key: the 64-op taken mask plus the scalar model state — an
+// exact pair, no packing tricks, no collision risk.  (__int128 would pack
+// both, but libstdc++'s hash-table traits reject it under -std=c++17.)
+using Key = std::pair<uint64_t, uint64_t>;
+
+struct KeyHash {
+    size_t operator()(const Key& k) const {
+        // splitmix64 over both halves
+        auto mix = [](uint64_t x) {
+            x += 0x9E3779B97F4A7C15ull;
+            x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+            x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+            return x ^ (x >> 31);
+        };
+        return mix(k.first) ^ (mix(k.second) * 0x9E3779B9ull);
+    }
+};
+
+struct Ctx {
+    int n;
+    const int32_t* cmd;
+    const int32_t* arg;
+    const int32_t* resp;
+    const uint8_t* pending;
+    const uint64_t* blockers;
+    const int32_t* trans;   // [S][C][A][R]
+    const uint8_t* ok;      // [S][C][A][R]
+    int S, C, A, R;
+    const int32_t* n_resps; // per command
+    int n_required;
+    long long budget;
+    long long nodes;
+    bool use_memo;
+    std::unordered_set<Key, KeyHash>* seen;
+};
+
+static inline Key key_of(uint64_t taken, int state) {
+    return {taken, static_cast<uint64_t>(static_cast<uint32_t>(state))};
+}
+
+static inline int step_idx(const Ctx& c, int s, int cm, int a, int r) {
+    return ((s * c.C + cm) * c.A + a) * c.R + r;
+}
+
+// returns Verdict {0, 1, 2}
+static int dfs(Ctx& c, uint64_t taken, int state, int got_required) {
+    if (got_required == c.n_required) return 1;
+    if (c.budget <= 0) return 2;
+    Key key{};
+    if (c.use_memo) {
+        key = key_of(taken, state);
+        if (c.seen->count(key)) return 0;
+    }
+    bool saw_budget = false;
+    for (int j = 0; j < c.n; ++j) {
+        if (taken >> j & 1) continue;
+        if (c.blockers[j] & ~taken) continue;  // an untaken op precedes j
+        const int cm = c.cmd[j], a = c.arg[j];
+        const bool pend = c.pending[j];
+        const int r_lo = pend ? 0 : c.resp[j];
+        const int r_hi = pend ? c.n_resps[cm] : c.resp[j] + 1;
+        for (int r = r_lo; r < r_hi; ++r) {
+            --c.budget;
+            ++c.nodes;
+            if (c.budget <= 0) return 2;
+            const int idx = step_idx(c, state, cm, a, r);
+            if (!c.ok[idx]) continue;
+            const int sub = dfs(c, taken | (1ull << j), c.trans[idx],
+                                got_required + (pend ? 0 : 1));
+            if (sub == 1) return 1;
+            if (sub == 2) saw_budget = true;
+        }
+    }
+    if (saw_budget) return 2;
+    if (c.use_memo) c.seen->insert(key);
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decide one history.  Returns nodes explored; verdict via out param.
+long long wg_check(
+    int n, const int32_t* cmd, const int32_t* arg, const int32_t* resp,
+    const uint8_t* pending, const uint64_t* blockers,
+    const int32_t* trans, const uint8_t* ok,
+    int S, int C, int A, int R, const int32_t* n_resps,
+    int init_state, long long node_budget, int use_memo,
+    int32_t* out_verdict) {
+    int n_required = 0;
+    for (int j = 0; j < n; ++j)
+        if (!pending[j]) ++n_required;
+    std::unordered_set<Key, KeyHash> seen;
+    Ctx c{n, cmd, arg, resp, pending, blockers, trans, ok,
+          S, C, A, R, n_resps, n_required, node_budget, 0,
+          use_memo != 0, &seen};
+    *out_verdict = (n == 0) ? 1 : dfs(c, 0ull, init_state, 0);
+    return c.nodes;
+}
+
+// Decide a batch: per-history arrays are concatenated, offsets[i] is the
+// start of history i's ops, offsets[n_hist] the total.  init_states may
+// carry one scalar per history (per-lane start states for the
+// segmentation combinator).  Returns total nodes explored.
+long long wg_check_batch(
+    int n_hist, const int64_t* offsets,
+    const int32_t* cmd, const int32_t* arg, const int32_t* resp,
+    const uint8_t* pending, const uint64_t* blockers,
+    const int32_t* trans, const uint8_t* ok,
+    int S, int C, int A, int R, const int32_t* n_resps,
+    const int32_t* init_states, long long node_budget, int use_memo,
+    int32_t* out_verdicts) {
+    long long total = 0;
+    for (int i = 0; i < n_hist; ++i) {
+        const int64_t lo = offsets[i];
+        const int n = static_cast<int>(offsets[i + 1] - lo);
+        total += wg_check(n, cmd + lo, arg + lo, resp + lo, pending + lo,
+                          blockers + lo, trans, ok, S, C, A, R, n_resps,
+                          init_states[i], node_budget, use_memo,
+                          out_verdicts + i);
+    }
+    return total;
+}
+
+}  // extern "C"

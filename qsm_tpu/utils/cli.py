@@ -74,6 +74,13 @@ def _ensure_device_reachable(timeout_s: float = 45.0) -> None:
 def _make_backend(name: str, spec):
     if name == "cpu":
         return WingGongCPU(memo=True)
+    if name == "cpp":
+        from ..native import CppOracle, native_available, native_error
+
+        if not native_available():
+            raise SystemExit(f"native backend unavailable: {native_error()}\n"
+                             "use --backend cpu")
+        return CppOracle(spec)
     if name == "tpu":
         _ensure_device_reachable()
         from ..ops.jax_kernel import JaxTPU
@@ -127,7 +134,7 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--schedules", type=int, default=4,
                    help="seeded schedules per generated program")
     p.add_argument("--backend", default="cpu",
-                   choices=["cpu", "tpu", "pcomp", "pcomp-tpu", "segdc",
+                   choices=["cpu", "cpp", "tpu", "pcomp", "pcomp-tpu", "segdc",
                             "segdc-tpu"])
     _add_fault_args(p)
     p.add_argument("--log", default=None, help="JSONL log path")
@@ -159,7 +166,9 @@ def cmd_run(args) -> int:
                  trials=res.trials_run, histories=res.histories_checked,
                  undecided=res.undecided, seconds=round(dt, 3),
                  schedules=res.schedules_run,
-                 schedule_diversity=round(res.schedule_diversity, 3))
+                 schedule_diversity=round(res.schedule_diversity, 3),
+                 timings={key: round(v, 3)
+                          for key, v in sorted(res.timings.items())})
     finally:
         log.close()
     if res.ok:
@@ -285,7 +294,7 @@ def main(argv=None) -> int:
     p = sub.add_parser("bench", help="checker throughput on one model")
     p.add_argument("--model", default="cas", choices=sorted(MODELS))
     p.add_argument("--backend", default="cpu",
-                   choices=["cpu", "tpu", "pcomp", "pcomp-tpu", "segdc",
+                   choices=["cpu", "cpp", "tpu", "pcomp", "pcomp-tpu", "segdc",
                             "segdc-tpu"])
     p.add_argument("--pids", type=int, default=None)
     p.add_argument("--ops", type=int, default=None)

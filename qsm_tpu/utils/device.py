@@ -99,6 +99,37 @@ def probe_default_backend(timeout_s: float = 45.0) -> Probe:
     return Probe(True, parts[0], r.stdout.strip())
 
 
+def probe_or_force_cpu(force_cpu: bool = False,
+                       probe_timeout_s: float = 45.0):
+    """The artifact-tool preamble, in ONE place (bench.py,
+    tools/bench_configs.py, tools/bench_e2e.py all need the identical
+    sequence — diverging copies would label fallbacks differently):
+    bounded-probe the real chip unless ``force_cpu``; pin this process to
+    the CPU platform when the chip is absent.  Returns
+    ``(on_tpu, probe_detail, header)`` where ``header`` is the provenance
+    dict artifacts embed (device / device_fallback / tpu_probe / iso).
+    """
+    import datetime
+
+    if force_cpu:
+        on_tpu, detail = False, "skipped (--force-cpu)"
+    else:
+        p = probe_default_backend(probe_timeout_s)
+        on_tpu, detail = p.is_device, p.detail
+    if not on_tpu:
+        force_cpu_platform()
+    import jax
+
+    header = {
+        "iso": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "device": str(jax.devices()[0]),
+        "device_fallback": None if on_tpu else "cpu",
+        "tpu_probe": detail,
+    }
+    return on_tpu, detail, header
+
+
 def force_cpu_platform(n_devices: Optional[int] = None) -> None:
     """Force THIS process onto the JAX CPU platform (before any device use).
 

@@ -1,0 +1,123 @@
+"""Native C++ lineariser: availability (the toolchain is baked into the
+image — a compile failure must FAIL, not skip), parity with the Python
+oracle incl. pending-op fault histories and budget semantics, fallback
+routing for vector-state specs, and init-state starts (SegDC's route)."""
+
+import numpy as np
+
+from qsm_tpu import Verdict, WingGongCPU
+from qsm_tpu.models import CasSpec, AtomicCasSUT, RacyCasSUT, QueueSpec
+from qsm_tpu.models.queue import AtomicQueueSUT, RacyTwoPhaseQueueSUT
+from qsm_tpu.models.register import (AtomicRegisterSUT,
+                                     RacyCachedRegisterSUT, RegisterSpec)
+from qsm_tpu.native import CppOracle, native_available, native_error
+from qsm_tpu.utils.corpus import build_corpus
+
+
+def test_native_lib_builds():
+    assert native_available(), native_error()
+
+
+def test_parity_cas_corpus():
+    spec = CasSpec()
+    corpus = build_corpus(spec, (AtomicCasSUT, RacyCasSUT), n=96,
+                          n_pids=8, max_ops=32, seed_base=1000,
+                          seed_prefix="bench")
+    cpp = CppOracle(spec)
+    got = cpp.check_histories(spec, corpus)
+    want = WingGongCPU(memo=True).check_histories(spec, corpus)
+    np.testing.assert_array_equal(got, want)
+    assert cpp.native_histories == len(corpus)  # no silent fallback
+    assert (got == int(Verdict.VIOLATION)).any()
+    assert (got == int(Verdict.LINEARIZABLE)).any()
+
+
+def test_parity_register_with_pending_ops():
+    """Fault-injected histories carry pending ops; the C++ search must
+    complete/prune them exactly like the oracle."""
+    from qsm_tpu import generate_program, run_concurrent
+    from qsm_tpu.sched.scheduler import FaultPlan
+
+    spec = RegisterSpec(n_values=5)
+    hists = []
+    for seed in range(48):
+        prog = generate_program(spec, seed=seed, n_pids=2, max_ops=12)
+        sut = (AtomicRegisterSUT if seed % 2 else RacyCachedRegisterSUT)()
+        hists.append(run_concurrent(
+            sut, prog, seed=f"n{seed}",
+            faults=FaultPlan(p_drop=0.2, p_duplicate=0.1)))
+    assert any(h.n_pending for h in hists), "fault corpus vacuous"
+    cpp = CppOracle(spec)
+    got = cpp.check_histories(spec, hists)
+    want = WingGongCPU(memo=True).check_histories(spec, hists)
+    np.testing.assert_array_equal(got, want)
+    # out-of-domain responses route to the fallback BY DESIGN (exactness
+    # for arbitrary specs); the bulk must still go native
+    assert cpp.native_histories >= 0.8 * len(hists)
+    assert cpp.native_histories + cpp.fallback_histories == len(hists)
+
+
+def test_budget_semantics_match_oracle():
+    """Same candidate order + same node accounting -> bit-identical
+    verdicts INCLUDING which histories exceed a tiny budget."""
+    spec = CasSpec()
+    corpus = build_corpus(spec, (AtomicCasSUT, RacyCasSUT), n=48,
+                          n_pids=8, max_ops=24, seed_base=7,
+                          seed_prefix="budget")
+    for budget in (50, 500, 5_000):
+        cpp = CppOracle(spec, node_budget=budget, memo=False)
+        py = WingGongCPU(node_budget=budget)
+        np.testing.assert_array_equal(
+            cpp.check_histories(spec, corpus),
+            py.check_histories(spec, corpus), err_msg=f"budget={budget}")
+
+
+def test_vector_state_spec_routes_to_fallback():
+    spec = QueueSpec()
+    corpus = build_corpus(spec, (AtomicQueueSUT, RacyTwoPhaseQueueSUT),
+                          n=16, n_pids=4, max_ops=16, seed_base=3,
+                          seed_prefix="fb")
+    cpp = CppOracle(spec)
+    got = cpp.check_histories(spec, corpus)
+    want = WingGongCPU(memo=True).check_histories(spec, corpus)
+    np.testing.assert_array_equal(got, want)
+    assert cpp.native_histories == 0
+    assert cpp.fallback_histories == len(corpus)
+
+
+def test_check_from_init_state():
+    """SegDC's final-segment route: start the search from explicit model
+    states; parity with the Python oracle's check_from."""
+    from qsm_tpu import overlapping_history
+
+    spec = RegisterSpec(n_values=5)
+    READ, WRITE = 0, 1
+    h = overlapping_history([(0, READ, 0, 3, 0, 1)])
+    cpp = CppOracle(spec)
+    py = WingGongCPU(memo=True)
+    for s in range(5):
+        state = np.asarray([s], np.int32)
+        assert (cpp.check_from(spec, h, state)
+                == py.check_from(spec, h, state)), s
+
+
+def test_native_is_actually_fast():
+    """Not a perf assertion in CI — just a sanity floor: the native path
+    must decide the CAS bench corpus well under the Python oracle's time
+    (catches accidentally routing everything to the fallback)."""
+    import time
+
+    spec = CasSpec()
+    corpus = build_corpus(spec, (AtomicCasSUT, RacyCasSUT), n=96,
+                          n_pids=8, max_ops=32, seed_base=1000,
+                          seed_prefix="bench")
+    cpp = CppOracle(spec)
+    cpp.check_histories(spec, corpus)  # table build + lib load
+    t0 = time.perf_counter()
+    cpp.check_histories(spec, corpus)
+    cpp_s = time.perf_counter() - t0
+    py = WingGongCPU(memo=True)
+    t0 = time.perf_counter()
+    py.check_histories(spec, corpus)
+    py_s = time.perf_counter() - t0
+    assert cpp_s < py_s, (cpp_s, py_s)

@@ -1,0 +1,127 @@
+"""Per-config benchmark artifact — one JSON line per BASELINE config
+(VERDICT.md round 2, "Next round" #7; BASELINE.json:6-12).
+
+For each of the five model configs at full default size, measures
+histories/sec for the memoised host oracle and for the config's natural
+device path (JaxTPU for scalar-state specs; SegDC(JaxTPU) for queue-48;
+PComp(JaxTPU) for multi-key KV-64), with verdict-parity accounting.
+
+Probe-guarded exactly like bench.py: real chip when the tunnel answers,
+honestly-labelled CPU fallback otherwise.  Usage:
+
+    python tools/bench_configs.py [--force-cpu] [--out BENCH_CONFIGS_rN.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def _backends_for(model: str, spec, on_tpu: bool):
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.ops.pcomp import PComp
+    from qsm_tpu.ops.segdc import SegDC
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+
+    # host fallback pays vmapped-step lockstep iterations for vector-state
+    # specs (no scalar step table) — cap their budgets so the artifact run
+    # stays bounded; the real chip gets the full defaults
+    vec_kw = (dict() if on_tpu
+              else dict(budget=2_000, mid_budget=10_000,
+                        rescue_budget=100_000))
+    if model == "kv":
+        # the UNdecomposed memo oracle on 16-pid × 64-op multi-key
+        # histories is exponential in practice (it ran >5 min on this
+        # corpus) — per-key P-compositionality is the only sane host
+        # checker at this size, so that is the honest host comparator
+        return {
+            "memo": PComp(spec),  # pcomp(memo)
+            "device": PComp(spec, make_inner=lambda s: JaxTPU(s, **vec_kw)),
+        }
+    out = {"memo": WingGongCPU(memo=True)}
+    if model == "queue":
+        out["device"] = SegDC(spec,
+                              make_inner=lambda s: JaxTPU(s, **vec_kw))
+    else:
+        out["device"] = JaxTPU(spec)
+    return out
+
+
+def bench_config(model: str, on_tpu: bool, n_corpus: int) -> dict:
+    from qsm_tpu.models.registry import MODELS
+    from qsm_tpu.utils.corpus import build_corpus
+
+    entry = MODELS[model]
+    spec = entry.make_spec()
+    suts = (entry.impls["atomic"], entry.impls["racy"])
+    t0 = time.perf_counter()
+    corpus = build_corpus(spec, suts, n=n_corpus,
+                          n_pids=entry.default_pids,
+                          max_ops=entry.default_ops,
+                          seed_base=1000, seed_prefix="bench")
+    gen_s = time.perf_counter() - t0
+
+    rec = {"model": model, "pids": entry.default_pids,
+           "ops": entry.default_ops, "corpus": len(corpus),
+           "corpus_gen_s": round(gen_s, 1), "backends": {}}
+    verdicts = {}
+    for bname, backend in _backends_for(model, spec, on_tpu).items():
+        if "device" in bname:
+            # warmup = compile; host oracles have nothing to warm (the
+            # memo cache is per-history, per-call) and the double pass
+            # would just double the artifact's wall-clock
+            backend.check_histories(spec, corpus)
+        t0 = time.perf_counter()
+        v = backend.check_histories(spec, corpus)
+        dt = time.perf_counter() - t0
+        verdicts[bname] = np.asarray(v)
+        undecided = int((v == 2).sum())
+        rec["backends"][bname] = {
+            "name": backend.name,
+            "histories_per_sec": round((len(corpus) - undecided)
+                                       / max(dt, 1e-9), 1),
+            "seconds": round(dt, 3),
+            "undecided": undecided,
+        }
+    # wrong verdicts: both sides decided, disagreed (BUDGET is honest)
+    m, d = verdicts["memo"], verdicts["device"]
+    both = (m != 2) & (d != 2)
+    rec["wrong_verdicts"] = int(((m != d) & both).sum())
+    rec["violations_in_corpus"] = int((m == 0).sum())
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/root/repo/BENCH_CONFIGS_r03.json")
+    ap.add_argument("--force-cpu", action="store_true")
+    ap.add_argument("--probe-timeout", type=float, default=45.0)
+    ap.add_argument("--corpus", type=int, default=None,
+                    help="override corpus size (default 128 cpu / 256 tpu)")
+    args = ap.parse_args(argv)
+
+    from qsm_tpu.utils.device import probe_or_force_cpu
+
+    on_tpu, _detail, header = probe_or_force_cpu(args.force_cpu,
+                                                 args.probe_timeout)
+    n_corpus = args.corpus or (256 if on_tpu else 128)
+    lines = [{"artifact": "bench_configs", **header}]
+    for model in ("register", "ticket", "cas", "queue", "kv"):
+        rec = bench_config(model, on_tpu, n_corpus)
+        lines.append(rec)
+        print(json.dumps(rec), flush=True)
+    with open(args.out, "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,98 @@
+"""End-to-end property throughput — trials/sec and the execute/check/shrink
+wall-clock split (VERDICT.md round 2, "Next round" #8).
+
+The 100× story is about the checking workload (SURVEY.md §3.5); this
+artifact measures whether checking is actually where end-to-end time goes,
+per backend.  Two runs per backend on the CAS 32×8 config:
+
+* atomic SUT — no violation, steady-state generate/execute/check split;
+* racy SUT — finds a violation and shrinks: the shrink split shows what
+  batching shrink candidates into one backend call buys.
+
+Usage: python tools/bench_e2e.py [--force-cpu] [--out BENCH_E2E_rN.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def run_one(label: str, backend_name: str, make_backend, sut_name: str,
+            n_trials: int, trial_batch: int = 1) -> dict:
+    from qsm_tpu.core.property import PropertyConfig, prop_concurrent
+    from qsm_tpu.models.registry import make
+
+    spec, sut = make("cas", sut_name)
+    backend = make_backend(spec)
+    cfg = PropertyConfig(n_trials=n_trials, n_pids=8, max_ops=32, seed=7,
+                         schedules_per_program=4, trial_batch=trial_batch)
+    t0 = time.perf_counter()
+    res = prop_concurrent(spec, sut, cfg, backend=backend)
+    dt = time.perf_counter() - t0
+    timings = {key: round(v, 3) for key, v in sorted(res.timings.items())}
+    accounted = sum(res.timings.values())
+    return {
+        "run": label, "backend": backend_name, "sut": sut_name,
+        "ok": res.ok, "trials_run": res.trials_run,
+        "histories_checked": res.histories_checked,
+        "undecided": res.undecided,
+        "seconds": round(dt, 2),
+        "trials_per_sec": round(res.trials_run / dt, 2),
+        "histories_per_sec": round(res.histories_checked / dt, 1),
+        "timings_s": timings,
+        "timings_pct": {key: round(100 * v / max(accounted, 1e-9), 1)
+                        for key, v in sorted(res.timings.items())},
+        "shrink_steps": (res.counterexample.shrink_steps
+                         if res.counterexample else 0),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/root/repo/BENCH_E2E_r03.json")
+    ap.add_argument("--force-cpu", action="store_true")
+    ap.add_argument("--probe-timeout", type=float, default=45.0)
+    ap.add_argument("--trials", type=int, default=150)
+    args = ap.parse_args(argv)
+
+    from qsm_tpu.utils.device import probe_or_force_cpu
+
+    _on_tpu, _detail, header = probe_or_force_cpu(args.force_cpu,
+                                                  args.probe_timeout)
+
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+
+    lines = [{
+        "artifact": "bench_e2e", "config": "cas 32ops x 8pids, 4 schedules",
+        **header,
+    }]
+    backends = {
+        "memo": lambda s: WingGongCPU(memo=True),
+        "device": lambda s: JaxTPU(s),
+    }
+    # trial_batch=1 is the reference-shaped serial loop; 64 makes the
+    # device see 256-lane batches (64 trials × 4 schedules) — the grouping
+    # exists precisely because the split below showed per-call dispatch
+    # dominating the device path at batch 4
+    for bname, mk in backends.items():
+        for sut_name in ("atomic", "racy"):
+            for tb in ((1,) if bname == "memo" else (1, 64)):
+                rec = run_one(f"cas-{sut_name}", bname, mk, sut_name,
+                              args.trials, trial_batch=tb)
+                rec["trial_batch"] = tb
+                lines.append(rec)
+                print(json.dumps(rec), flush=True)
+    with open(args.out, "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

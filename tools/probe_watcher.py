@@ -98,12 +98,12 @@ def _seize_window(bench_timeout: float) -> bool:
     round's only real-chip artifact on the longest run."""
     banked = _run_window_bench(bench_timeout / 2, ["--no-sweep"],
                                "window_bench_headline")
-    # only chase the sweep upgrade while the window is demonstrably open;
-    # a failed bank means the flicker closed — running the full sweep on
-    # the CPU fallback would block probing for up to bench_timeout
-    upgraded = banked and _run_window_bench(bench_timeout, [],
-                                            "window_bench_full")
-    return banked or upgraded
+    if banked:
+        # chase the sweep upgrade only while the window is demonstrably
+        # open; after a failed bank the flicker closed — a full sweep on
+        # the CPU fallback would block probing for up to bench_timeout
+        _run_window_bench(bench_timeout, [], "window_bench_full")
+    return banked
 
 
 def main() -> int:
