@@ -57,6 +57,10 @@ class PropertyConfig:
     # first failing trial in canonical order shrinks, exactly as ungrouped —
     # later trials in its group were merely also checked).
     trial_batch: int = 1
+    # message transport for the scheduler plane: "memory" (default) or
+    # "tcp" (real loopback sockets, sched/transport.py).  Histories are
+    # bit-identical across transports — the scheduler owns ordering.
+    transport: str = "memory"
 
 
 @dataclasses.dataclass
@@ -153,9 +157,9 @@ def _resolve(spec: Spec, verdicts: np.ndarray, histories: Sequence[History],
 
 
 def _execute(sut: ConcurrentSUT, prog: Program, sched_seed: str,
-             cfg: PropertyConfig) -> History:
+             cfg: PropertyConfig, transport=None) -> History:
     return run_concurrent(sut, prog, seed=sched_seed, faults=cfg.faults,
-                          max_steps=cfg.max_steps)
+                          max_steps=cfg.max_steps, transport=transport)
 
 
 def shrink_failure(
@@ -168,6 +172,7 @@ def shrink_failure(
     history: History,
     sched_seed: str,
     timings: Optional[Dict[str, float]] = None,
+    transport=None,
 ) -> tuple[Program, History, int, int]:
     """Greedy shrink: each round, decide ALL candidates in one backend batch
     and step to the first (canonical order) still-failing one.
@@ -181,7 +186,8 @@ def shrink_failure(
         if not cands:
             break
         t0 = time.perf_counter()
-        hists = [_execute(sut, c, sched_seed, cfg) for c in cands]
+        hists = [_execute(sut, c, sched_seed, cfg, transport)
+                 for c in cands]
         t1 = time.perf_counter()
         timings["shrink_execute"] = (timings.get("shrink_execute", 0.0)
                                      + t1 - t0)
@@ -214,17 +220,35 @@ def prop_concurrent(
     # resolution path; parity tests construct the memo-less one explicitly
     oracle = oracle or WingGongCPU(memo=True)
     backend = backend or oracle
-    checked = 0
-    undecided = 0
-    schedules_run = 0
-    distinct = 0
     timings: Dict[str, float] = {}
+    # ONE transport for the whole property run: TCP endpoint connections
+    # persist across every trial/schedule/shrink execution instead of
+    # churning ephemeral ports per history (sched/transport.py)
+    transport = None
+    if cfg.transport != "memory":
+        from ..sched.transport import make_transport
+
+        transport = make_transport(cfg.transport)
 
     def _bump(key: str, t0: float) -> float:
         now = time.perf_counter()
         timings[key] = timings.get(key, 0.0) + now - t0
         return now
 
+    try:
+        return _prop_concurrent_body(
+            spec, sut, cfg, backend, oracle, transport, timings, _bump)
+    finally:
+        if transport is not None:
+            transport.close()
+
+
+def _prop_concurrent_body(spec, sut, cfg, backend, oracle, transport,
+                          timings, _bump) -> PropertyResult:
+    checked = 0
+    undecided = 0
+    schedules_run = 0
+    distinct = 0
     k = max(1, cfg.schedules_per_program)
     group_n = max(1, cfg.trial_batch)
     t = 0
@@ -247,7 +271,8 @@ def prop_concurrent(
             progs.append(prog)
             seeds_all.append(seeds)
             spans.append(len(hists_all))
-            hists_all.extend(_execute(sut, prog, sk, cfg) for sk in seeds)
+            hists_all.extend(_execute(sut, prog, sk, cfg, transport)
+                             for sk in seeds)
             _bump("execute", t0)
         t0 = time.perf_counter()
         raw = backend.check_histories(spec, hists_all)
@@ -269,7 +294,7 @@ def prop_concurrent(
             j = fail_at - spans[gi]
             mp, mh, steps, c2 = shrink_failure(
                 spec, sut, backend, oracle, cfg, progs[gi],
-                hists_all[fail_at], seeds_all[gi][j], timings)
+                hists_all[fail_at], seeds_all[gi][j], timings, transport)
             return PropertyResult(
                 ok=False, trials_run=ti + 1,
                 histories_checked=checked + c2,
@@ -302,4 +327,7 @@ def replay(
     prog = generate_program(
         spec, seed=random.Random(prog_key).randrange(1 << 62),
         n_pids=cfg.n_pids, max_ops=_trial_ops(cfg, int(t)))
-    return _execute(sut, prog, trial_seed_key, cfg)
+    # a single run: pass the transport SPEC so run_concurrent owns and
+    # closes it (histories are transport-independent either way)
+    return _execute(sut, prog, trial_seed_key, cfg,
+                    None if cfg.transport == "memory" else cfg.transport)

@@ -81,18 +81,36 @@ def prepare_run(
     seed,  # int or str; any random.Random seed value
     faults: Optional[FaultPlan] = None,
     max_steps: int = 100_000,
+    transport=None,  # None/"memory"/"tcp" or a Transport instance
 ) -> tuple:
     """(scheduler, recorder) wired up and ready to ``sched.run()``.
 
     Split out of :func:`run_concurrent` so callers that need scheduler
     internals afterwards (e.g. the delivery trace, for coverage stats) share
     the exact same run protocol."""
-    sched = Scheduler(seed=seed, faults=faults, max_steps=max_steps)
-    rec = HistoryRecorder(sched)
-    sut.setup(sched)
-    for pid, ops in enumerate(program.per_pid()):
-        if ops:
-            sched.spawn(f"client:{pid}", _client(rec, sut, pid, ops))
+    # ownership: a transport created HERE (from a string) is closed by
+    # run_concurrent's finally; a caller-passed instance stays the
+    # caller's — the property layer reuses ONE TCP transport across every
+    # execution of a run (per-endpoint connections persist, so a 50k-
+    # candidate shrink doesn't burn 50k ephemeral ports into TIME_WAIT)
+    owns = isinstance(transport, str)
+    if owns:
+        from .transport import make_transport
+
+        transport = make_transport(transport)
+    try:
+        sched = Scheduler(seed=seed, faults=faults, max_steps=max_steps,
+                          transport=transport)
+        rec = HistoryRecorder(sched)
+        sut.setup(sched)
+        for pid, ops in enumerate(program.per_pid()):
+            if ops:
+                sched.spawn(f"client:{pid}", _client(rec, sut, pid, ops))
+    except BaseException:
+        if owns and transport is not None:
+            transport.close()
+        raise
+    sched.owns_transport = owns
     return sched, rec
 
 
@@ -102,13 +120,21 @@ def run_concurrent(
     seed,
     faults: Optional[FaultPlan] = None,
     max_steps: int = 100_000,
+    transport=None,
 ) -> History:
     """Execute ``program`` concurrently; return its history.
 
     Determinism contract: identical (sut, program, seed, faults) → identical
-    History, bit for bit.  Unresponded ops (faults/wedges) come back as
-    pending ops for the lineariser to complete/prune.
+    History, bit for bit — on EVERY transport (sched/transport.py); the
+    transport carries bytes, never ordering.  Unresponded ops
+    (faults/wedges) come back as pending ops for the lineariser to
+    complete/prune.
     """
-    sched, rec = prepare_run(sut, program, seed, faults, max_steps)
-    sched.run()
+    sched, rec = prepare_run(sut, program, seed, faults, max_steps,
+                             transport=transport)
+    try:
+        sched.run()
+    finally:
+        if sched.transport is not None and sched.owns_transport:
+            sched.transport.close()
     return rec.history(seed=seed)

@@ -149,10 +149,16 @@ class Scheduler:
     """Single-threaded deterministic actor scheduler."""
 
     def __init__(self, seed: int, faults: Optional[FaultPlan] = None,
-                 max_steps: int = 100_000):
+                 max_steps: int = 100_000, transport=None):
         self.rng = random.Random(seed)
         self.faults = faults
         self.max_steps = max_steps
+        # transport carries the bytes; the scheduler keeps every ordering
+        # decision (sched/transport.py — None = in-memory, zero overhead).
+        # owns_transport: set by prepare_run when the transport was created
+        # from a string spec and should be closed with the run
+        self.transport = transport
+        self.owns_transport = False
         self.procs: Dict[str, _Proc] = {}
         self.pool: List[_InFlight] = []  # in-flight messages
         self.clock = 0  # logical event clock (history timestamps)
@@ -205,9 +211,11 @@ class Scheduler:
             p.send_value = None
             if isinstance(eff, Send):
                 self._uid += 1
-                self.pool.append(_InFlight(Message(
-                    src=p.name, dst=eff.to,
-                    payload=eff.payload, uid=self._uid)))
+                msg = Message(src=p.name, dst=eff.to,
+                              payload=eff.payload, uid=self._uid)
+                if self.transport is not None:
+                    msg = self.transport.uplink(msg)
+                self.pool.append(_InFlight(msg))
                 continue  # async send: sender keeps running
             if isinstance(eff, Recv):
                 if p.mailbox:
@@ -247,6 +255,8 @@ class Scheduler:
         dst = self.procs.get(msg.dst)
         if dst is None or dst.done:
             return  # message to dead/unknown process: dropped
+        if self.transport is not None:
+            msg = self.transport.downlink(msg)
         self.trace.append(msg.uid)
         dst.mailbox.append(msg)
         if dst.blocked:

@@ -1,0 +1,153 @@
+"""Pluggable message transports for the scheduler plane.
+
+The reference runs its actors over ``network-transport-tcp`` or
+``network-transport-inmemory`` (SURVEY.md §5 comm backend row); the
+deterministic scheduler sits above either.  Same split here:
+
+* :class:`InMemoryTransport` — the default; messages move as Python
+  objects, zero overhead (the reference's in-memory transport).
+* :class:`TcpLoopbackTransport` — every captured send and every delivery
+  physically traverses a real localhost TCP connection (length-prefixed
+  pickle frames), one persistent connection per process endpoint, exactly
+  as ``network-transport-tcp`` carries Cloud Haskell actor mail.
+
+Determinism is untouched by construction: the scheduler still makes every
+ordering decision from its seeded RNG; the transport only carries bytes,
+synchronously, over per-connection FIFO streams.  What the TCP variant
+adds is the real-transport guarantees the in-memory path can't exercise:
+payloads must survive serialization, and messages really cross the OS
+socket layer (tests/test_transport.py pins history bit-equality between
+the two transports).
+"""
+
+from __future__ import annotations
+
+import pickle
+import select
+import socket
+import struct
+from typing import Dict, Tuple
+
+from .scheduler import Message
+
+_LEN = struct.Struct(">I")
+
+
+class InMemoryTransport:
+    """Messages move as Python objects — the reference's in-memory path."""
+
+    name = "memory"
+
+    def uplink(self, msg: Message) -> Message:
+        """Carry a captured send from its source process to the pool."""
+        return msg
+
+    def downlink(self, msg: Message) -> Message:
+        """Carry a chosen delivery from the pool to its destination."""
+        return msg
+
+    def close(self) -> None:
+        pass
+
+
+def _roundtrip(send_sock: socket.socket, recv_sock: socket.socket,
+               msg: Message) -> Message:
+    """Write one frame on ``send_sock`` and read it back off ``recv_sock``,
+    interleaved via select: both ends are driven by the ONE scheduler
+    thread, so a blocking ``sendall`` of a frame larger than the loopback
+    buffers would deadlock (nobody would ever drain the peer).  Payload
+    size is therefore unbounded, not silently capped."""
+    blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    out = memoryview(_LEN.pack(len(blob)) + blob)
+    chunks = []
+    got = 0
+    need = None  # total inbound frame size, once the header is in
+    while out or need is None or got < _LEN.size + need:
+        ws = [send_sock] if out else []
+        rs, wr, _ = select.select([recv_sock], ws, [], 60.0)
+        if not rs and not wr:
+            raise ConnectionError("transport round-trip stalled (60s)")
+        if wr:
+            sent = send_sock.send(out[:65536])
+            out = out[sent:]
+        if rs:
+            chunk = recv_sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("transport peer closed mid-frame")
+            chunks.append(chunk)
+            got += len(chunk)
+            if need is None and got >= _LEN.size:
+                head = b"".join(chunks)
+                (need,) = _LEN.unpack(head[:_LEN.size])
+                chunks = [head]
+    return pickle.loads(b"".join(chunks)[_LEN.size:])
+
+
+class TcpLoopbackTransport:
+    """Real localhost TCP transport under the deterministic scheduler.
+
+    One listener plays the broker side (the scheduler's end); each named
+    process endpoint gets ONE persistent loopback connection, created
+    lazily at its first send or delivery.  ``uplink`` writes the captured
+    send on the source's connection and the broker reads it back off the
+    accepted peer; ``downlink`` writes the chosen delivery on the broker's
+    peer for the destination and reads it off the destination's
+    connection.  Both are synchronous round-trips on FIFO streams driven
+    by the single scheduler thread, so the seeded interleaving decisions
+    are exactly as replayable as in memory — histories are required to be
+    bit-identical across transports.
+    """
+
+    name = "tcp"
+
+    def __init__(self):
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(128)
+        self.address = self._listener.getsockname()
+        # endpoint name -> (process-side socket, broker-side socket)
+        self._conns: Dict[str, Tuple[socket.socket, socket.socket]] = {}
+        self.frames = 0  # frames actually carried over TCP (tests/stats)
+
+    def _conn(self, name: str) -> Tuple[socket.socket, socket.socket]:
+        pair = self._conns.get(name)
+        if pair is None:
+            client = socket.create_connection(self.address)
+            client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            broker, _ = self._listener.accept()
+            broker.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            pair = (client, broker)
+            self._conns[name] = pair
+        return pair
+
+    def uplink(self, msg: Message) -> Message:
+        client, broker = self._conn(msg.src)
+        self.frames += 1
+        return _roundtrip(client, broker, msg)
+
+    def downlink(self, msg: Message) -> Message:
+        client, broker = self._conn(msg.dst)
+        self.frames += 1
+        return _roundtrip(broker, client, msg)
+
+    def close(self) -> None:
+        for client, broker in self._conns.values():
+            for s in (client, broker):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._conns.clear()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def make_transport(kind: str):
+    if kind == "memory":
+        return InMemoryTransport()
+    if kind == "tcp":
+        return TcpLoopbackTransport()
+    raise ValueError(f"unknown transport {kind!r}")

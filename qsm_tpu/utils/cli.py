@@ -136,6 +136,10 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--backend", default="cpu",
                    choices=["cpu", "cpp", "tpu", "pcomp", "pcomp-tpu", "segdc",
                             "segdc-tpu"])
+    p.add_argument("--transport", default="memory",
+                   choices=["memory", "tcp"],
+                   help="scheduler-plane message transport (tcp = real "
+                        "loopback sockets; histories are bit-identical)")
     _add_fault_args(p)
     p.add_argument("--log", default=None, help="JSONL log path")
     p.add_argument("--save-regression", default=None,
@@ -151,7 +155,8 @@ def cmd_run(args) -> int:
         n_pids=args.pids or entry.default_pids,
         max_ops=args.ops or entry.default_ops,
         seed=args.seed, faults=faults,
-        schedules_per_program=args.schedules)
+        schedules_per_program=args.schedules,
+        transport=args.transport)
     log = JsonlLogger(path=args.log) if args.log else JsonlLogger()
     try:
         t0 = time.perf_counter()
