@@ -61,6 +61,12 @@ class PropertyConfig:
     # "tcp" (real loopback sockets, sched/transport.py).  Histories are
     # bit-identical across transports — the scheduler owns ordering.
     transport: str = "memory"
+    # Worker processes for schedule execution (sched/pool.py).  0 = serial.
+    # Histories are pure functions of (sut, program, seed, faults), so
+    # fan-out changes wall-clock only — results stay bit-identical.
+    # Requires a picklable sut factory (prop_concurrent's ``sut_factory``,
+    # e.g. ``qsm_tpu.models.registry.SutFactory``); ignored without one.
+    executor_workers: int = 0
 
 
 @dataclasses.dataclass
@@ -162,6 +168,15 @@ def _execute(sut: ConcurrentSUT, prog: Program, sched_seed: str,
                           max_steps=cfg.max_steps, transport=transport)
 
 
+def _execute_many(sut: ConcurrentSUT, jobs, cfg: PropertyConfig,
+                  transport=None, executor=None) -> List[History]:
+    """Execute [(program, seed), ...] in job order — serially, or fanned
+    over the worker pool (order-preserving, bit-identical histories)."""
+    if executor is not None:
+        return executor.run_many(jobs, cfg.faults, cfg.max_steps)
+    return [_execute(sut, p, s, cfg, transport) for p, s in jobs]
+
+
 def shrink_failure(
     spec: Spec,
     sut: ConcurrentSUT,
@@ -173,6 +188,7 @@ def shrink_failure(
     sched_seed: str,
     timings: Optional[Dict[str, float]] = None,
     transport=None,
+    executor=None,
 ) -> tuple[Program, History, int, int]:
     """Greedy shrink: each round, decide ALL candidates in one backend batch
     and step to the first (canonical order) still-failing one.
@@ -186,8 +202,8 @@ def shrink_failure(
         if not cands:
             break
         t0 = time.perf_counter()
-        hists = [_execute(sut, c, sched_seed, cfg, transport)
-                 for c in cands]
+        hists = _execute_many(sut, [(c, sched_seed) for c in cands],
+                              cfg, transport, executor)
         t1 = time.perf_counter()
         timings["shrink_execute"] = (timings.get("shrink_execute", 0.0)
                                      + t1 - t0)
@@ -211,9 +227,12 @@ def prop_concurrent(
     cfg: Optional[PropertyConfig] = None,
     backend: Optional[LineariseBackend] = None,
     oracle: Optional[WingGongCPU] = None,
+    sut_factory=None,
 ) -> PropertyResult:
     """Generate → execute → linearise → shrink; the reference's main entry
-    point (SURVEY.md §3.1)."""
+    point (SURVEY.md §3.1).  ``sut_factory`` (picklable, zero-arg — e.g.
+    ``qsm_tpu.models.registry.SutFactory``) enables the parallel execution
+    plane when ``cfg.executor_workers > 0``."""
     cfg = cfg or PropertyConfig()
     # memoised oracle: identical verdicts, orders of magnitude faster on
     # violating histories (Lowe-style cache) — the right default for the
@@ -221,30 +240,45 @@ def prop_concurrent(
     oracle = oracle or WingGongCPU(memo=True)
     backend = backend or oracle
     timings: Dict[str, float] = {}
-    # ONE transport for the whole property run: TCP endpoint connections
-    # persist across every trial/schedule/shrink execution instead of
-    # churning ephemeral ports per history (sched/transport.py)
     transport = None
-    if cfg.transport != "memory":
-        from ..sched.transport import make_transport
-
-        transport = make_transport(cfg.transport)
+    executor = None
 
     def _bump(key: str, t0: float) -> float:
         now = time.perf_counter()
         timings[key] = timings.get(key, 0.0) + now - t0
         return now
 
+    # everything that opens resources lives INSIDE the try so a failure in
+    # any construction step still closes the ones already open
     try:
+        use_pool = cfg.executor_workers > 0 and sut_factory is not None
+        if cfg.transport != "memory" and not use_pool:
+            # ONE transport for the whole property run: TCP endpoint
+            # connections persist across every trial/schedule/shrink
+            # execution instead of churning ephemeral ports per history
+            # (sched/transport.py).  With a worker pool every execution
+            # happens in the workers, which build their own transports —
+            # a parent-side one would carry zero bytes.
+            from ..sched.transport import make_transport
+
+            transport = make_transport(cfg.transport)
+        if use_pool:
+            from ..sched.pool import PoolExecutor
+
+            executor = PoolExecutor(sut_factory, cfg.executor_workers,
+                                    transport=cfg.transport)
         return _prop_concurrent_body(
-            spec, sut, cfg, backend, oracle, transport, timings, _bump)
+            spec, sut, cfg, backend, oracle, transport, executor,
+            timings, _bump)
     finally:
         if transport is not None:
             transport.close()
+        if executor is not None:
+            executor.close()
 
 
 def _prop_concurrent_body(spec, sut, cfg, backend, oracle, transport,
-                          timings, _bump) -> PropertyResult:
+                          executor, timings, _bump) -> PropertyResult:
     checked = 0
     undecided = 0
     schedules_run = 0
@@ -256,24 +290,26 @@ def _prop_concurrent_body(spec, sut, cfg, backend, oracle, transport,
         group = list(range(t, min(t + group_n, cfg.n_trials)))
         progs: List[Program] = []
         seeds_all: List[List[str]] = []
-        hists_all: List[History] = []
         spans: List[int] = []
+        jobs: List[tuple] = []
         for ti in group:
             s = trial_seed(cfg.seed, ti)
             t0 = time.perf_counter()
             prog = generate_program(
                 spec, seed=random.Random(s).randrange(1 << 62),
                 n_pids=cfg.n_pids, max_ops=_trial_ops(cfg, ti))
-            t0 = _bump("generate", t0)
+            _bump("generate", t0)
             # k seeded schedules of the SAME program; the whole group's
-            # histories are decided in ONE backend batch below
+            # histories are executed in one (possibly fanned-out) batch
+            # and decided in ONE backend batch below
             seeds = [schedule_seed(s, j) for j in range(k)]
             progs.append(prog)
             seeds_all.append(seeds)
-            spans.append(len(hists_all))
-            hists_all.extend(_execute(sut, prog, sk, cfg, transport)
-                             for sk in seeds)
-            _bump("execute", t0)
+            spans.append(len(jobs))
+            jobs.extend((prog, sk) for sk in seeds)
+        t0 = time.perf_counter()
+        hists_all = _execute_many(sut, jobs, cfg, transport, executor)
+        _bump("execute", t0)
         t0 = time.perf_counter()
         raw = backend.check_histories(spec, hists_all)
         _bump("check", t0)
@@ -294,7 +330,8 @@ def _prop_concurrent_body(spec, sut, cfg, backend, oracle, transport,
             j = fail_at - spans[gi]
             mp, mh, steps, c2 = shrink_failure(
                 spec, sut, backend, oracle, cfg, progs[gi],
-                hists_all[fail_at], seeds_all[gi][j], timings, transport)
+                hists_all[fail_at], seeds_all[gi][j], timings, transport,
+                executor)
             return PropertyResult(
                 ok=False, trials_run=ti + 1,
                 histories_checked=checked + c2,

@@ -65,3 +65,17 @@ def make(model: str, impl: str, spec_kwargs: dict = None):
     entry = MODELS[model]
     spec = entry.make_spec(**(spec_kwargs or {}))
     return spec, entry.impls[impl](spec)
+
+
+class SutFactory:
+    """Picklable zero-arg SUT constructor for the parallel execution plane
+    (sched/pool.py): spawn-started worker processes rebuild the SUT from
+    registry names — lambdas/closures don't survive pickling."""
+
+    def __init__(self, model: str, impl: str, spec_kwargs: dict = None):
+        self.model = model
+        self.impl = impl
+        self.spec_kwargs = spec_kwargs
+
+    def __call__(self):
+        return make(self.model, self.impl, self.spec_kwargs)[1]

@@ -1,0 +1,85 @@
+"""Parallel execution plane — seed-deterministic schedules over worker
+processes.
+
+The reference executes one schedule at a time inside one OS process; its
+distribution story is actors over transports (SURVEY.md §5).  Here the
+checker plane already batches on the device, which makes host-side
+*execution* the dominant end-to-end cost once checking is fast
+(BENCH_E2E_r03.json: execute ≈ check for the memoised host checker).
+Schedules are embarrassingly parallel BY CONSTRUCTION: every history is a
+pure function of (SUT factory, program, seed, faults), so fanning
+executions over worker processes changes wall-clock, never histories —
+the property layer requires bit-identical results to the serial path
+(tests/test_pool.py pins this).
+
+Workers are spawned (not forked: JAX-initialized parents must not fork)
+and live for the whole property run; each builds its SUT once from a
+picklable factory (``qsm_tpu.models.registry.SutFactory`` or any
+module-level callable) and reuses it — ``setup`` resets SUT state per
+run, exactly as the serial loop relies on.
+
+WHEN IT PAYS (measured, this image): worker warmup is ~4 s/worker (the
+image's sitecustomize imports run in every interpreter) and steady-state
+dispatch ~0.7 ms/job, so fan-out wins only when a single execution costs
+≳2 ms — real transports, heavy SUT step functions, large programs.  The
+in-tree toy SUTs execute in ~0.3 ms and are FASTER serial; that is why
+``executor_workers`` defaults to 0.  This mirrors the reference's
+reality: its SUTs are real distributed systems where execution is
+network-bound, and that is the regime this plane exists for.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from ..core.history import History
+
+_STATE: dict = {}
+
+
+def _init_worker(sut_factory, transport_spec: str) -> None:
+    from .transport import make_transport
+
+    _STATE["sut"] = sut_factory()
+    _STATE["transport"] = (None if transport_spec == "memory"
+                           else make_transport(transport_spec))
+
+
+def _run_one(job) -> History:
+    from .runner import run_concurrent
+
+    prog, seed, faults, max_steps = job
+    return run_concurrent(_STATE["sut"], prog, seed, faults=faults,
+                          max_steps=max_steps,
+                          transport=_STATE["transport"])
+
+
+class PoolExecutor:
+    """Executes (program, seed) jobs over a persistent process pool,
+    preserving input order (and therefore every downstream decision)."""
+
+    def __init__(self, sut_factory, n_workers: Optional[int] = None,
+                 transport: str = "memory"):
+        self.n_workers = n_workers or min(8, os.cpu_count() or 2)
+        ctx = multiprocessing.get_context("spawn")
+        self._pool = ctx.Pool(self.n_workers, initializer=_init_worker,
+                              initargs=(sut_factory, transport))
+        self.jobs_run = 0
+
+    def run_many(self, jobs: Sequence[Tuple], faults, max_steps: int
+                 ) -> List[History]:
+        """Execute jobs = [(program, seed), ...]; returns histories in job
+        order, bit-identical to serial execution."""
+        payload = [(p, s, faults, max_steps) for p, s in jobs]
+        # one chunk per worker: each run_many is a barrier anyway (its
+        # verdicts gate the next step), so finer chunks only add IPC
+        chunk = max(1, -(-len(payload) // self.n_workers))
+        out = self._pool.map(_run_one, payload, chunksize=chunk)
+        self.jobs_run += len(out)
+        return out
+
+    def close(self) -> None:
+        self._pool.terminate()
+        self._pool.join()

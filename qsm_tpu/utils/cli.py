@@ -14,7 +14,7 @@ import sys
 import time
 
 from ..core.property import PropertyConfig, prop_concurrent, replay
-from ..models.registry import MODELS, make
+from ..models.registry import MODELS, SutFactory, make
 from ..ops.wing_gong_cpu import WingGongCPU
 from ..sched.runner import run_concurrent
 from ..sched.scheduler import FaultPlan
@@ -140,6 +140,9 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
                    choices=["memory", "tcp"],
                    help="scheduler-plane message transport (tcp = real "
                         "loopback sockets; histories are bit-identical)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="worker processes for schedule execution "
+                        "(0 = serial; histories are bit-identical)")
     _add_fault_args(p)
     p.add_argument("--log", default=None, help="JSONL log path")
     p.add_argument("--save-regression", default=None,
@@ -156,7 +159,8 @@ def cmd_run(args) -> int:
         max_ops=args.ops or entry.default_ops,
         seed=args.seed, faults=faults,
         schedules_per_program=args.schedules,
-        transport=args.transport)
+        transport=args.transport,
+        executor_workers=args.workers)
     log = JsonlLogger(path=args.log) if args.log else JsonlLogger()
     try:
         t0 = time.perf_counter()
@@ -165,7 +169,10 @@ def cmd_run(args) -> int:
         # backend-is-oracle short-circuit fires (re-running the identical
         # search can only repeat the verdict)
         oracle = backend if args.backend == "cpu" else None
-        res = prop_concurrent(spec, sut, cfg, backend=backend, oracle=oracle)
+        res = prop_concurrent(
+            spec, sut, cfg, backend=backend, oracle=oracle,
+            sut_factory=(SutFactory(args.model, args.impl)
+                         if args.workers > 0 else None))
         dt = time.perf_counter() - t0
         log.emit("result", model=args.model, impl=args.impl, ok=res.ok,
                  trials=res.trials_run, histories=res.histories_checked,

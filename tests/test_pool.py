@@ -1,0 +1,56 @@
+"""Parallel execution plane (sched/pool.py): fanning schedule executions
+over spawn-started worker processes must be invisible in the results —
+histories, counterexamples, and stats bit-identical to the serial path —
+because every history is a pure function of (SUT factory, program, seed,
+faults)."""
+
+import dataclasses
+
+from qsm_tpu.core.property import PropertyConfig, prop_concurrent
+from qsm_tpu.models.registry import SutFactory, make
+
+
+CFG = PropertyConfig(n_trials=24, n_pids=4, max_ops=16, seed=11)
+
+
+def test_pool_matches_serial_on_failure():
+    spec, sut = make("cas", "racy")
+    serial = prop_concurrent(spec, sut, CFG)
+    spec2, sut2 = make("cas", "racy")
+    pooled = prop_concurrent(
+        spec2, sut2, dataclasses.replace(CFG, executor_workers=2),
+        sut_factory=SutFactory("cas", "racy"))
+    assert not serial.ok and not pooled.ok
+    assert pooled.counterexample.trial == serial.counterexample.trial
+    assert pooled.counterexample.trial_seed == serial.counterexample.trial_seed
+    assert (pooled.counterexample.history.fingerprint()
+            == serial.counterexample.history.fingerprint())
+    assert (tuple(pooled.counterexample.program.ops)
+            == tuple(serial.counterexample.program.ops))
+
+
+def test_pool_matches_serial_on_pass_and_with_faults():
+    from qsm_tpu.sched.scheduler import FaultPlan
+
+    cfg = dataclasses.replace(
+        CFG, n_trials=12,
+        faults=FaultPlan(p_drop=0.1, p_duplicate=0.05))
+    spec, sut = make("cas", "atomic")
+    serial = prop_concurrent(spec, sut, cfg)
+    spec2, sut2 = make("cas", "atomic")
+    pooled = prop_concurrent(
+        spec2, sut2, dataclasses.replace(cfg, executor_workers=2),
+        sut_factory=SutFactory("cas", "atomic"))
+    assert serial.ok and pooled.ok
+    assert pooled.histories_checked == serial.histories_checked
+    assert pooled.distinct_histories == serial.distinct_histories
+    assert pooled.undecided == serial.undecided
+
+
+def test_pool_ignored_without_factory():
+    spec, sut = make("register", "atomic")
+    res = prop_concurrent(
+        spec, sut,
+        dataclasses.replace(CFG, n_trials=5, n_pids=2, max_ops=8,
+                            executor_workers=4))  # no factory -> serial
+    assert res.ok
