@@ -135,6 +135,15 @@ class Spec:
         """
         return None
 
+    def native_kernel(self) -> Optional[Tuple[int, int, int]]:
+        """(kind, p0, p1) selecting a built-in C++ step kernel in
+        qsm_tpu/native/wg.cpp, or None.  Scalar-table specs need none (the
+        native checker drives them through the compiled domain table);
+        vector-state specs opt in by returning their kernel id + params —
+        the C++ side reimplements ``step_py`` exactly, and the parity
+        suite (tests/test_native.py) pins the equivalence."""
+        return None
+
     # -- decomposition ----------------------------------------------------
     def partition_key(self, cmd: int, arg: int) -> Optional[int]:
         """Key for P-compositionality decomposition, or None if the spec is

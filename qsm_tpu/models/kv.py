@@ -47,6 +47,9 @@ class KvSpec(Spec):
     def spec_kwargs(self):
         return {"n_keys": self.n_keys, "n_values": self.n_values}
 
+    def native_kernel(self):
+        return (2, self.n_keys, self.n_values)  # wg.cpp kind 2
+
     def step_py(self, state, cmd, arg, resp):
         state = list(state)
         if cmd == GET:

@@ -52,6 +52,9 @@ class QueueSpec(Spec):
     def spec_kwargs(self):
         return {"capacity": self.capacity, "n_values": self.n_values}
 
+    def native_kernel(self):
+        return (1, self.capacity, self.n_values)  # wg.cpp kind 1
+
     def step_py(self, state, cmd, arg, resp):
         length = state[0]
         slots = list(state[1:])

@@ -85,7 +85,10 @@ def get_lib():
     lib.wg_check_batch.argtypes = [
         ctypes.c_int, i64p,                 # n_hist, offsets
         i32p, i32p, i32p, u8p, u64p,        # cmd, arg, resp, pending, blockers
-        i32p, u8p,                          # trans, ok
+        ctypes.c_int, ctypes.c_int,         # kind, state_dim
+        ctypes.c_int32, ctypes.c_int32,     # p0, p1
+        ctypes.c_int,                       # elem_bits (0 = string keys)
+        i32p, u8p,                          # trans, ok (kind 0; else None)
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,  # S C A R
         i32p,                               # n_resps
         i32p, ctypes.c_longlong, ctypes.c_int,  # init_states, budget, memo

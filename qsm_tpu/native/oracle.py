@@ -1,18 +1,21 @@
 """``CppOracle`` — the native host checker (LineariseBackend).
 
-Routes scalar-state histories whose args are inside the declared command
-domains to the C++ Wing–Gong DFS (wg.cpp, same candidate order / budget /
-memo semantics as the Python oracle); everything else — vector-state
-specs, out-of-domain args, missing toolchain — falls back to the Python
-oracle, so verdicts are always available and always exact.
+Routing per history:
 
-Out-of-domain RESPONSES (SUTs can return anything; args come from the
-generator) are handled without fallback: a recorded response outside
-``[0, n_resps)`` can never be stepped ok by the domain table, which is
-exactly the Python oracle's outcome whenever ``step_py`` rejects every
-out-of-domain response — true of all in-tree scalar specs, and pinned by
-the parity suite (tests/test_native.py).  To stay exact for arbitrary
-future specs, such histories are routed to the fallback too.
+* scalar-state specs with a declared state bound → the C++ DFS driven by
+  the dense domain step table (wg.cpp kind 0);
+* vector-state specs that declare a built-in C++ kernel
+  (``Spec.native_kernel``: queue, kv) → the same DFS with the native step
+  function (kinds 1-2), total in the response like ``step_py``;
+* everything else — unknown specs, out-of-domain args, histories over 64
+  ops, missing toolchain — falls back to the Python oracle, so verdicts
+  are always available and always exact.
+
+For the TABLE path, out-of-domain RESPONSES also route to the fallback:
+the table only covers declared domains, and staying exact for arbitrary
+future specs beats assuming every out-of-domain response fails.  Native
+vector kernels evaluate the response directly, so they take any response
+value, exactly as the Python oracle does.
 """
 
 from __future__ import annotations
@@ -27,11 +30,12 @@ from ..core.spec import Spec, compile_step_table
 from ..ops.backend import Verdict
 from ..ops.wing_gong_cpu import WingGongCPU
 
-_MAX_OPS = 64  # one uint64 taken mask; the encoder's bucket cap
+_MAX_OPS = 64    # one uint64 taken mask; the encoder's bucket cap
+_MAX_STATE = 64  # wg.cpp MAX_STATE
 
 
 class CppOracle:
-    """Batched native Wing–Gong checker for scalar-state specs."""
+    """Batched native Wing–Gong checker."""
 
     name = "cpp_oracle"
 
@@ -47,26 +51,32 @@ class CppOracle:
                                                 memo=memo)
         self._lib = get_lib()
         self._tables = {}  # state bound -> (trans, ok)
+        self._vector_kernel = spec.native_kernel()
+        if (self._vector_kernel is not None
+                and spec.STATE_DIM > _MAX_STATE):
+            self._vector_kernel = None  # larger than the C++ state cap
         self.nodes_explored = 0
         self.native_histories = 0
         self.fallback_histories = 0
 
     # ------------------------------------------------------------------
+    def _uses_table(self) -> bool:
+        return (self.spec.STATE_DIM == 1
+                and self.spec.scalar_state_bound(1) is not None)
+
     def _native_ok(self, h: History) -> bool:
-        if self._lib is None or self.spec.STATE_DIM != 1:
+        if self._lib is None or len(h) > _MAX_OPS:
             return False
-        if len(h) > _MAX_OPS:
-            return False
-        if self.spec.scalar_state_bound(max(len(h), 1)) is None:
+        table = self._uses_table()
+        if not table and self._vector_kernel is None:
             return False
         for o in h.ops:
-            sig_ok = (0 <= o.cmd < self.spec.n_cmds
-                      and 0 <= o.arg < self.spec.CMDS[o.cmd].n_args)
-            if not sig_ok:
-                return False
-            if not o.is_pending and not (
+            if not (0 <= o.cmd < self.spec.n_cmds
+                    and 0 <= o.arg < self.spec.CMDS[o.cmd].n_args):
+                return False  # out-of-domain arg: step contract undefined
+            if table and not o.is_pending and not (
                     0 <= o.resp < self.spec.CMDS[o.cmd].n_resps):
-                return False  # stay exact for arbitrary specs (docstring)
+                return False  # table path: stay exact (module docstring)
         return True
 
     def _table(self, bound: int):
@@ -76,7 +86,7 @@ class CppOracle:
             # clip transitions into [0, bound): a broken bound contract
             # would otherwise index out of the table in C++; the clip makes
             # it a wrong-but-bounded row, and the bound contract itself is
-            # pinned by tests/test_models.py-style exhaustive checks
+            # pinned by the models' exhaustive step-table tests
             trans = np.clip(np.ascontiguousarray(trans, np.int32),
                             0, bound - 1)
             ok = np.ascontiguousarray(ok, np.uint8)
@@ -113,12 +123,30 @@ class CppOracle:
         return Verdict(int(v[0]))
 
     # ------------------------------------------------------------------
+    def _elem_bits(self, kind: int, p0: int, p1: int) -> int:
+        """Bit width bounding any state element of a native vector kernel
+        (lets the C++ memo pack the state into one 64-bit word instead of
+        allocating a string key per DFS node).  0 = unknown, use strings."""
+        if kind == 1:    # queue: [length <= capacity, slots < n_values]
+            return max(p0, p1 - 1).bit_length() or 1
+        if kind == 2:    # kv: values < n_values
+            return max(1, (p1 - 1).bit_length())
+        return 0
+
     def _run_native(self, histories, idx, init_states, out) -> None:
         spec = self.spec
-        max_len = max(len(histories[i]) for i in idx)
-        bound = spec.scalar_state_bound(max(max_len, 1))
-        trans, ok = self._table(bound)
-        S, C, A, R = trans.shape
+        dim = spec.STATE_DIM
+        if self._uses_table():
+            max_len = max(len(histories[i]) for i in idx)
+            bound = spec.scalar_state_bound(max(max_len, 1))
+            trans, ok = self._table(bound)
+            S, C, A, R = trans.shape
+            kind, p0, p1, elem_bits = 0, 0, 0, 0
+        else:
+            trans = ok = None
+            S = C = A = R = 0
+            kind, p0, p1 = self._vector_kernel
+            elem_bits = self._elem_bits(kind, p0, p1)
 
         total = sum(len(histories[i]) for i in idx)
         offsets = np.zeros(len(idx) + 1, np.int64)
@@ -127,8 +155,8 @@ class CppOracle:
         resp = np.empty(total, np.int32)
         pending = np.empty(total, np.uint8)
         blockers = np.empty(total, np.uint64)
-        inits = np.empty(len(idx), np.int32)
-        default_init = int(np.asarray(spec.initial_state())[0])
+        inits = np.empty((len(idx), dim), np.int32)
+        default_init = np.asarray(spec.initial_state(), np.int32)
         pos = 0
         for k, i in enumerate(idx):
             h = histories[i]
@@ -145,20 +173,22 @@ class CppOracle:
                     bit[prec[:, j]]) if prec[:, j].any() else np.uint64(0)
             inits[k] = (default_init if init_states is None
                         or init_states[i] is None
-                        else int(np.asarray(init_states[i])[0]))
+                        else np.asarray(init_states[i], np.int32))
             pos += n
 
         n_resps = np.asarray([c.n_resps for c in spec.CMDS], np.int32)
         verdicts = np.empty(len(idx), np.int32)
 
         def p(a, ty):
-            return a.ctypes.data_as(ctypes.POINTER(ty))
+            return (None if a is None
+                    else a.ctypes.data_as(ctypes.POINTER(ty)))
 
         nodes = self._lib.wg_check_batch(
             len(idx), p(offsets, ctypes.c_int64),
             p(cmd, ctypes.c_int32), p(arg, ctypes.c_int32),
             p(resp, ctypes.c_int32), p(pending, ctypes.c_uint8),
             p(blockers, ctypes.c_uint64),
+            kind, dim, p0, p1, elem_bits,
             p(trans, ctypes.c_int32), p(ok, ctypes.c_uint8),
             S, C, A, R, p(n_resps, ctypes.c_int32),
             p(inits, ctypes.c_int32),

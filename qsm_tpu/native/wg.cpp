@@ -11,29 +11,88 @@
 // ANY response in its command's domain, or never).  Verdict codes match
 // ops/backend.py::Verdict: 0 VIOLATION, 1 LINEARIZABLE, 2 BUDGET_EXCEEDED.
 //
-// Scope: scalar-state specs with a declared state bound (the step function
-// arrives as the dense [S][C][A][R] domain table compiled by
-// core/spec.py::compile_step_table).  Vector-state specs stay on the
-// Python oracle — the Python side routes them (native/__init__.py).
+// Spec dispatch (SpecDesc.kind):
+//   0 — scalar-state spec via the dense [S][C][A][R] domain table compiled
+//       by core/spec.py::compile_step_table;
+//   1 — bounded FIFO queue (models/queue.py semantics reimplemented:
+//       state = [length, slot0..slotC-1], params = capacity, n_values);
+//   2 — multi-key KV map (models/kv.py: state = value per key,
+//       params = n_keys, n_values).
+// Vector kinds evaluate the step directly (total in the response, exactly
+// like step_py), so only ARG domains need host-side routing; parity with
+// the Python oracle is pinned by tests/test_native.py.
 //
 // Histories are capped at 64 ops (the encoder's bucket cap), so the taken
 // set is one uint64 and precedence is a per-op blocker bitmask.
 
 #include <cstddef>
 #include <cstdint>
+#include <cstring>
+#include <string>
 #include <unordered_set>
 #include <utility>
 
 namespace {
 
-// Exact memo key: the 64-op taken mask plus the scalar model state — an
-// exact pair, no packing tricks, no collision risk.  (__int128 would pack
-// both, but libstdc++'s hash-table traits reject it under -std=c++17.)
+constexpr int MAX_STATE = 64;  // state vector length cap (router enforces)
+
+struct SpecDesc {
+    int kind;        // 0 table, 1 queue, 2 kv
+    int state_dim;
+    int32_t p0, p1;  // queue: capacity, n_values; kv: n_keys, n_values
+    const int32_t* trans;  // kind 0 only: [S][C][A][R]
+    const uint8_t* ok;
+    int S, C, A, R;
+    // bit width of any state element (caller-provided domain bound).  When
+    // state_dim * elem_bits <= 64 the whole vector packs into the fast
+    // pair key — no per-node heap allocation on the memo path.
+    int elem_bits;
+};
+
+// step: writes the successor state into out[], returns the postcondition.
+static inline bool do_step(const SpecDesc& sp, const int32_t* s,
+                           int32_t* out, int cmd, int arg, int resp) {
+    switch (sp.kind) {
+        case 0: {
+            const int idx = ((s[0] * sp.C + cmd) * sp.A + arg) * sp.R + resp;
+            out[0] = sp.trans[idx];
+            return sp.ok[idx] != 0;
+        }
+        case 1: {  // bounded FIFO queue: s = [length, slots...]
+            const int cap = sp.p0, n_values = sp.p1;
+            const int length = s[0];
+            std::memcpy(out, s, sizeof(int32_t) * (1 + cap));
+            if (cmd == 0) {                       // ENQ(arg)
+                if (length == cap) return resp == 1;   // FULL
+                out[1 + length] = arg;
+                out[0] = length + 1;
+                return resp == 0;                      // OK
+            }
+            if (length == 0) return resp == n_values;  // DEQ on empty
+            const int head = s[1];
+            for (int i = 0; i < cap - 1; ++i) out[1 + i] = s[2 + i];
+            out[cap] = 0;  // canonical form: vacated tail slot zeroed
+            out[0] = length - 1;
+            return resp == head;
+        }
+        case 2: {  // kv map: s = value per key
+            const int n_values = sp.p1;
+            std::memcpy(out, s, sizeof(int32_t) * sp.state_dim);
+            if (cmd == 0) return resp == s[arg];       // GET(key)
+            out[arg / n_values] = arg % n_values;      // PUT packs k*V+v
+            return resp == 0;
+        }
+    }
+    return false;
+}
+
+// Exact memo keys.  Scalar states use a (taken, state) pair set — the hot
+// path; vector states serialize taken + the raw state bytes into a string
+// set.  Both are exact (full-key storage), collisions impossible.
 using Key = std::pair<uint64_t, uint64_t>;
 
 struct KeyHash {
     size_t operator()(const Key& k) const {
-        // splitmix64 over both halves
         auto mix = [](uint64_t x) {
             x += 0x9E3779B97F4A7C15ull;
             x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
@@ -51,35 +110,63 @@ struct Ctx {
     const int32_t* resp;
     const uint8_t* pending;
     const uint64_t* blockers;
-    const int32_t* trans;   // [S][C][A][R]
-    const uint8_t* ok;      // [S][C][A][R]
-    int S, C, A, R;
-    const int32_t* n_resps; // per command
+    SpecDesc sp;
+    const int32_t* n_resps;  // per command
     int n_required;
     long long budget;
     long long nodes;
     bool use_memo;
-    std::unordered_set<Key, KeyHash>* seen;
+    std::unordered_set<Key, KeyHash>* seen;          // state_dim == 1
+    std::unordered_set<std::string>* seen_vec;       // state_dim  > 1
 };
 
 static inline Key key_of(uint64_t taken, int state) {
     return {taken, static_cast<uint64_t>(static_cast<uint32_t>(state))};
 }
 
-static inline int step_idx(const Ctx& c, int s, int cm, int a, int r) {
-    return ((s * c.C + cm) * c.A + a) * c.R + r;
+// Packs a small-domain state vector into the pair key's second word; the
+// caller guarantees every element fits elem_bits (spec domain bound).
+static inline Key key_packed(uint64_t taken, const int32_t* state, int dim,
+                             int elem_bits) {
+    uint64_t packed = 0;
+    for (int i = 0; i < dim; ++i)
+        packed |= static_cast<uint64_t>(static_cast<uint32_t>(state[i]))
+                  << (i * elem_bits);
+    return {taken, packed};
+}
+
+static std::string vec_key(uint64_t taken, const int32_t* state, int dim) {
+    std::string k(sizeof(taken) + sizeof(int32_t) * dim, '\0');
+    std::memcpy(&k[0], &taken, sizeof(taken));
+    std::memcpy(&k[sizeof(taken)], state, sizeof(int32_t) * dim);
+    return k;
 }
 
 // returns Verdict {0, 1, 2}
-static int dfs(Ctx& c, uint64_t taken, int state, int got_required) {
+static int dfs(Ctx& c, uint64_t taken, const int32_t* state,
+               int got_required) {
     if (got_required == c.n_required) return 1;
     if (c.budget <= 0) return 2;
+    const bool scalar = c.sp.state_dim == 1;
+    const bool packed = !scalar
+        && c.sp.elem_bits > 0
+        && c.sp.state_dim * c.sp.elem_bits <= 64;
     Key key{};
+    std::string vkey;
     if (c.use_memo) {
-        key = key_of(taken, state);
-        if (c.seen->count(key)) return 0;
+        if (scalar) {
+            key = key_of(taken, state[0]);
+            if (c.seen->count(key)) return 0;
+        } else if (packed) {
+            key = key_packed(taken, state, c.sp.state_dim, c.sp.elem_bits);
+            if (c.seen->count(key)) return 0;
+        } else {
+            vkey = vec_key(taken, state, c.sp.state_dim);
+            if (c.seen_vec->count(vkey)) return 0;
+        }
     }
     bool saw_budget = false;
+    int32_t child[MAX_STATE];
     for (int j = 0; j < c.n; ++j) {
         if (taken >> j & 1) continue;
         if (c.blockers[j] & ~taken) continue;  // an untaken op precedes j
@@ -91,16 +178,18 @@ static int dfs(Ctx& c, uint64_t taken, int state, int got_required) {
             --c.budget;
             ++c.nodes;
             if (c.budget <= 0) return 2;
-            const int idx = step_idx(c, state, cm, a, r);
-            if (!c.ok[idx]) continue;
-            const int sub = dfs(c, taken | (1ull << j), c.trans[idx],
+            if (!do_step(c.sp, state, child, cm, a, r)) continue;
+            const int sub = dfs(c, taken | (1ull << j), child,
                                 got_required + (pend ? 0 : 1));
             if (sub == 1) return 1;
             if (sub == 2) saw_budget = true;
         }
     }
     if (saw_budget) return 2;
-    if (c.use_memo) c.seen->insert(key);
+    if (c.use_memo) {
+        if (scalar || packed) c.seen->insert(key);
+        else c.seen_vec->insert(std::move(vkey));
+    }
     return 0;
 }
 
@@ -108,45 +197,37 @@ static int dfs(Ctx& c, uint64_t taken, int state, int got_required) {
 
 extern "C" {
 
-// Decide one history.  Returns nodes explored; verdict via out param.
-long long wg_check(
-    int n, const int32_t* cmd, const int32_t* arg, const int32_t* resp,
-    const uint8_t* pending, const uint64_t* blockers,
-    const int32_t* trans, const uint8_t* ok,
-    int S, int C, int A, int R, const int32_t* n_resps,
-    int init_state, long long node_budget, int use_memo,
-    int32_t* out_verdict) {
-    int n_required = 0;
-    for (int j = 0; j < n; ++j)
-        if (!pending[j]) ++n_required;
-    std::unordered_set<Key, KeyHash> seen;
-    Ctx c{n, cmd, arg, resp, pending, blockers, trans, ok,
-          S, C, A, R, n_resps, n_required, node_budget, 0,
-          use_memo != 0, &seen};
-    *out_verdict = (n == 0) ? 1 : dfs(c, 0ull, init_state, 0);
-    return c.nodes;
-}
-
-// Decide a batch: per-history arrays are concatenated, offsets[i] is the
-// start of history i's ops, offsets[n_hist] the total.  init_states may
-// carry one scalar per history (per-lane start states for the
-// segmentation combinator).  Returns total nodes explored.
+// Decide a batch: per-history op arrays are concatenated, offsets[i] is
+// the start of history i's ops, offsets[n_hist] the total.  init_states
+// is [n_hist][state_dim] (per-lane start states — the segmentation
+// combinator's route).  kind/p0/p1 select the spec semantics; trans/ok
+// carry the scalar domain table for kind 0 (pass null otherwise).
+// Returns total nodes explored; verdicts land in out_verdicts.
 long long wg_check_batch(
     int n_hist, const int64_t* offsets,
     const int32_t* cmd, const int32_t* arg, const int32_t* resp,
     const uint8_t* pending, const uint64_t* blockers,
+    int kind, int state_dim, int32_t p0, int32_t p1, int elem_bits,
     const int32_t* trans, const uint8_t* ok,
     int S, int C, int A, int R, const int32_t* n_resps,
     const int32_t* init_states, long long node_budget, int use_memo,
     int32_t* out_verdicts) {
+    SpecDesc sp{kind, state_dim, p0, p1, trans, ok, S, C, A, R, elem_bits};
     long long total = 0;
     for (int i = 0; i < n_hist; ++i) {
         const int64_t lo = offsets[i];
         const int n = static_cast<int>(offsets[i + 1] - lo);
-        total += wg_check(n, cmd + lo, arg + lo, resp + lo, pending + lo,
-                          blockers + lo, trans, ok, S, C, A, R, n_resps,
-                          init_states[i], node_budget, use_memo,
-                          out_verdicts + i);
+        int n_required = 0;
+        for (int j = 0; j < n; ++j)
+            if (!pending[lo + j]) ++n_required;
+        std::unordered_set<Key, KeyHash> seen;
+        std::unordered_set<std::string> seen_vec;
+        Ctx c{n, cmd + lo, arg + lo, resp + lo, pending + lo,
+              blockers + lo, sp, n_resps, n_required, node_budget, 0,
+              use_memo != 0, &seen, &seen_vec};
+        out_verdicts[i] =
+            (n == 0) ? 1 : dfs(c, 0ull, init_states + i * state_dim, 0);
+        total += c.nodes;
     }
     return total;
 }

@@ -23,6 +23,12 @@ from .report import (JsonlLogger, format_counterexample, format_history,
 from .stats import schedule_coverage
 
 
+# one list for every subcommand: a backend added to only one
+# parser would silently be unselectable from the other
+_BACKENDS = ("cpu", "cpp", "tpu", "pcomp", "pcomp-cpp", "pcomp-tpu",
+             "segdc", "segdc-cpp", "segdc-tpu")
+
+
 def _ensure_device_reachable(timeout_s: float = 45.0) -> None:
     """Fail fast (never hang) before initializing a device backend.
 
@@ -81,6 +87,23 @@ def _make_backend(name: str, spec):
             raise SystemExit(f"native backend unavailable: {native_error()}\n"
                              "use --backend cpu")
         return CppOracle(spec)
+    if name == "pcomp-cpp":
+        from ..native import CppOracle, native_available, native_error
+        from ..ops.pcomp import PComp
+
+        if not native_available():
+            raise SystemExit(f"native backend unavailable: {native_error()}\n"
+                             "use --backend pcomp")
+        return PComp(spec, lambda pspec: CppOracle(pspec))
+    if name == "segdc-cpp":
+        from ..native import CppOracle, native_available, native_error
+        from ..ops.segdc import SegDC
+
+        if not native_available():
+            raise SystemExit(f"native backend unavailable: {native_error()}\n"
+                             "use --backend segdc")
+        cpp = CppOracle(spec)
+        return SegDC(spec, make_inner=lambda s: cpp, oracle=cpp)
     if name == "tpu":
         _ensure_device_reachable()
         from ..ops.jax_kernel import JaxTPU
@@ -134,8 +157,7 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--schedules", type=int, default=4,
                    help="seeded schedules per generated program")
     p.add_argument("--backend", default="cpu",
-                   choices=["cpu", "cpp", "tpu", "pcomp", "pcomp-tpu", "segdc",
-                            "segdc-tpu"])
+                   choices=_BACKENDS)
     p.add_argument("--transport", default="memory",
                    choices=["memory", "tcp"],
                    help="scheduler-plane message transport (tcp = real "
@@ -306,8 +328,7 @@ def main(argv=None) -> int:
     p = sub.add_parser("bench", help="checker throughput on one model")
     p.add_argument("--model", default="cas", choices=sorted(MODELS))
     p.add_argument("--backend", default="cpu",
-                   choices=["cpu", "cpp", "tpu", "pcomp", "pcomp-tpu", "segdc",
-                            "segdc-tpu"])
+                   choices=_BACKENDS)
     p.add_argument("--pids", type=int, default=None)
     p.add_argument("--ops", type=int, default=None)
     p.add_argument("--corpus", type=int, default=256)

@@ -72,12 +72,54 @@ def test_budget_semantics_match_oracle():
             py.check_histories(spec, corpus), err_msg=f"budget={budget}")
 
 
-def test_vector_state_spec_routes_to_fallback():
+def test_queue_native_kernel_parity():
+    """Vector-state queue histories run the built-in C++ step kernel
+    (wg.cpp kind 1) — full-size 48-op corpus, parity with the oracle."""
+    spec = QueueSpec()
+    corpus = build_corpus(spec, (AtomicQueueSUT, RacyTwoPhaseQueueSUT),
+                          n=48, n_pids=8, max_ops=48, seed_base=1000,
+                          seed_prefix="bench")
+    cpp = CppOracle(spec)
+    got = cpp.check_histories(spec, corpus)
+    want = WingGongCPU(memo=True).check_histories(spec, corpus)
+    np.testing.assert_array_equal(got, want)
+    assert cpp.native_histories == len(corpus)
+    assert (got == int(Verdict.VIOLATION)).any()
+    assert (got == int(Verdict.LINEARIZABLE)).any()
+
+
+def test_kv_native_kernel_parity():
+    """KV histories (wg.cpp kind 2) at full 16-pid/64-op size; note the
+    UNdecomposed search — the native DFS handles it where the Python
+    memo oracle is impractically slow, so parity is checked against
+    P-compositionality + oracle."""
+    from qsm_tpu.models import KvSpec
+    from qsm_tpu.models.kv import AtomicKvSUT, StaleCacheKvSUT
+    from qsm_tpu.ops.pcomp import PComp
+
+    spec = KvSpec()
+    corpus = build_corpus(spec, (AtomicKvSUT, StaleCacheKvSUT),
+                          n=24, n_pids=16, max_ops=64, seed_base=1000,
+                          seed_prefix="bench")
+    cpp = CppOracle(spec, node_budget=20_000_000)
+    got = cpp.check_histories(spec, corpus)
+    want = PComp(spec).check_histories(spec, corpus)
+    decided = got != int(Verdict.BUDGET_EXCEEDED)
+    np.testing.assert_array_equal(got[decided],
+                                  np.asarray(want)[decided])
+    assert cpp.native_histories == len(corpus)
+    assert decided.any()
+
+
+def test_unknown_vector_spec_routes_to_fallback():
+    """A vector-state spec WITHOUT a native kernel still gets exact
+    verdicts via the Python fallback."""
     spec = QueueSpec()
     corpus = build_corpus(spec, (AtomicQueueSUT, RacyTwoPhaseQueueSUT),
                           n=16, n_pids=4, max_ops=16, seed_base=3,
                           seed_prefix="fb")
     cpp = CppOracle(spec)
+    cpp._vector_kernel = None  # simulate a spec with no C++ kernel
     got = cpp.check_histories(spec, corpus)
     want = WingGongCPU(memo=True).check_histories(spec, corpus)
     np.testing.assert_array_equal(got, want)
