@@ -186,20 +186,30 @@ def run_sweep(on_tpu: bool) -> dict:
     # to keep cells bounded — BUDGET_EXCEEDED lanes then report honestly
     q_kw = (dict() if on_tpu
             else dict(budget=2_000, mid_budget=10_000, rescue_budget=100_000))
+
+    from qsm_tpu.native import CppOracle, native_available
+
     configs = {
         "cas": (CasSpec, (AtomicCasSUT, RacyCasSUT), {
             "oracle": lambda s: WingGongCPU(node_budget=5_000_000),
             "memo": lambda s: WingGongCPU(memo=True),
+            "cpp": lambda s: CppOracle(s),
             "device": lambda s: JaxTPU(s),
         }),
         "queue": (QueueSpec, (AtomicQueueSUT, RacyTwoPhaseQueueSUT), {
             "oracle": lambda s: WingGongCPU(node_budget=5_000_000),
             "memo": lambda s: WingGongCPU(memo=True),
+            "cpp": lambda s: CppOracle(s),
             "device": lambda s: JaxTPU(s, **q_kw),
             "segdc_device": lambda s: SegDC(
                 s, make_inner=lambda x: JaxTPU(x, **q_kw)),
         }),
     }
+    if not native_available():
+        # no toolchain: omit the cpp rows entirely rather than reporting
+        # a fake "couldn't solve 12 ops" zero
+        for _, _, backends in configs.values():
+            backends.pop("cpp", None)
 
     cells: dict = {}
     solved: dict = {}

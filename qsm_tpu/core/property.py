@@ -110,6 +110,20 @@ class PropertyResult:
         return self.ok and self.undecided == 0
 
 
+def _default_oracle(spec: Spec):
+    """Native C++ checker when buildable, else the memoised Python oracle
+    (identical verdicts; CppOracle routes anything it can't decide
+    natively to the Python oracle itself)."""
+    try:
+        from ..native import CppOracle, native_available
+
+        if native_available():
+            return CppOracle(spec)
+    except Exception:  # noqa: BLE001 — the oracle must always exist
+        pass
+    return WingGongCPU(memo=True)
+
+
 def trial_seed(base_seed: int, trial: int) -> str:
     """Stable per-trial seed key (str-seeded Random uses sha512 — stable
     across processes, unlike hash())."""
@@ -234,10 +248,13 @@ def prop_concurrent(
     ``qsm_tpu.models.registry.SutFactory``) enables the parallel execution
     plane when ``cfg.executor_workers > 0``."""
     cfg = cfg or PropertyConfig()
-    # memoised oracle: identical verdicts, orders of magnitude faster on
-    # violating histories (Lowe-style cache) — the right default for the
-    # resolution path; parity tests construct the memo-less one explicitly
-    oracle = oracle or WingGongCPU(memo=True)
+    # Default resolution oracle: the native C++ checker when the toolchain
+    # is available (same verdict contract — it falls back to the Python
+    # oracle internally for anything outside its native coverage), else
+    # the memoised Python oracle.  Identical verdicts either way; only
+    # the wall-clock of BUDGET_EXCEEDED resolution changes.
+    if oracle is None:
+        oracle = _default_oracle(spec)
     backend = backend or oracle
     timings: Dict[str, float] = {}
     transport = None

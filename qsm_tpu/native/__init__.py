@@ -94,6 +94,20 @@ def get_lib():
         i32p, ctypes.c_longlong, ctypes.c_int,  # init_states, budget, memo
         i32p,                               # out_verdicts
     ]
+    lib.wg_end_states.restype = ctypes.c_longlong
+    lib.wg_end_states.argtypes = [
+        ctypes.c_int,                       # n (segment ops)
+        i32p, i32p, i32p, u64p,             # cmd, arg, resp, blockers
+        ctypes.c_int, ctypes.c_int,         # kind, state_dim
+        ctypes.c_int32, ctypes.c_int32,     # p0, p1
+        ctypes.c_int,                       # elem_bits
+        i32p, u8p,                          # trans, ok
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,  # S C A R
+        i32p, ctypes.c_int,                 # init_states, n_inits
+        ctypes.c_longlong,                  # node budget
+        i32p, ctypes.c_int,                 # out_states, max_out
+        ctypes.POINTER(ctypes.c_longlong),  # nodes_used (out)
+    ]
     _lib = lib
     return _lib
 

@@ -64,33 +64,63 @@ class CppOracle:
         return (self.spec.STATE_DIM == 1
                 and self.spec.scalar_state_bound(1) is not None)
 
-    def _native_ok(self, h: History) -> bool:
-        if self._lib is None or len(h) > _MAX_OPS:
-            return False
+    def _ops_in_domain(self, ops) -> bool:
+        """ONE copy of the native-routing domain rules (used by history
+        routing and by end_states): args always in the declared domains;
+        responses too on the table path (module docstring)."""
         table = self._uses_table()
-        if not table and self._vector_kernel is None:
-            return False
-        for o in h.ops:
+        for o in ops:
             if not (0 <= o.cmd < self.spec.n_cmds
                     and 0 <= o.arg < self.spec.CMDS[o.cmd].n_args):
                 return False  # out-of-domain arg: step contract undefined
             if table and not o.is_pending and not (
                     0 <= o.resp < self.spec.CMDS[o.cmd].n_resps):
-                return False  # table path: stay exact (module docstring)
+                return False  # table path: stay exact
         return True
 
+    def _dispatch(self, max_len: int, max_start: Optional[int] = None):
+        """ONE copy of the table-vs-kernel selection: (kind, p0, p1,
+        elem_bits, trans, ok, S, C, A, R) for the C++ calls, or None when
+        this spec has no native route.  ``max_start`` sizes the scalar
+        table past the from-initial bound when searches begin at frontier
+        states (growth heuristic: +1 per step covers the counter/ticket
+        family; anything that still escapes hits the in-kernel OOB guard
+        and defers honestly — never a misread)."""
+        if self._uses_table():
+            bound = self.spec.scalar_state_bound(max(max_len, 1))
+            if max_start is not None:
+                bound = max(bound, max_start + 1 + max_len)
+            trans, ok = self._table(bound)
+            S, C, A, R = trans.shape
+            return (0, 0, 0, 0, trans, ok, S, C, A, R)
+        if self._vector_kernel is not None:
+            kind, p0, p1 = self._vector_kernel
+            return (kind, p0, p1, self._elem_bits(kind, p0, p1),
+                    None, None, 0, 0, 0, 0)
+        return None
+
+    def _native_ok(self, h: History) -> bool:
+        if self._lib is None or len(h) > _MAX_OPS:
+            return False
+        if not self._uses_table() and self._vector_kernel is None:
+            return False
+        return self._ops_in_domain(h.ops)
+
     def _table(self, bound: int):
-        tab = self._tables.get(bound)
-        if tab is None:
-            trans, ok = compile_step_table(self.spec, bound)
-            # clip transitions into [0, bound): a broken bound contract
-            # would otherwise index out of the table in C++; the clip makes
-            # it a wrong-but-bounded row, and the bound contract itself is
-            # pinned by the models' exhaustive step-table tests
-            trans = np.clip(np.ascontiguousarray(trans, np.int32),
-                            0, bound - 1)
-            ok = np.ascontiguousarray(ok, np.uint8)
-            self._tables[bound] = (trans, ok)
+        # any cached table with rows >= bound serves (the kernel reads S
+        # from the table itself); compile rounded up so a frontier that
+        # grows by one per segment doesn't recompile per segment
+        usable = [b for b in self._tables if b >= bound]
+        if usable:
+            return self._tables[min(usable)]
+        bound = -(-bound // 32) * 32
+        trans, ok = compile_step_table(self.spec, bound)
+        # NOT clipped: a successor beyond the table is caught by the
+        # in-kernel state_oob guard and deferred honestly — clipping
+        # would silently misread a wrong row instead
+        trans = np.ascontiguousarray(trans, np.int32)
+        ok = np.ascontiguousarray(ok, np.uint8)
+        self._tables[bound] = (trans, ok)
         return self._tables[bound]
 
     # ------------------------------------------------------------------
@@ -123,6 +153,75 @@ class CppOracle:
         return Verdict(int(v[0]))
 
     # ------------------------------------------------------------------
+    def end_states(self, spec: Spec, ops, starts, budget=None,
+                   node_budget: Optional[int] = None,
+                   max_out: int = 4096):
+        """Distinct model states reachable by SOME complete linearization
+        of ``ops`` (a pending-free segment) from any state in ``starts`` —
+        the native counterpart of ops/segdc.py::_end_states for its
+        middle-segment frontier threading.  Returns a set of int tuples,
+        or None when this spec/segment can't run natively or the budget /
+        output cap is hit (the caller then uses the Python path).
+
+        ``budget``: a shared object with a ``left`` counter (SegDC's
+        per-history node budget); nodes consumed natively are charged
+        against it — including on failure, so a Python fallback resumes
+        with the true remainder instead of double-spending."""
+        assert spec is self.spec
+        n = len(ops)
+        if (self._lib is None or n == 0 or n > _MAX_OPS
+                or any(o.is_pending for o in ops)
+                or not self._ops_in_domain(ops)):
+            return None
+        starts = sorted({tuple(int(v) for v in s) for s in starts})
+        disp = self._dispatch(n, max_start=(max(s[0] for s in starts)
+                                            if self._uses_table() else None))
+        if disp is None:
+            return None
+        kind, p0, p1, elem_bits, trans, ok, S, C, A, R = disp
+
+        dim = spec.STATE_DIM
+        # one construction site for precedence: the same History-based
+        # vectorized path _run_native uses
+        seg = History(sorted(ops, key=lambda o: o.invoke_time))
+        cmd = np.asarray([o.cmd for o in seg.ops], np.int32)
+        arg = np.asarray([o.arg for o in seg.ops], np.int32)
+        resp = np.asarray([o.resp for o in seg.ops], np.int32)
+        prec = seg.precedes_matrix().astype(bool)
+        bit = np.uint64(1) << np.arange(n, dtype=np.uint64)
+        blockers = np.asarray(
+            [np.bitwise_or.reduce(bit[prec[:, j]]) if prec[:, j].any()
+             else np.uint64(0) for j in range(n)], np.uint64)
+        inits = np.asarray(starts, np.int32).reshape(len(starts), dim)
+        out = np.empty((max_out, dim), np.int32)
+        if node_budget is None:
+            node_budget = (budget.left if budget is not None
+                           else self.node_budget)
+        if node_budget <= 0:
+            return None
+
+        def p(a, ty):
+            return (None if a is None
+                    else a.ctypes.data_as(ctypes.POINTER(ty)))
+
+        nodes_used = ctypes.c_longlong(0)
+        got = self._lib.wg_end_states(
+            n, p(cmd, ctypes.c_int32), p(arg, ctypes.c_int32),
+            p(resp, ctypes.c_int32), p(blockers, ctypes.c_uint64),
+            kind, dim, p0, p1, elem_bits,
+            p(trans, ctypes.c_int32), p(ok, ctypes.c_uint8),
+            S, C, A, R,
+            p(inits, ctypes.c_int32), len(starts),
+            node_budget, p(out, ctypes.c_int32), max_out,
+            ctypes.byref(nodes_used))
+        self.nodes_explored += int(nodes_used.value)
+        if budget is not None:
+            budget.left -= int(nodes_used.value)
+        if got < 0:
+            return None  # -1 budget, -2 output cap, -3 table escape
+        return {tuple(int(v) for v in out[i]) for i in range(int(got))}
+
+    # ------------------------------------------------------------------
     def _elem_bits(self, kind: int, p0: int, p1: int) -> int:
         """Bit width bounding any state element of a native vector kernel
         (lets the C++ memo pack the state into one 64-bit word instead of
@@ -136,17 +235,18 @@ class CppOracle:
     def _run_native(self, histories, idx, init_states, out) -> None:
         spec = self.spec
         dim = spec.STATE_DIM
-        if self._uses_table():
-            max_len = max(len(histories[i]) for i in idx)
-            bound = spec.scalar_state_bound(max(max_len, 1))
-            trans, ok = self._table(bound)
-            S, C, A, R = trans.shape
-            kind, p0, p1, elem_bits = 0, 0, 0, 0
-        else:
-            trans = ok = None
-            S = C = A = R = 0
-            kind, p0, p1 = self._vector_kernel
-            elem_bits = self._elem_bits(kind, p0, p1)
+        max_len = max(len(histories[i]) for i in idx)
+        max_start = None
+        if self._uses_table() and init_states is not None:
+            # searches may start at frontier states past the from-initial
+            # bound (SegDC's route) — size the table to cover them; any
+            # still-escaping state hits the in-kernel OOB guard
+            max_start = max(
+                (int(np.asarray(init_states[i])[0])
+                 for i in idx if init_states[i] is not None),
+                default=0)
+        kind, p0, p1, elem_bits, trans, ok, S, C, A, R = self._dispatch(
+            max_len, max_start=max_start)
 
         total = sum(len(histories[i]) for i in idx)
         offsets = np.zeros(len(idx) + 1, np.int64)

@@ -49,7 +49,39 @@ struct SpecDesc {
     int elem_bits;
 };
 
+// A kind-0 state outside the table: the caller must defer (never index).
+static inline bool state_oob(const SpecDesc& sp, const int32_t* s) {
+    return sp.kind == 0 && (s[0] < 0 || s[0] >= sp.S);
+}
+
+// Caller-supplied START states can be arbitrary (check_from / frontier
+// threading); the step kernels preserve validity, so one root check per
+// search restores the "never a misread" contract for vector kinds too:
+// a queue length outside [0, cap] would index past the stack buffer, and
+// any element past elem_bits would alias packed memo keys (an aliased
+// "proven failed" entry is a WRONG verdict, not just a lost prune).
+static inline bool start_state_invalid(const SpecDesc& sp,
+                                       const int32_t* s) {
+    switch (sp.kind) {
+        case 0:
+            return s[0] < 0 || s[0] >= sp.S;
+        case 1: {
+            if (s[0] < 0 || s[0] > sp.p0) return true;       // length
+            for (int i = 1; i <= sp.p0; ++i)                 // slots
+                if (s[i] < 0 || s[i] >= sp.p1) return true;
+            return false;
+        }
+        case 2: {
+            for (int i = 0; i < sp.state_dim; ++i)           // values
+                if (s[i] < 0 || s[i] >= sp.p1) return true;
+            return false;
+        }
+    }
+    return true;
+}
+
 // step: writes the successor state into out[], returns the postcondition.
+// Kind-0 callers must have checked state_oob() first.
 static inline bool do_step(const SpecDesc& sp, const int32_t* s,
                            int32_t* out, int cmd, int arg, int resp) {
     switch (sp.kind) {
@@ -147,6 +179,9 @@ static int dfs(Ctx& c, uint64_t taken, const int32_t* state,
                int got_required) {
     if (got_required == c.n_required) return 1;
     if (c.budget <= 0) return 2;
+    // a state beyond the domain table (non-initial start past the bound,
+    // or a broken bound contract): defer honestly instead of misreading
+    if (state_oob(c.sp, state)) return 2;
     const bool scalar = c.sp.state_dim == 1;
     const bool packed = !scalar
         && c.sp.elem_bits > 0
@@ -193,9 +228,116 @@ static int dfs(Ctx& c, uint64_t taken, const int32_t* state,
     return 0;
 }
 
+// --- end-state enumeration (decrease-and-conquer middle segments) -------
+// Explores EVERY valid complete linearization of a (pending-free) segment
+// from one start state, collecting the set of distinct reachable end
+// states — the native counterpart of ops/segdc.py::_end_states, same
+// budget accounting (one unit per step evaluation) and same visited-set
+// pruning semantics.
+
+struct EndCtx {
+    int n;
+    const int32_t* cmd;
+    const int32_t* arg;
+    const int32_t* resp;
+    const uint64_t* blockers;
+    SpecDesc sp;
+    long long budget;
+    long long nodes;
+    bool overflow;   // hit max_out (distinct from budget exhaustion)
+    bool oob;        // kind-0 state escaped the table (caller must defer)
+    std::unordered_set<Key, KeyHash>* visited;      // packed/scalar states
+    std::unordered_set<std::string>* visited_vec;   // string-key states
+    std::unordered_set<std::string>* ends;          // distinct end states
+    int32_t* out;       // [max_out][state_dim]
+    int max_out;
+};
+
+static bool end_dfs(EndCtx& c, uint64_t taken, const int32_t* state) {
+    const int dim = c.sp.state_dim;
+    const uint64_t full = (c.n == 64) ? ~0ull : ((1ull << c.n) - 1);
+    if (taken == full) {
+        std::string k(reinterpret_cast<const char*>(state),
+                      sizeof(int32_t) * dim);
+        if (c.ends->count(k)) return true;
+        if (static_cast<int>(c.ends->size()) >= c.max_out) {
+            c.overflow = true;
+            return false;
+        }
+        std::memcpy(c.out + c.ends->size() * dim, state,
+                    sizeof(int32_t) * dim);
+        c.ends->insert(std::move(k));
+        return true;
+    }
+    if (state_oob(c.sp, state)) {
+        c.oob = true;
+        return false;
+    }
+    const bool scalar = dim == 1;
+    const bool packed = !scalar && c.sp.elem_bits > 0
+                        && dim * c.sp.elem_bits <= 64;
+    if (scalar || packed) {
+        Key key = scalar ? key_of(taken, state[0])
+                         : key_packed(taken, state, dim, c.sp.elem_bits);
+        if (!c.visited->insert(key).second) return true;
+    } else {
+        if (!c.visited_vec->insert(vec_key(taken, state, dim)).second)
+            return true;
+    }
+    int32_t child[MAX_STATE];
+    for (int j = 0; j < c.n; ++j) {
+        if (taken >> j & 1) continue;
+        if (c.blockers[j] & ~taken) continue;
+        --c.budget;
+        ++c.nodes;
+        if (c.budget <= 0) return false;
+        if (!do_step(c.sp, state, child, c.cmd[j], c.arg[j], c.resp[j]))
+            continue;
+        if (!end_dfs(c, taken | (1ull << j), child)) return false;
+    }
+    return true;
+}
+
 }  // namespace
 
 extern "C" {
+
+// Enumerate reachable end states of a complete segment from n_inits start
+// states.  Returns the count written to out_states; on failure returns
+// -1 (budget exhausted), -2 (more than max_out distinct end states), or
+// -3 (a scalar state escaped the domain table — caller must defer).
+// *nodes_used always reports step evaluations consumed, so the caller can
+// charge its shared budget before falling back to the exact Python walk.
+long long wg_end_states(
+    int n, const int32_t* cmd, const int32_t* arg, const int32_t* resp,
+    const uint64_t* blockers,
+    int kind, int state_dim, int32_t p0, int32_t p1, int elem_bits,
+    const int32_t* trans, const uint8_t* ok,
+    int S, int C, int A, int R,
+    const int32_t* init_states, int n_inits,
+    long long node_budget, int32_t* out_states, int max_out,
+    long long* nodes_used) {
+    SpecDesc sp{kind, state_dim, p0, p1, trans, ok, S, C, A, R, elem_bits};
+    std::unordered_set<std::string> ends;
+    EndCtx c{n, cmd, arg, resp, blockers, sp, node_budget, 0, false, false,
+             nullptr, nullptr, &ends, out_states, max_out};
+    long long rc = 0;
+    for (int i = 0; i < n_inits && rc == 0; ++i) {
+        // fresh visited set per start, exactly like the Python version
+        std::unordered_set<Key, KeyHash> visited;
+        std::unordered_set<std::string> visited_vec;
+        c.visited = &visited;
+        c.visited_vec = &visited_vec;
+        const int32_t* init = init_states + i * state_dim;
+        if (start_state_invalid(sp, init)) {
+            rc = -3;  // caller falls back to the exact Python walk
+        } else if (!end_dfs(c, 0ull, init)) {
+            rc = c.oob ? -3 : (c.overflow ? -2 : -1);
+        }
+    }
+    *nodes_used = c.nodes;
+    return rc == 0 ? static_cast<long long>(ends.size()) : rc;
+}
 
 // Decide a batch: per-history op arrays are concatenated, offsets[i] is
 // the start of history i's ops, offsets[n_hist] the total.  init_states
@@ -225,8 +367,13 @@ long long wg_check_batch(
         Ctx c{n, cmd + lo, arg + lo, resp + lo, pending + lo,
               blockers + lo, sp, n_resps, n_required, node_budget, 0,
               use_memo != 0, &seen, &seen_vec};
-        out_verdicts[i] =
-            (n == 0) ? 1 : dfs(c, 0ull, init_states + i * state_dim, 0);
+        const int32_t* init = init_states + i * state_dim;
+        if (n == 0)
+            out_verdicts[i] = 1;
+        else if (start_state_invalid(sp, init))
+            out_verdicts[i] = 2;  // defer: the Python oracle is exact here
+        else
+            out_verdicts[i] = dfs(c, 0ull, init, 0);
         total += c.nodes;
     }
     return total;

@@ -159,6 +159,7 @@ class SegDC:
         self.segments_split = 0    # histories that actually cut
         self.segments_total = 0    # segments across them
         self.final_states_device = 0  # (segment × state) lanes sent to device
+        self.segments_native = 0   # middle segments enumerated natively
 
     def check_histories(self, spec: Spec, histories: Sequence[History]
                         ) -> np.ndarray:
@@ -180,8 +181,19 @@ class SegDC:
             frontier: Set[Tuple[int, ...]] = {
                 tuple(int(v) for v in spec.initial_state())}
             verdict: Optional[Verdict] = None
+            native_ends = getattr(self.oracle, "end_states", None)
             for seg in segs[:-1]:
-                nxt = _end_states(spec, seg, frontier, budget)
+                nxt = None
+                if native_ends is not None:
+                    # native middle-segment enumeration (CppOracle); a
+                    # None answer (unsupported spec/segment, budget or
+                    # output cap) falls through to the Python walk, which
+                    # resumes with the charged-down shared budget
+                    nxt = native_ends(spec, seg, frontier, budget=budget)
+                    if nxt is not None:
+                        self.segments_native += 1
+                if nxt is None:
+                    nxt = _end_states(spec, seg, frontier, budget)
                 if nxt is None:
                     verdict = Verdict.BUDGET_EXCEEDED
                     break

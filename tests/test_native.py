@@ -163,3 +163,92 @@ def test_native_is_actually_fast():
     py.check_histories(spec, corpus)
     py_s = time.perf_counter() - t0
     assert cpp_s < py_s, (cpp_s, py_s)
+
+
+def test_end_states_matches_python_enumeration():
+    """Native middle-segment end-state enumeration == the Python walk,
+    and segdc with a CppOracle oracle stays verdict-identical while
+    actually using it."""
+    from qsm_tpu.ops.segdc import SegDC, _Budget, _end_states, \
+        split_at_quiescent_cuts
+
+    spec = QueueSpec()
+    corpus = build_corpus(spec, (AtomicQueueSUT, RacyTwoPhaseQueueSUT),
+                          n=48, n_pids=8, max_ops=48, seed_base=1000,
+                          seed_prefix="bench")
+    cpp = CppOracle(spec)
+    checked = 0
+    for h in corpus:
+        segs = split_at_quiescent_cuts(h)
+        if len(segs) <= 1:
+            continue
+        frontier = {tuple(int(v) for v in spec.initial_state())}
+        for seg in segs[:-1]:
+            want = _end_states(spec, seg, frontier, _Budget(10_000_000))
+            got = cpp.end_states(spec, seg, frontier)
+            assert got == want, (len(seg), sorted(frontier))
+            checked += 1
+            frontier = want
+    assert checked > 0, "corpus produced no middle segments"
+
+    host = SegDC(spec)
+    nat = SegDC(spec, make_inner=lambda s: cpp, oracle=cpp)
+    got = nat.check_histories(spec, corpus)
+    want = host.check_histories(spec, corpus)
+    np.testing.assert_array_equal(got, want)
+    assert nat.segments_native > 0
+
+
+def test_frontier_start_past_segment_bound_is_exact():
+    """Regression: a ticket-dispenser frontier state can exceed the FINAL
+    segment's from-initial state bound (scalar_state_bound(n2) < start).
+    The native table must cover frontier starts (and anything that still
+    escapes defers via the in-kernel OOB guard) — never a misread."""
+    from qsm_tpu import overlapping_history
+    from qsm_tpu.models.counter import TicketSpec
+    from qsm_tpu.ops.segdc import SegDC, split_at_quiescent_cuts
+
+    spec = TicketSpec(n_tickets=32)
+    TAKE = 0
+    # segment 1: ten sequential ok TAKEs drive the state to 10;
+    # segment 2 (after a quiescent cut): one TAKE expecting ticket 10
+    ops = [(0, TAKE, 0, i, 2 * i, 2 * i + 1) for i in range(10)]
+    good = overlapping_history(ops + [(1, TAKE, 0, 10, 100, 101)])
+    bad = overlapping_history(ops + [(1, TAKE, 0, 3, 100, 101)])
+    assert len(split_at_quiescent_cuts(good)) == 11
+
+    cpp = CppOracle(spec)
+    host = SegDC(spec)
+    nat = SegDC(spec, make_inner=lambda s: cpp, oracle=cpp)
+    for h in (good, bad):
+        want = host.check_histories(spec, [h])
+        got = nat.check_histories(spec, [h])
+        np.testing.assert_array_equal(got, want, err_msg=str(h))
+    assert nat.segments_native > 0
+    # direct end_states past the per-segment bound
+    seg2 = split_at_quiescent_cuts(good)[-1]
+    got = cpp.end_states(spec, seg2, {(10,)})
+    assert got == {(11,)}
+    # check_from with a start past scalar_state_bound(len(h)) stays exact
+    h1 = overlapping_history([(0, TAKE, 0, 20, 0, 1)])
+    assert cpp.check_from(spec, h1, np.asarray([20], np.int32)) == \
+        WingGongCPU().check_from(spec, h1, np.asarray([20], np.int32))
+
+
+def test_invalid_start_states_defer_never_corrupt():
+    """Foreign/corrupt start states on vector kernels must defer (verdict
+    BUDGET_EXCEEDED) rather than smash the stack buffer or alias packed
+    memo keys."""
+    from qsm_tpu import overlapping_history
+
+    spec = QueueSpec()
+    h = overlapping_history([(0, 0, 1, 0, 0, 1)])  # ENQ(1) -> OK
+    cpp = CppOracle(spec)
+    for bad in ([70, 0, 0, 0, 0], [-3, 0, 0, 0, 0], [1, 99, 0, 0, 0]):
+        v = cpp.check_from(spec, h, np.asarray(bad, np.int32))
+        assert v == Verdict.BUDGET_EXCEEDED, bad
+        assert cpp.end_states(spec, h.ops, {tuple(bad)}) is None
+    # valid starts still decide natively
+    assert cpp.check_from(
+        spec, h, np.asarray([0, 0, 0, 0, 0], np.int32)) \
+        == Verdict.LINEARIZABLE

@@ -108,3 +108,15 @@ def test_trial_batch_grouping_preserves_semantics():
     assert ok_grouped.trials_run == ok_plain.trials_run
     assert ok_grouped.histories_checked == ok_plain.histories_checked
     assert ok_grouped.timings.get("check", 0) > 0
+
+
+def test_default_oracle_is_native_when_available():
+    from qsm_tpu.core.property import _default_oracle
+    from qsm_tpu.models import CasSpec
+    from qsm_tpu.native import CppOracle, native_available
+
+    oracle = _default_oracle(CasSpec())
+    if native_available():
+        assert isinstance(oracle, CppOracle)
+    else:
+        assert isinstance(oracle, WingGongCPU)

@@ -36,21 +36,28 @@ def _backends_for(model: str, spec, on_tpu: bool):
     vec_kw = (dict() if on_tpu
               else dict(budget=2_000, mid_budget=10_000,
                         rescue_budget=100_000))
+    from qsm_tpu.native import CppOracle, native_available
+
     if model == "kv":
         # the UNdecomposed memo oracle on 16-pid × 64-op multi-key
         # histories is exponential in practice (it ran >5 min on this
         # corpus) — per-key P-compositionality is the only sane host
         # checker at this size, so that is the honest host comparator
-        return {
+        out = {
             "memo": PComp(spec),  # pcomp(memo)
             "device": PComp(spec, make_inner=lambda s: JaxTPU(s, **vec_kw)),
         }
+        if native_available():
+            out["cpp"] = PComp(spec, make_inner=lambda s: CppOracle(s))
+        return out
     out = {"memo": WingGongCPU(memo=True)}
     if model == "queue":
         out["device"] = SegDC(spec,
                               make_inner=lambda s: JaxTPU(s, **vec_kw))
     else:
         out["device"] = JaxTPU(spec)
+    if native_available():
+        out["cpp"] = CppOracle(spec)
     return out
 
 
