@@ -13,8 +13,9 @@ thousands of histories batch into one device call (BASELINE.json:9):
     pending[B, N]     = invoked but never responded (crash/fault injection);
                         the checker may prune or complete these (SURVEY.md §3.2)
 
-``N`` (MAX_OPS) is bucketed to {12, 24, 32, 48, 64} to bound XLA
-recompilation across the five milestone configs (BASELINE.json:7-11).
+``N`` (MAX_OPS) is bucketed to ``OP_BUCKETS`` below (12…128; 96/128 go
+past the largest milestone config) to bound XLA recompilation
+(BASELINE.json:7-11).
 
 The real-time precedence partial order needed by Wing-Gong is derived, not
 stored: op *i* precedes op *j* iff ``response_time[i] < invoke_time[j]``.
@@ -27,7 +28,11 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-OP_BUCKETS = (12, 24, 32, 48, 64)
+# 96/128 extend PAST the reference's largest config (64×16 —
+# BASELINE.json:11): the device kernel and the host oracles take any
+# bucket; the native C++ checker's 64-bit taken mask caps at 64 and
+# routes longer histories to the Python oracle (qsm_tpu/native/oracle.py)
+OP_BUCKETS = (12, 24, 32, 48, 64, 96, 128)
 
 # Sentinel response for pending operations (no response observed).
 NO_RESP = -1

@@ -1,0 +1,55 @@
+"""Beyond the reference's scale: 96- and 128-op histories (the largest
+BASELINE config is 64×16).  The device kernel and host oracles handle the
+new buckets directly; the native C++ checker's 64-op taken mask routes
+longer histories to its exact Python fallback; segmentation keeps the
+long-history cost decomposed (SURVEY.md §5 long-context row)."""
+
+import numpy as np
+
+from qsm_tpu import Verdict, WingGongCPU
+from qsm_tpu.core.history import bucket_for
+from qsm_tpu.models import CasSpec, AtomicCasSUT, RacyCasSUT, QueueSpec
+from qsm_tpu.models.queue import AtomicQueueSUT, RacyTwoPhaseQueueSUT
+from qsm_tpu.utils.corpus import build_corpus
+
+
+def test_buckets_extend_past_reference_scale():
+    assert bucket_for(65) == 96
+    assert bucket_for(97) == 128
+
+
+def test_cas_96ops_device_parity():
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+
+    spec = CasSpec()
+    corpus = build_corpus(spec, (AtomicCasSUT, RacyCasSUT), n=24,
+                          n_pids=8, max_ops=96, seed_base=1000,
+                          seed_prefix="long")
+    dev = JaxTPU(spec)
+    got = dev.check_histories(spec, corpus)
+    want = WingGongCPU(memo=True).check_histories(spec, corpus)
+    decided = got != int(Verdict.BUDGET_EXCEEDED)
+    np.testing.assert_array_equal(got[decided], np.asarray(want)[decided])
+    assert decided.sum() >= 0.8 * len(corpus)
+    assert (want == int(Verdict.VIOLATION)).any()
+
+
+def test_queue_96ops_segdc_and_native_fallback_parity():
+    from qsm_tpu.native import CppOracle
+    from qsm_tpu.ops.segdc import SegDC
+
+    spec = QueueSpec()
+    corpus = build_corpus(spec, (AtomicQueueSUT, RacyTwoPhaseQueueSUT),
+                          n=24, n_pids=8, max_ops=96, seed_base=1000,
+                          seed_prefix="long")
+    assert any(len(h) > 64 for h in corpus)
+    want = WingGongCPU(memo=True).check_histories(spec, corpus)
+
+    seg = SegDC(spec)
+    np.testing.assert_array_equal(seg.check_histories(spec, corpus), want)
+    assert seg.segments_split > 0
+
+    cpp = CppOracle(spec)
+    np.testing.assert_array_equal(cpp.check_histories(spec, corpus), want)
+    # >64-op histories must have routed to the exact fallback
+    assert cpp.fallback_histories > 0

@@ -76,13 +76,20 @@ def main(argv=None) -> int:
         "memo": lambda s: WingGongCPU(memo=True),
         "device": lambda s: JaxTPU(s),
     }
+    try:
+        from qsm_tpu.native import CppOracle, native_available
+
+        if native_available():
+            backends["cpp"] = lambda s: CppOracle(s)
+    except Exception:  # noqa: BLE001 — optional fast path, never the bench
+        pass
     # trial_batch=1 is the reference-shaped serial loop; 64 makes the
     # device see 256-lane batches (64 trials × 4 schedules) — the grouping
     # exists precisely because the split below showed per-call dispatch
     # dominating the device path at batch 4
     for bname, mk in backends.items():
         for sut_name in ("atomic", "racy"):
-            for tb in ((1,) if bname == "memo" else (1, 64)):
+            for tb in ((1,) if bname != "device" else (1, 64)):
                 rec = run_one(f"cas-{sut_name}", bname, mk, sut_name,
                               args.trials, trial_batch=tb)
                 rec["trial_batch"] = tb
