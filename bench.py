@@ -141,10 +141,11 @@ def run_sweep(on_tpu: bool) -> dict:
     buckets = (12, 24, 48, 64, 96, 128)  # 96/128 exceed the reference's
     # largest config — long-context headroom (VERDICT r2 #4: "add buckets
     # beyond 64 if the device can take them")
-    # per-backend coverage caps: the native checker's 64-bit taken mask
-    # stops at 64 ops (beyond it the measurement would silently be the
-    # Python fallback's)
-    caps = {"cpp": 64}
+    # per-backend coverage caps: past the native checker's taken-mask cap
+    # the measurement would silently be the Python fallback's
+    from qsm_tpu.native import NATIVE_MAX_OPS
+
+    caps = {"cpp": NATIVE_MAX_OPS}
 
     def host_cell(backend, spec, corpus):
         times, verds = [], []

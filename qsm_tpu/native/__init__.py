@@ -112,6 +112,7 @@ def get_lib():
     return _lib
 
 
-from .oracle import CppOracle  # noqa: E402  (needs get_lib defined)
+from .oracle import NATIVE_MAX_OPS, CppOracle  # noqa: E402  (needs get_lib)
 
-__all__ = ["CppOracle", "get_lib", "native_available", "native_error"]
+__all__ = ["CppOracle", "NATIVE_MAX_OPS", "get_lib", "native_available",
+           "native_error"]

@@ -30,7 +30,10 @@ from ..core.spec import Spec, compile_step_table
 from ..ops.backend import Verdict
 from ..ops.wing_gong_cpu import WingGongCPU
 
-_MAX_OPS = 64    # one uint64 taken mask; the encoder's bucket cap
+# public: the native checker's coverage cap (one uint64 taken mask) —
+# consumers (bench.py's sweep caps) must derive from this, not hardcode
+NATIVE_MAX_OPS = 64
+_MAX_OPS = NATIVE_MAX_OPS
 _MAX_STATE = 64  # wg.cpp MAX_STATE
 
 

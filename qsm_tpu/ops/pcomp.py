@@ -69,6 +69,12 @@ class PComp:
         from .wing_gong_cpu import WingGongCPU
 
         self.spec = spec
+        if not hasattr(spec, "projected_spec"):
+            raise ValueError(
+                f"spec {spec.name!r} is not per-key decomposable: "
+                "P-compositionality needs projected_spec()/project_op() "
+                "and a partition_key (PAPERS.md:5); use a whole-history "
+                "backend for this spec")
         self.projected = spec.projected_spec()
         self.inner: LineariseBackend = (
             make_inner(self.projected) if make_inner is not None

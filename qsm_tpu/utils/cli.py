@@ -94,7 +94,10 @@ def _make_backend(name: str, spec):
         if not native_available():
             raise SystemExit(f"native backend unavailable: {native_error()}\n"
                              "use --backend pcomp")
-        return PComp(spec, lambda pspec: CppOracle(pspec))
+        try:
+            return PComp(spec, lambda pspec: CppOracle(pspec))
+        except ValueError as e:
+            raise SystemExit(str(e)) from e
     if name == "segdc-cpp":
         from ..native import CppOracle, native_available, native_error
         from ..ops.segdc import SegDC
@@ -112,13 +115,19 @@ def _make_backend(name: str, spec):
     if name == "pcomp":
         from ..ops.pcomp import PComp
 
-        return PComp(spec)
+        try:
+            return PComp(spec)
+        except ValueError as e:  # non-decomposable spec: clean exit, not
+            raise SystemExit(str(e)) from e  # a traceback
     if name == "pcomp-tpu":
         _ensure_device_reachable()
         from ..ops.jax_kernel import JaxTPU
         from ..ops.pcomp import PComp
 
-        return PComp(spec, lambda pspec: JaxTPU(pspec))
+        try:
+            return PComp(spec, lambda pspec: JaxTPU(pspec))
+        except ValueError as e:
+            raise SystemExit(str(e)) from e
     if name == "segdc":
         from ..ops.segdc import SegDC
 

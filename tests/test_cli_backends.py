@@ -1,0 +1,90 @@
+"""CLI backend construction — every ``--backend`` choice builds the
+composition it names (VERDICT.md round 2, "What's weak" #6: the tpu CLI
+paths had no coverage; the probe is mocked healthy so the wiring is
+exercised without a chip — construction never touches jax devices, the
+backends are lazy)."""
+
+import pytest
+
+from qsm_tpu.models import CasSpec, QueueSpec
+from qsm_tpu.utils import cli as cli_mod
+from qsm_tpu.utils.cli import _BACKENDS, _make_backend
+from qsm_tpu.utils.device import Probe
+
+
+@pytest.fixture
+def healthy_probe(monkeypatch):
+    # this test process imported jax pinned to the CPU platform (conftest),
+    # which _ensure_device_reachable rightly refuses before it ever probes;
+    # bypass the gate here — its probe handling is exercised separately by
+    # test_device_gate_follows_probe below
+    monkeypatch.setattr(cli_mod, "_ensure_device_reachable",
+                        lambda timeout_s=45.0: None)
+
+
+def test_device_gate_follows_probe(monkeypatch):
+    """With the cpu-pin checks out of the way (jax 'unimported', env
+    clear), _ensure_device_reachable's outcome is exactly the probe's."""
+    import sys as _sys
+
+    import qsm_tpu.utils.device as device
+
+    monkeypatch.delitem(_sys.modules, "jax", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(
+        device, "probe_default_backend",
+        lambda timeout_s=45.0: Probe(True, "tpu", "tpu 1 TPU v5 lite0"))
+    cli_mod._ensure_device_reachable()  # healthy: no raise
+    monkeypatch.setattr(
+        device, "probe_default_backend",
+        lambda timeout_s=45.0: Probe(False, "none", "wedged (test)"))
+    with pytest.raises(SystemExit, match="no accelerator"):
+        cli_mod._ensure_device_reachable()
+
+
+def test_every_backend_choice_constructs(healthy_probe):
+    from qsm_tpu.native import CppOracle
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.ops.pcomp import PComp
+    from qsm_tpu.ops.segdc import SegDC
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+
+    from qsm_tpu.models import KvSpec
+
+    # pcomp variants need a partition-key spec (KV); the rest take any
+    want = {
+        "cpu": (WingGongCPU, QueueSpec),
+        "cpp": (CppOracle, QueueSpec),
+        "tpu": (JaxTPU, QueueSpec),
+        "pcomp": (PComp, KvSpec),
+        "pcomp-cpp": (PComp, KvSpec),
+        "pcomp-tpu": (PComp, KvSpec),
+        "segdc": (SegDC, QueueSpec),
+        "segdc-cpp": (SegDC, QueueSpec),
+        "segdc-tpu": (SegDC, QueueSpec),
+    }
+    assert set(want) == set(_BACKENDS)
+    for name, (ty, mk_spec) in want.items():
+        b = _make_backend(name, mk_spec())
+        assert isinstance(b, ty), name
+
+    # the composites really wire the inner they name
+    b = _make_backend("segdc-tpu", CasSpec())
+    assert isinstance(b.inner, JaxTPU)
+    assert b.device_final  # final segments batch on the device
+    b = _make_backend("segdc-cpp", CasSpec())
+    assert isinstance(b.inner, CppOracle)
+    b = _make_backend("pcomp-tpu", KvSpec())
+    assert isinstance(b.inner, JaxTPU)
+
+
+def test_unknown_backend_refused():
+    with pytest.raises(SystemExit):
+        _make_backend("gpu", CasSpec())
+
+
+def test_pcomp_refuses_non_decomposable_spec():
+    from qsm_tpu.ops.pcomp import PComp
+
+    with pytest.raises(ValueError, match="decomposable"):
+        PComp(QueueSpec())
