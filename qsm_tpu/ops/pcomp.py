@@ -51,6 +51,13 @@ def split_history(spec: Spec, history: History) -> Dict[int, History]:
             for k, ops in per_key.items()}
 
 
+class NotDecomposableError(ValueError):
+    """The spec declares no per-key projection; P-compositionality cannot
+    apply.  A distinct type so callers (the CLI) can convert exactly this
+    misconfiguration to a clean exit without masking unrelated
+    ValueErrors from inner-backend construction."""
+
+
 class PComp:
     """Backend combinator: decompose per key, decide ALL sub-histories of
     the whole input batch in one inner-backend call, aggregate per input.
@@ -70,7 +77,7 @@ class PComp:
 
         self.spec = spec
         if not hasattr(spec, "projected_spec"):
-            raise ValueError(
+            raise NotDecomposableError(
                 f"spec {spec.name!r} is not per-key decomposable: "
                 "P-compositionality needs projected_spec()/project_op() "
                 "and a partition_key (PAPERS.md:5); use a whole-history "

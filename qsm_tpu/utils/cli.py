@@ -78,6 +78,17 @@ def _ensure_device_reachable(timeout_s: float = 45.0) -> None:
 
 
 def _make_backend(name: str, spec):
+    from ..ops.pcomp import NotDecomposableError
+
+    try:
+        return _make_backend_inner(name, spec)
+    except NotDecomposableError as e:
+        # exactly this misconfiguration exits cleanly; unrelated
+        # ValueErrors from backend construction still traceback
+        raise SystemExit(str(e)) from e
+
+
+def _make_backend_inner(name: str, spec):
     if name == "cpu":
         return WingGongCPU(memo=True)
     if name == "cpp":
@@ -94,10 +105,7 @@ def _make_backend(name: str, spec):
         if not native_available():
             raise SystemExit(f"native backend unavailable: {native_error()}\n"
                              "use --backend pcomp")
-        try:
-            return PComp(spec, lambda pspec: CppOracle(pspec))
-        except ValueError as e:
-            raise SystemExit(str(e)) from e
+        return PComp(spec, lambda pspec: CppOracle(pspec))
     if name == "segdc-cpp":
         from ..native import CppOracle, native_available, native_error
         from ..ops.segdc import SegDC
@@ -115,19 +123,13 @@ def _make_backend(name: str, spec):
     if name == "pcomp":
         from ..ops.pcomp import PComp
 
-        try:
-            return PComp(spec)
-        except ValueError as e:  # non-decomposable spec: clean exit, not
-            raise SystemExit(str(e)) from e  # a traceback
+        return PComp(spec)
     if name == "pcomp-tpu":
         _ensure_device_reachable()
         from ..ops.jax_kernel import JaxTPU
         from ..ops.pcomp import PComp
 
-        try:
-            return PComp(spec, lambda pspec: JaxTPU(pspec))
-        except ValueError as e:
-            raise SystemExit(str(e)) from e
+        return PComp(spec, lambda pspec: JaxTPU(pspec))
     if name == "segdc":
         from ..ops.segdc import SegDC
 

@@ -132,3 +132,27 @@ def test_low_concurrency_register_corpus_parity():
     want = WingGongCPU(memo=True).check_histories(RSPEC, corpus)
     np.testing.assert_array_equal(got, want)
     assert backend.segments_split > 0  # splitting actually happened
+
+
+def test_device_final_segments_with_pending_ops():
+    """Fault-injected histories put PENDING ops in final segments; the
+    batched device-final path (init_states + host-side pending expansion)
+    must agree with the host SegDC everywhere."""
+    from qsm_tpu import generate_program, run_concurrent
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.sched.scheduler import FaultPlan
+
+    hists = []
+    for seed in range(32):
+        prog = generate_program(RSPEC, seed=seed, n_pids=2, max_ops=12)
+        sut = (AtomicRegisterSUT if seed % 2 else RacyCachedRegisterSUT)()
+        hists.append(run_concurrent(
+            sut, prog, seed=f"sp{seed}",
+            faults=FaultPlan(p_drop=0.25, p_duplicate=0.1)))
+    assert any(h.n_pending for h in hists), "fault corpus vacuous"
+    host = SegDC(RSPEC)
+    dev = SegDC(RSPEC, make_inner=lambda s: JaxTPU(s))
+    got = dev.check_histories(RSPEC, hists)
+    want = host.check_histories(RSPEC, hists)
+    np.testing.assert_array_equal(got, want)
+    assert dev.final_states_device > 0
