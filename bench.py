@@ -333,6 +333,12 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
     # count (the property layer resolves them via the oracle — SURVEY.md §7
     # hard-parts #5), so the headline rate only counts decided verdicts.
     backend = JaxTPU(spec, budget=sc["budget"])
+    if on_tpu:
+        # healing windows are short and first-compiles are the enemy: two
+        # chunk stages instead of four halves the executables per bucket
+        # at a small lockstep-waste cost (the escalation still happens,
+        # just coarser)
+        backend.CHUNK_SCHEDULE = (2048, 65536)
     backend.check_histories(spec, device_corpus)  # warmup: compile + run
     backend.lockstep_cost = 0   # count only the timed passes below
     backend.rounds_run = 0
@@ -395,6 +401,9 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
             "tpu_probe": probe_detail,
             "device_batch": sc["device_batch"],
             "device_budget": sc["budget"],
+            # the measured configuration, for cross-round comparability
+            # (the TPU path coarsens the schedule to halve window compiles)
+            "chunk_schedule": list(backend.CHUNK_SCHEDULE),
             "budget_exceeded": budget_exceeded,
             "rescued": backend.rescued,
             "lockstep_iters": backend.lockstep_cost // sc["reps"],  # per pass

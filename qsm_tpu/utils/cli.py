@@ -176,6 +176,11 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--workers", type=int, default=0,
                    help="worker processes for schedule execution "
                         "(0 = serial; histories are bit-identical)")
+    p.add_argument("--trial-batch", type=int, default=1,
+                   help="trials decided per backend batch; verdicts are "
+                        "identical. Amortizes per-call dispatch on a real "
+                        "accelerator — on the CPU fallback the bigger "
+                        "padded batch measures SLOWER (BENCH_E2E_r03)")
     _add_fault_args(p)
     p.add_argument("--log", default=None, help="JSONL log path")
     p.add_argument("--save-regression", default=None,
@@ -193,7 +198,8 @@ def cmd_run(args) -> int:
         seed=args.seed, faults=faults,
         schedules_per_program=args.schedules,
         transport=args.transport,
-        executor_workers=args.workers)
+        executor_workers=args.workers,
+        trial_batch=args.trial_batch)
     log = JsonlLogger(path=args.log) if args.log else JsonlLogger()
     try:
         t0 = time.perf_counter()
