@@ -20,7 +20,8 @@ from .core.spec import CmdSig, Spec, compile_step_table
 from .core.history import (EncodedBatch, History, Op, encode_batch,
                            overlapping_history, sequential_history)
 from .core.generator import Program, ProgOp, generate_program, shrink_candidates
-from .core.sequential import ModelSUT, run_sequential
+from .core.sequential import (ModelSUT, prop_sequential,
+                              run_sequential)
 from .core.property import (Counterexample, PropertyConfig, PropertyResult,
                             prop_concurrent, replay, trial_seed)
 from .ops.backend import LineariseBackend, Verdict, check_one

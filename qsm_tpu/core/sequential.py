@@ -9,9 +9,11 @@ stays the debugging baseline for every spec/SUT pair.
 from __future__ import annotations
 
 import dataclasses
+import random
 from typing import Optional, Protocol
 
-from .generator import Program
+from .generator import (Program, dedupe, generate_program,
+                        shrink_candidates)
 from .history import History, Op
 from .spec import Spec
 
@@ -70,3 +72,64 @@ def run_sequential(spec: Spec, sut: SequentialSUT, program: Program
             return SequentialResult(False, History(ops), failed_at=idx)
         state = [int(v) for v in new_state]
     return SequentialResult(True, History(ops))
+
+
+@dataclasses.dataclass
+class SequentialPropertyResult:
+    ok: bool
+    trials_run: int
+    counterexample: Optional[Program] = None
+    history: Optional[History] = None
+    failed_at: Optional[int] = None
+    trial_seed: Optional[str] = None  # replay key, same contract as the
+    # concurrent Counterexample.trial_seed: regenerate the program with
+    # random.Random(key).randrange(1 << 62)
+    shrink_steps: int = 0
+
+
+def _shrink_sequential(spec: Spec, sut: SequentialSUT, program: Program,
+                       result: SequentialResult, rounds: int = 200):
+    """Greedy QC-style shrink for the sequential property: re-run each
+    candidate (sequential execution is cheap) and step to the first one
+    still failing.  ``result`` is the caller's already-failing run of
+    ``program`` (no redundant re-execution)."""
+    steps = 0
+    for _ in range(rounds):
+        nxt = None
+        for cand in dedupe(shrink_candidates(spec, program), 256):
+            res = run_sequential(spec, sut, cand)
+            if not res.ok:
+                nxt = (cand, res)
+                break
+        if nxt is None:
+            break
+        program, result = nxt
+        steps += 1
+    return program, result, steps
+
+
+def prop_sequential(spec: Spec, sut: SequentialSUT, n_trials: int = 100,
+                    n_pids: int = 1, max_ops: int = 12, seed: int = 0
+                    ) -> SequentialPropertyResult:
+    """The reference's ``prop_sequential`` (SURVEY.md §3.4): generate →
+    run sequentially with inline postcondition checks → shrink failures.
+    Deterministic from ``seed``; no scheduler, no lineariser.  Seed keys
+    come from the SAME per-trial derivation as the concurrent property,
+    so one (seed, trial) names one program on both paths."""
+    # function-local: property.py sits above this module in the layer
+    # order (it imports sched/ops); a module-level import would invert it
+    from .property import trial_seed
+
+    for t in range(n_trials):
+        key = trial_seed(seed, t)
+        prog = generate_program(
+            spec, seed=random.Random(key).randrange(1 << 62),
+            n_pids=n_pids, max_ops=max_ops)
+        res = run_sequential(spec, sut, prog)
+        if not res.ok:
+            mp, mres, steps = _shrink_sequential(spec, sut, prog, res)
+            return SequentialPropertyResult(
+                ok=False, trials_run=t + 1, counterexample=mp,
+                history=mres.history, failed_at=mres.failed_at,
+                trial_seed=key, shrink_steps=steps)
+    return SequentialPropertyResult(ok=True, trials_run=n_trials)
