@@ -90,19 +90,71 @@ def _run_window_bench(bench_timeout: float, extra_args, label: str) -> bool:
     return bool(on_device)
 
 
+def _run_tool(script: str, out_path: str, timeout: float, label: str
+              ) -> None:
+    """Bank one auxiliary artifact (bench_configs / bench_e2e) from the
+    open window.  Device-capture discipline mirrors _run_window_bench:
+    a previously banked REAL-device artifact is never clobbered by a
+    CPU-fallback run (the tool writes to a temp path, promoted only when
+    its header shows no fallback), ``ok`` in the log means "device
+    capture", and the window is re-probed first so a closed window costs
+    one bounded probe instead of a full CPU-fallback workload."""
+    if os.path.exists(out_path):
+        _log(event=label, ok=True, detail="already banked; kept")
+        return
+    p = probe_default_backend(30)
+    if not p.is_device:
+        _log(event=label, ok=False, detail=f"window closed: {p.detail}")
+        return
+    t0 = time.time()
+    tmp = f"{out_path}.{os.getpid()}.tmp"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", script),
+             "--probe-timeout", "45", "--out", tmp],
+            capture_output=True, text=True, timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        _log(event=label, ok=False,
+             detail=f"exceeded {timeout:.0f}s (window closed mid-run?)")
+        return
+    on_device = False
+    try:
+        with open(tmp) as f:
+            header = json.loads(f.readline())
+        on_device = header.get("device_fallback") is None
+    except (OSError, ValueError):
+        pass
+    if on_device:
+        os.replace(tmp, out_path)
+    else:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+    _log(event=label, ok=on_device, rc=r.returncode,
+         seconds=round(time.time() - t0, 1))
+
+
 def _seize_window(bench_timeout: float) -> bool:
     """The tunnel just answered: bank a headline-only device line FIRST
     (sweep-free, fast), then try to upgrade it with the sweep-inclusive
-    full run.  If the window closes mid-sweep the headline capture
-    survives — a killed subprocess's stdout is gone, so never stake the
-    round's only real-chip artifact on the longest run."""
+    full run, then bank the per-config and e2e artifacts.  If the window
+    closes mid-way the earlier captures survive — a killed subprocess's
+    stdout is gone, so never stake the round's only real-chip artifact on
+    the longest run."""
     banked = _run_window_bench(bench_timeout / 2, ["--no-sweep"],
                                "window_bench_headline")
     if banked:
-        # chase the sweep upgrade only while the window is demonstrably
-        # open; after a failed bank the flicker closed — a full sweep on
-        # the CPU fallback would block probing for up to bench_timeout
+        # chase the upgrades only while the window is demonstrably open;
+        # after a failed bank the flicker closed — a full sweep on the
+        # CPU fallback would block probing for up to bench_timeout
         _run_window_bench(bench_timeout, [], "window_bench_full")
+        _run_tool("bench_configs.py",
+                  os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"),
+                  bench_timeout, "window_configs")
+        _run_tool("bench_e2e.py",
+                  os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json"),
+                  bench_timeout / 2, "window_e2e")
     return banked
 
 
