@@ -53,7 +53,9 @@ def test_two_process_jax_distributed_sharded_kernel_parity(tmp_path):
     logs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=300)
+            # generous: the workers each cold-start a JAX runtime; under
+            # heavy machine load 300s has been observed too tight
+            out, _ = p.communicate(timeout=600)
             logs.append(out)
     finally:
         for p in procs:

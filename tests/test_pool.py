@@ -54,3 +54,21 @@ def test_pool_ignored_without_factory():
         dataclasses.replace(CFG, n_trials=5, n_pids=2, max_ops=8,
                             executor_workers=4))  # no factory -> serial
     assert res.ok
+
+
+def test_pool_with_tcp_transport_matches_serial():
+    """Workers build their own loopback-TCP transports (PoolExecutor's
+    transport spec); results must still be bit-identical to the serial
+    in-memory run — the full transport × executor matrix holds."""
+    spec, sut = make("cas", "racy")
+    serial = prop_concurrent(spec, sut, CFG)
+    spec2, sut2 = make("cas", "racy")
+    pooled_tcp = prop_concurrent(
+        spec2, sut2,
+        dataclasses.replace(CFG, executor_workers=2, transport="tcp"),
+        sut_factory=SutFactory("cas", "racy"))
+    assert not serial.ok and not pooled_tcp.ok
+    assert (pooled_tcp.counterexample.history.fingerprint()
+            == serial.counterexample.history.fingerprint())
+    assert pooled_tcp.counterexample.trial_seed == \
+        serial.counterexample.trial_seed
