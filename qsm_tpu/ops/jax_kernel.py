@@ -194,13 +194,19 @@ def build_stepper(spec: Spec, n_ops: int, budget: int,
             f"cache_write must be 'onehot' or 'dus', got {cache_write!r}")
     shift = jnp.arange(32, dtype=jnp.uint32)
 
+    def _pack_bool(vec):
+        """bool[n_ops] -> uint32[n_words] bitmask — THE word layout, used
+        by both the cache keys and the packed precedence masks (one
+        definition so the layouts cannot drift apart)."""
+        pad = jnp.concatenate(
+            [vec, jnp.zeros(n_words * 32 - n_ops, bool)])
+        return jnp.sum(
+            pad.reshape(n_words, 32).astype(jnp.uint32) << shift, axis=1)
+
     def pack_key(taken, state):
         """(taken bool[N], state int32[S]) -> uint32[key_words], exact."""
-        pad = jnp.concatenate(
-            [taken, jnp.zeros(n_words * 32 - n_ops, bool)])
-        words = jnp.sum(
-            pad.reshape(n_words, 32).astype(jnp.uint32) << shift, axis=1)
-        return jnp.concatenate([words, state.astype(jnp.uint32)])
+        return jnp.concatenate([_pack_bool(taken),
+                                state.astype(jnp.uint32)])
 
     hash_slot = make_hash_slot(key_words, cache_slots) if use_cache else None
 
@@ -231,6 +237,17 @@ def build_stepper(spec: Spec, n_ops: int, budget: int,
 
     def run_one(carry, cmd, arg, resp, valid, precedes, chunk=None):
         n_req = jnp.sum(valid.astype(jnp.int32))
+        # precedence as packed words: blocked[j] = ∃i untaken: i precedes j.
+        # The naive form is an O(N²) bool matvec EVERY iteration; packed,
+        # the per-iteration cost is O(N·W) with W = ⌈N/32⌉ (same bitmask
+        # trick the native C++ checker uses).  Packed once per chunk call,
+        # outside the while body.
+        prec_pad = jnp.concatenate(
+            [precedes, jnp.zeros((n_words * 32 - n_ops, n_ops), bool)],
+            axis=0)
+        prec_words = jnp.sum(
+            prec_pad.reshape(n_words, 32, n_ops).astype(jnp.uint32)
+            << shift[None, :, None], axis=1)  # [W, N]
 
         if state_bound is not None:
             # per-history step table: [state_bound, n_ops] next-state / ok
@@ -250,7 +267,10 @@ def build_stepper(spec: Spec, n_ops: int, budget: int,
             state = states[d]
             untaken = valid & ~taken
             # minimality: op j is blocked if some untaken op precedes it
-            blocked = jnp.any(untaken[:, None] & precedes, axis=0)
+            # (packed-word AND — see prec_words above)
+            uw = _pack_bool(untaken)
+            blocked = jnp.any(
+                (prec_words & uw[:, None]) != jnp.uint32(0), axis=0)
             if state_bound is not None:
                 # one dynamic row gather instead of n_ops step evaluations.
                 # A state outside [0, bound) means the spec's

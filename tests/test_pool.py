@@ -13,9 +13,19 @@ from qsm_tpu.models.registry import SutFactory, make
 CFG = PropertyConfig(n_trials=24, n_pids=4, max_ops=16, seed=11)
 
 
-def test_pool_matches_serial_on_failure():
+import pytest
+
+
+@pytest.fixture(scope="module")
+def serial_racy_result():
+    """One serial baseline for every parity test in this module (the
+    run is deterministic, so sharing it is free)."""
     spec, sut = make("cas", "racy")
-    serial = prop_concurrent(spec, sut, CFG)
+    return prop_concurrent(spec, sut, CFG)
+
+
+def test_pool_matches_serial_on_failure(serial_racy_result):
+    serial = serial_racy_result
     spec2, sut2 = make("cas", "racy")
     pooled = prop_concurrent(
         spec2, sut2, dataclasses.replace(CFG, executor_workers=2),
@@ -56,12 +66,11 @@ def test_pool_ignored_without_factory():
     assert res.ok
 
 
-def test_pool_with_tcp_transport_matches_serial():
+def test_pool_with_tcp_transport_matches_serial(serial_racy_result):
     """Workers build their own loopback-TCP transports (PoolExecutor's
     transport spec); results must still be bit-identical to the serial
     in-memory run — the full transport × executor matrix holds."""
-    spec, sut = make("cas", "racy")
-    serial = prop_concurrent(spec, sut, CFG)
+    serial = serial_racy_result
     spec2, sut2 = make("cas", "racy")
     pooled_tcp = prop_concurrent(
         spec2, sut2,
