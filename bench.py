@@ -119,7 +119,8 @@ def _scale(on_tpu: bool) -> dict:
                 cpu_timebox_s=45.0, reps=1, budget=2_000)
 
 
-def run_sweep(on_tpu: bool) -> dict:
+def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
+              box_s: float = 60.0) -> dict:
     """Measure "max ops solved < 60 s" (BASELINE.json:2 second metric;
     VERDICT.md round 2, "Next round" #4): for CAS and queue, scan op
     buckets 12→128 (96/128 exceed the reference's largest config) per
@@ -136,9 +137,10 @@ def run_sweep(on_tpu: bool) -> dict:
     from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
     from qsm_tpu.utils.corpus import build_corpus as shared
 
-    box_s = 60.0
-    n_sample = 16 if on_tpu else 8
-    buckets = (12, 24, 48, 64, 96, 128)  # 96/128 exceed the reference's
+    if n_sample is None:
+        n_sample = 16 if on_tpu else 8
+    if buckets is None:  # 96/128 exceed the reference's
+        buckets = (12, 24, 48, 64, 96, 128)
     # largest config — long-context headroom (VERDICT r2 #4: "add buckets
     # beyond 64 if the device can take them")
     # per-backend coverage caps: past the native checker's taken-mask cap

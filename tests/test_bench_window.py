@@ -98,3 +98,20 @@ def test_force_cpu_ignores_window_artifact(tmp_path, monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] != 12345.6
     assert out["extras"]["device_fallback"] == "cpu"
+
+
+def test_run_sweep_structure_fast():
+    """The sweep path (default bench run) at miniature scale: structure,
+    solved table, and the honest cpp coverage cap."""
+    bench = _load_bench()
+    sw = bench.run_sweep(on_tpu=False, buckets=(12, 24), n_sample=2,
+                         box_s=30.0)
+    assert set(sw["solved"]) == {"cas", "queue"}
+    for cname, backends in sw["solved"].items():
+        assert "memo" in backends and "device" in backends, cname
+        for bname, best in backends.items():
+            assert best in (0, 12, 24), (cname, bname, best)
+    # cells carry per-bucket measurements with verdict accounting
+    cas_memo = sw["cells"]["cas"]["memo"]
+    assert "12" in cas_memo and cas_memo["12"]["undecided"] == 0
+    assert cas_memo["12"]["solved"] is True
