@@ -1,5 +1,6 @@
-"""The five milestone specs + correct/racy SUT pairs (BASELINE.json:7-11;
-SURVEY.md §2 Examples — the reference's test suite IS its examples)."""
+"""The five milestone specs (BASELINE.json:7-11) plus extra model
+families (set, stack), each a correct/racy SUT pair (SURVEY.md §2
+Examples — the reference's test suite IS its examples)."""
 
 from .register import (AtomicRegisterSUT, RacyCachedRegisterSUT,
                        RegisterSpec, ReplicatedRegisterSUT)
@@ -7,3 +8,5 @@ from .counter import AtomicTicketSUT, RacyTicketSUT, TicketSpec
 from .cas import AtomicCasSUT, CasSpec, RacyCasSUT
 from .queue import AtomicQueueSUT, QueueSpec, RacyTwoPhaseQueueSUT
 from .kv import AtomicKvSUT, KvSpec, StaleCacheKvSUT
+from .set import AtomicSetSUT, RacyCheckThenActSetSUT, SetSpec
+from .stack import AtomicStackSUT, RacyTwoPhaseStackSUT, StackSpec

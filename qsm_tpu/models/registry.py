@@ -16,6 +16,8 @@ from .kv import AtomicKvSUT, KvSpec, StaleCacheKvSUT
 from .queue import AtomicQueueSUT, QueueSpec, RacyTwoPhaseQueueSUT
 from .register import (AtomicRegisterSUT, RacyCachedRegisterSUT,
                        RegisterSpec, ReplicatedRegisterSUT)
+from .set import AtomicSetSUT, RacyCheckThenActSetSUT, SetSpec
+from .stack import AtomicStackSUT, RacyTwoPhaseStackSUT, StackSpec
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,6 +56,15 @@ MODELS: Dict[str, ModelEntry] = {
         make_spec=KvSpec,
         impls={"atomic": AtomicKvSUT, "racy": StaleCacheKvSUT},
         default_pids=16, default_ops=64),
+    # extra model families beyond the five milestone configs
+    "set": ModelEntry(
+        make_spec=SetSpec,
+        impls={"atomic": AtomicSetSUT, "racy": RacyCheckThenActSetSUT},
+        default_pids=4, default_ops=24),
+    "stack": ModelEntry(
+        make_spec=StackSpec,
+        impls={"atomic": AtomicStackSUT, "racy": RacyTwoPhaseStackSUT},
+        default_pids=8, default_ops=32),
 }
 
 

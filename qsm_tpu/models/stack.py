@@ -1,0 +1,178 @@
+"""Bounded LIFO stack — an additional vector-state model family beyond
+the five milestone configs (SURVEY.md §2 Examples).
+
+Mirrors the FIFO queue config's representation choices (models/queue.py,
+SURVEY.md §7 hard-parts #2): model state is the packed int32 vector
+``[length, slot0..slotC-1]`` with a branchless jitted transition — but the
+LIFO discipline makes the top of the stack a *dynamic* slot
+(``slots[length-1]``), so the jax step exercises a dynamic gather where
+the queue's head was a static index.  A native C++ step kernel (wg.cpp
+kind 3) gives the host checker plane the same fast path the queue has.
+
+The racy implementation's pop is top-read + drop as separate round trips:
+two concurrent pops can both observe (and both "remove") the same top —
+the LIFO twin of the queue's duplicate-dequeue race.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.spec import CmdSig, Spec
+from ..sched.scheduler import Recv, Scheduler, Send
+
+PUSH = 0
+POP = 1
+
+OK = 0
+FULL = 1
+
+
+class StackSpec(Spec):
+    """Bounded LIFO stack of capacity ``capacity`` over values [0, n_values).
+
+    PUSH(v) responds OK(0) and appends, or FULL(1) when at capacity.
+    POP responds the top value, or the sentinel ``n_values`` when empty.
+    Model state: ``[length, slot0, ..., slot_{capacity-1}]`` with
+    ``slot_{length-1}`` the top; vacated slots are zeroed so equal stack
+    contents always pack to the same state vector (canonical form matters
+    for memoised oracles).
+    """
+
+    name = "stack"
+
+    def __init__(self, capacity: int = 4, n_values: int = 4):
+        self.capacity = capacity
+        self.n_values = n_values
+        self.STATE_DIM = 1 + capacity
+        self.EMPTY = n_values  # POP-on-empty response sentinel
+        self.CMDS = (
+            CmdSig("push", n_args=n_values, n_resps=2),
+            CmdSig("pop", n_args=1, n_resps=n_values + 1),
+        )
+
+    def initial_state(self) -> np.ndarray:
+        return np.zeros(self.STATE_DIM, np.int32)
+
+    def spec_kwargs(self):
+        return {"capacity": self.capacity, "n_values": self.n_values}
+
+    def native_kernel(self):
+        return (3, self.capacity, self.n_values)  # wg.cpp kind 3
+
+    def step_py(self, state, cmd, arg, resp):
+        length = state[0]
+        slots = list(state[1:])
+        if cmd == PUSH:
+            if length == self.capacity:
+                return [length] + slots, resp == FULL
+            new = slots.copy()
+            new[length] = arg
+            return [length + 1] + new, resp == OK
+        if length == 0:
+            return [0] + slots, resp == self.EMPTY
+        top = slots[length - 1]
+        new = slots.copy()
+        new[length - 1] = 0  # canonical form: vacated top zeroed
+        return [length - 1] + new, resp == top
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        length = state[0]
+        slots = state[1:]
+        iota = jnp.arange(self.capacity)
+
+        is_push = cmd == PUSH
+        full = length == self.capacity
+        empty = length == 0
+        top = slots[jnp.maximum(length - 1, 0)]  # dynamic gather
+
+        push_ok = jnp.where(full, resp == FULL, resp == OK)
+        pop_ok = jnp.where(empty, resp == self.EMPTY, resp == top)
+        ok = jnp.where(is_push, push_ok, pop_ok)
+
+        push_slots = jnp.where((iota == length) & ~full, arg, slots)
+        pop_slots = jnp.where((iota == length - 1) & ~empty, 0, slots)
+        new_slots = jnp.where(is_push, push_slots, pop_slots)
+        new_len = jnp.where(is_push,
+                            length + (~full).astype(length.dtype),
+                            length - (~empty).astype(length.dtype))
+        new_state = jnp.concatenate(
+            [new_len[None], new_slots]).astype(state.dtype)
+        return new_state, ok
+
+
+# ---------------------------------------------------------------------------
+# SUT implementations
+# ---------------------------------------------------------------------------
+
+def _stack_server(st: dict, capacity: int, n_values: int):
+    """Atomic per-message stack server; also answers the racy SUT's
+    two-phase ('top', 'drop') protocol."""
+    while True:
+        msg = yield Recv()
+        kind, *rest = msg.payload
+        items = st["items"]
+        if kind == "push":
+            if len(items) >= capacity:
+                yield Send(msg.src, FULL)
+            else:
+                items.append(rest[0])
+                yield Send(msg.src, OK)
+        elif kind == "pop":
+            yield Send(msg.src, items.pop() if items else n_values)
+        elif kind == "top":
+            yield Send(msg.src, items[-1] if items else n_values)
+        elif kind == "drop":
+            if items:
+                items.pop()
+            yield Send(msg.src, OK)
+
+
+class AtomicStackSUT:
+    """Correct: push/pop each a single atomically-applied server message.
+    Expected to PASS prop_concurrent."""
+
+    def __init__(self, spec: StackSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.st = {"items": []}
+        sched.spawn("server",
+                    _stack_server(self.st, self.spec.capacity,
+                                  self.spec.n_values), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        yield Send("server", ("push", arg) if cmd == PUSH else ("pop",))
+        msg = yield Recv()
+        return msg.payload
+
+
+class RacyTwoPhaseStackSUT:
+    """Racy: pop is top-read then drop as separate round trips; two
+    concurrent pops can both return the same top (duplicate delivery)
+    while two elements get dropped.  Expected to FAIL."""
+
+    def __init__(self, spec: StackSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.st = {"items": []}
+        sched.spawn("server",
+                    _stack_server(self.st, self.spec.capacity,
+                                  self.spec.n_values), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        if cmd == PUSH:
+            yield Send("server", ("push", arg))
+            msg = yield Recv()
+            return msg.payload
+        yield Send("server", ("top",))
+        msg = yield Recv()
+        top = msg.payload
+        if top == self.spec.n_values:
+            return top  # observed empty
+        yield Send("server", ("drop",))
+        yield Recv()
+        return top

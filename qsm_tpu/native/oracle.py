@@ -229,7 +229,7 @@ class CppOracle:
         """Bit width bounding any state element of a native vector kernel
         (lets the C++ memo pack the state into one 64-bit word instead of
         allocating a string key per DFS node).  0 = unknown, use strings."""
-        if kind == 1:    # queue: [length <= capacity, slots < n_values]
+        if kind in (1, 3):  # queue/stack: [length <= cap, slots < n_values]
             return max(p0, p1 - 1).bit_length() or 1
         if kind == 2:    # kv: values < n_values
             return max(1, (p1 - 1).bit_length())

@@ -17,7 +17,9 @@
 //   1 — bounded FIFO queue (models/queue.py semantics reimplemented:
 //       state = [length, slot0..slotC-1], params = capacity, n_values);
 //   2 — multi-key KV map (models/kv.py: state = value per key,
-//       params = n_keys, n_values).
+//       params = n_keys, n_values);
+//   3 — bounded LIFO stack (models/stack.py: state = [length, slots...],
+//       params = capacity, n_values; top is slots[length-1]).
 // Vector kinds evaluate the step directly (total in the response, exactly
 // like step_py), so only ARG domains need host-side routing; parity with
 // the Python oracle is pinned by tests/test_native.py.
@@ -37,7 +39,7 @@ namespace {
 constexpr int MAX_STATE = 64;  // state vector length cap (router enforces)
 
 struct SpecDesc {
-    int kind;        // 0 table, 1 queue, 2 kv
+    int kind;        // 0 table, 1 queue, 2 kv, 3 stack
     int state_dim;
     int32_t p0, p1;  // queue: capacity, n_values; kv: n_keys, n_values
     const int32_t* trans;  // kind 0 only: [S][C][A][R]
@@ -65,7 +67,8 @@ static inline bool start_state_invalid(const SpecDesc& sp,
     switch (sp.kind) {
         case 0:
             return s[0] < 0 || s[0] >= sp.S;
-        case 1: {
+        case 1:
+        case 3: {  // queue/stack share the [length, slots...] layout
             if (s[0] < 0 || s[0] > sp.p0) return true;       // length
             for (int i = 1; i <= sp.p0; ++i)                 // slots
                 if (s[i] < 0 || s[i] >= sp.p1) return true;
@@ -113,6 +116,21 @@ static inline bool do_step(const SpecDesc& sp, const int32_t* s,
             if (cmd == 0) return resp == s[arg];       // GET(key)
             out[arg / n_values] = arg % n_values;      // PUT packs k*V+v
             return resp == 0;
+        }
+        case 3: {  // bounded LIFO stack: s = [length, slots...]
+            const int cap = sp.p0, n_values = sp.p1;
+            const int length = s[0];
+            std::memcpy(out, s, sizeof(int32_t) * (1 + cap));
+            if (cmd == 0) {                       // PUSH(arg)
+                if (length == cap) return resp == 1;   // FULL
+                out[1 + length] = arg;
+                out[0] = length + 1;
+                return resp == 0;                      // OK
+            }
+            if (length == 0) return resp == n_values;  // POP on empty
+            out[length] = 0;  // canonical form: vacated top zeroed
+            out[0] = length - 1;
+            return resp == s[length];                  // top = slots[len-1]
         }
     }
     return false;

@@ -24,7 +24,10 @@ def _failing_result():
 
 
 def test_registry_covers_all_five_configs():
-    assert set(MODELS) == {"register", "ticket", "cas", "queue", "kv"}
+    # the five milestone configs (BASELINE.json:7-11) + extra families
+    assert {"register", "ticket", "cas", "queue", "kv"} <= set(MODELS)
+    assert set(MODELS) == {"register", "ticket", "cas", "queue", "kv",
+                           "set", "stack"}
     for name, entry in MODELS.items():
         spec, sut = make(name, "racy")
         assert hasattr(sut, "perform")
