@@ -534,7 +534,16 @@ class JaxTPU:
                 return run_one(carry, cmd, arg, resp, valid, precedes,
                                chunk=chunk)
 
-            fn = jax.jit(jax.vmap(run_chunk, in_axes=(0, 0, 0, 0, 0, 0)))
+            # Donate the input carry: it is dead the moment the chunk call
+            # returns (the driver only ever reads the RETURNED carry), and
+            # the carry dominates the kernel's memory (stack + states +
+            # memo cache per lane) — donation lets XLA update it in place
+            # instead of double-buffering it in HBM every chunk.  The CPU
+            # backend can't donate and warns per call site, so only donate
+            # where it works (the carry is small enough either way there).
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            fn = jax.jit(jax.vmap(run_chunk, in_axes=(0, 0, 0, 0, 0, 0)),
+                         donate_argnums=donate)
             self._compiled[key] = fn
         return fn
 

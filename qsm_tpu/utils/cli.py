@@ -320,6 +320,21 @@ def cmd_coverage(args) -> int:
     return 0
 
 
+def cmd_fuzz(args) -> int:
+    from .fuzz import fuzz_parity
+
+    rep = fuzz_parity(n_specs=args.specs, hists_per_spec=args.histories,
+                      seed=args.seed, n_pids=args.pids, n_ops=args.ops,
+                      p_pending=args.p_pending,
+                      backends=tuple(args.backends.split(",")))
+    print(json.dumps({
+        "specs": rep.specs, "histories": rep.histories,
+        "linearizable": rep.linearizable, "violations": rep.violations,
+        "budget_exceeded": rep.budget_exceeded,
+        "mismatches": rep.mismatches[:20], "ok": rep.ok}))
+    return 0 if rep.ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="qsm_tpu",
@@ -350,6 +365,18 @@ def main(argv=None) -> int:
     p.add_argument("--ops", type=int, default=None)
     p.add_argument("--corpus", type=int, default=256)
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "fuzz", help="differential backend fuzzing over random specs")
+    p.add_argument("--specs", type=int, default=10)
+    p.add_argument("--histories", type=int, default=32)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--pids", type=int, default=4)
+    p.add_argument("--ops", type=int, default=10)
+    p.add_argument("--p-pending", type=float, default=0.1)
+    p.add_argument("--backends", default="memo,cpp,device",
+                   help="comma list from {memo, cpp, device}")
+    p.set_defaults(fn=cmd_fuzz)
 
     p = sub.add_parser("coverage", help="schedule-coverage stats")
     p.add_argument("--model", required=True, choices=sorted(MODELS))

@@ -1,0 +1,210 @@
+"""Differential fuzzing of the checker backends over RANDOM specs.
+
+The curated parity suites (tests/test_parity.py and friends) pin
+CPU == C++ == device verdicts on histories produced by the in-tree
+models.  This module removes the "in-tree" qualifier: it generates
+whole *specifications* at random — arbitrary seeded transition tables —
+plus random concurrent histories against them, and asserts every backend
+agrees with the exact Python oracle (SURVEY.md §4: the cross-backend
+parity suite, property-tested; here the property ranges over specs too).
+
+A random table is the adversarial case for the fast paths: it has no
+algebraic structure for a bug to hide behind (canonical-form tricks,
+idempotence, commutativity all absent), so ordering mistakes in the
+search — candidate order, memo keying, budget accounting, precedence
+masks — decohere from the oracle almost immediately.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.history import History, Op
+from ..core.spec import CmdSig, Spec
+from ..sched.runner import PENDING_T  # the one pending-time sentinel
+
+
+class RandomTableSpec(Spec):
+    """A spec whose fused step function IS a seeded random table.
+
+    ``trans[s, c, a, r]`` is uniform over [0, n_states); ``ok[s, c, a, r]``
+    is true with probability ``ok_bias`` (biased toward ok so random
+    histories aren't all trivially non-linearizable).  Scalar state with
+    ``scalar_state_bound == n_states`` by construction, so the spec rides
+    the domain-table fast paths of all three backends.
+    """
+
+    name = "random_table"
+    STATE_DIM = 1
+
+    def __init__(self, seed: int, n_states: int = 8, n_cmds: int = 3,
+                 max_args: int = 3, max_resps: int = 3,
+                 ok_bias: float = 0.7):
+        self.seed = seed
+        self.n_states = n_states
+        self.ok_bias = ok_bias
+        # the DOMAIN bounds, not the observed maxima: spec_kwargs must
+        # round-trip (Spec.max_resps is a derived property, hence _bound)
+        self._max_args_bound = max_args
+        self._max_resps_bound = max_resps
+        rng = np.random.default_rng(seed)
+        self.CMDS = tuple(
+            CmdSig(f"c{i}", n_args=int(rng.integers(1, max_args + 1)),
+                   n_resps=int(rng.integers(1, max_resps + 1)))
+            for i in range(n_cmds))
+        a = max(c.n_args for c in self.CMDS)
+        r = max(c.n_resps for c in self.CMDS)
+        self._trans = rng.integers(
+            0, n_states, size=(n_states, n_cmds, a, r), dtype=np.int32)
+        self._ok = rng.random((n_states, n_cmds, a, r)) < ok_bias
+        self._jnp_tables = None
+
+    def initial_state(self) -> np.ndarray:
+        return np.zeros(1, np.int32)
+
+    def scalar_state_bound(self, n_ops):
+        return self.n_states
+
+    def spec_kwargs(self):
+        return {"seed": self.seed, "n_states": self.n_states,
+                "n_cmds": len(self.CMDS),
+                "max_args": self._max_args_bound,
+                "max_resps": self._max_resps_bound,
+                "ok_bias": self.ok_bias}
+
+    def step_py(self, state, cmd, arg, resp):
+        s = state[0]
+        if not 0 <= s < self.n_states:
+            # unreachable from the initial state (trans values are all in
+            # range) but probed by compile_step_table when the native
+            # backend rounds the table bound up: define as a failing
+            # self-loop so every tabulation of this spec agrees
+            return [s], False
+        return ([int(self._trans[s, cmd, arg, resp])],
+                bool(self._ok[s, cmd, arg, resp]))
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        if self._jnp_tables is None:
+            self._jnp_tables = (jnp.asarray(self._trans),
+                                jnp.asarray(self._ok))
+        trans, ok = self._jnp_tables
+        s = state[0]
+        return (jnp.stack([trans[s, cmd, arg, resp]]),
+                ok[s, cmd, arg, resp])
+
+
+def random_history(spec: Spec, rng: random.Random, n_pids: int,
+                   n_ops: int, p_pending: float = 0.0) -> History:
+    """A random well-formed concurrent history against ``spec``.
+
+    Simulated clock: each tick either invokes a fresh op on an idle pid or
+    completes an outstanding one, so per-pid ops are sequential while ops
+    on different pids overlap arbitrarily — the full shape space the
+    scheduler plane can emit, without needing a SUT (a random spec has no
+    implementation; responses are drawn uniformly from the command's
+    domain, so verdicts split between linearizable and violating).
+    """
+    remaining = n_ops
+    outstanding: Dict[int, Op] = {}
+    dead: set = set()  # pids whose op went pending: blocked forever
+    done: List[Op] = []
+    t = 0
+    while remaining > 0 or outstanding:
+        idle = [p for p in range(n_pids)
+                if p not in outstanding and p not in dead]
+        can_invoke = remaining > 0 and idle
+        if not can_invoke and not outstanding:
+            break  # every pid is dead; undone ops are simply not issued
+        if can_invoke and (not outstanding or rng.random() < 0.5):
+            pid = rng.choice(idle)
+            cmd = rng.randrange(len(spec.CMDS))
+            arg = rng.randrange(spec.CMDS[cmd].n_args)
+            outstanding[pid] = Op(pid=pid, cmd=cmd, arg=arg, resp=-1,
+                                  invoke_time=t, response_time=PENDING_T)
+            remaining -= 1
+        else:
+            pid = rng.choice(sorted(outstanding))
+            op = outstanding.pop(pid)
+            if rng.random() < p_pending:
+                done.append(op)  # never responds (crash/drop shape)
+                dead.add(pid)    # a blocked pid can't invoke again
+            else:
+                resp = rng.randrange(spec.CMDS[op.cmd].n_resps)
+                done.append(dataclasses.replace(
+                    op, resp=resp, response_time=t))
+        t += 1
+    done.sort(key=lambda o: o.invoke_time)
+    return History(done)
+
+
+@dataclasses.dataclass
+class FuzzReport:
+    specs: int
+    histories: int
+    linearizable: int
+    violations: int
+    budget_exceeded: int
+    mismatches: List[Tuple[int, int, str, int, int]]
+    # (spec_seed, history_index, backend_name, oracle_verdict, got)
+
+    @property
+    def ok(self) -> bool:
+        return not self.mismatches
+
+
+def fuzz_parity(n_specs: int = 10, hists_per_spec: int = 32,
+                seed: int = 0, n_pids: int = 4, n_ops: int = 10,
+                p_pending: float = 0.1,
+                backends: Sequence[str] = ("memo", "cpp", "device"),
+                spec_kwargs: Optional[dict] = None) -> FuzzReport:
+    """Differential sweep: for each random spec, every requested backend
+    must agree with the exact (memo-free) Python oracle on every random
+    history.  BUDGET_EXCEEDED never counts as a mismatch on its own —
+    backends may defer — but a decided verdict that contradicts the
+    oracle's decided verdict always does.
+    """
+    from ..native import CppOracle
+    from ..ops.backend import Verdict
+    from ..ops.jax_kernel import JaxTPU
+    from ..ops.wing_gong_cpu import WingGongCPU
+
+    oracle = WingGongCPU(memo=False)
+    lin = vio = bud = 0
+    mismatches: List[Tuple[int, int, str, int, int]] = []
+    for k in range(n_specs):
+        spec_seed = seed * 1_000_003 + k
+        spec = RandomTableSpec(spec_seed, **(spec_kwargs or {}))
+        rng = random.Random(f"fuzz:{spec_seed}")
+        hists = [random_history(spec, rng, n_pids, n_ops,
+                                p_pending=p_pending)
+                 for _ in range(hists_per_spec)]
+        want = oracle.check_histories(spec, hists)
+        lin += int((want == int(Verdict.LINEARIZABLE)).sum())
+        vio += int((want == int(Verdict.VIOLATION)).sum())
+        bud += int((want == int(Verdict.BUDGET_EXCEEDED)).sum())
+        for name in backends:
+            if name == "memo":
+                backend = WingGongCPU(memo=True)
+            elif name == "cpp":
+                backend = CppOracle(spec)
+            elif name == "device":
+                backend = JaxTPU(spec)
+            else:
+                raise ValueError(f"unknown fuzz backend {name!r}")
+            got = backend.check_histories(spec, hists)
+            for i, (w, g) in enumerate(zip(want, got)):
+                undecided = int(Verdict.BUDGET_EXCEEDED)
+                if int(g) == undecided or int(w) == undecided:
+                    continue  # honest deferral (either side), never a
+                    # mismatch on its own — only decided-vs-decided counts
+                if int(w) != int(g):
+                    mismatches.append((spec_seed, i, name, int(w), int(g)))
+    return FuzzReport(specs=n_specs, histories=n_specs * hists_per_spec,
+                      linearizable=lin, violations=vio,
+                      budget_exceeded=bud, mismatches=mismatches)
