@@ -1,0 +1,223 @@
+"""Root splitting — intra-history search parallelism.
+
+The batched device kernel is data-parallel over *histories* (one DFS per
+lane); a single pathological history therefore occupies one lane while the
+rest of the batch idles — the lockstep tail the chunked driver compacts
+around.  Root splitting attacks the tail itself: it decomposes ONE search
+into many independent sub-searches and spreads them across lanes, the
+search-space analog of tensor parallelism (SURVEY.md §2b names in-kernel
+frontier parallelism as exactly this analog).
+
+The decomposition is the first Wing–Gong choice point made explicit: the
+set of linearizations of a complete history partitions by which
+precedence-minimal operation linearizes FIRST.  For each minimal op ``j``
+whose postcondition holds from the current state, the child problem is the
+same history minus ``j``, checked from ``step(state, j)`` — precisely the
+per-lane ``init_states`` route the kernel already exposes for the
+segmentation combinator (ops/jax_kernel.py ``check_histories``).  So:
+
+    linearizable(h, s)  ⇔  ∃ j minimal, ok(s, j):
+                               linearizable(h − j, step(s, j))
+
+Splitting to ``depth`` d yields up to ``pids^d`` children (only minimal
+ops branch, and only ok steps survive); children arising from different
+orders of the same op set are deduplicated by their (remaining-ops,
+state) configuration — the root-level analog of the Lowe memo cache.
+
+Aggregation per input history: any child LINEARIZABLE → LINEARIZABLE;
+else any child BUDGET_EXCEEDED → BUDGET_EXCEEDED (the undecided child
+could have been the succeeding branch); else VIOLATION.  Histories with
+pending ops are routed to the inner backend whole (their completion
+expansion already multiplies lanes).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.history import History
+from ..core.spec import Spec
+from .backend import LineariseBackend, Verdict
+
+
+def _split_once(spec: Spec, h: History, state: Tuple[int, ...]
+                ) -> List[Tuple[History, Tuple[int, ...]]]:
+    """All ok (child-history, child-state) pairs one root step down."""
+    prec = h.precedes_matrix()
+    out = []
+    for j in range(len(h.ops)):
+        if prec[:, j].any():
+            continue  # some op precedes j: j cannot linearize first
+        o = h.ops[j]
+        nxt, ok = spec.step_py(list(state), o.cmd, o.arg, o.resp)
+        if not ok:
+            continue  # this first choice dies immediately
+        rest = History([p for i, p in enumerate(h.ops) if i != j],
+                       seed=h.seed, program_id=h.program_id)
+        out.append((rest, tuple(int(v) for v in nxt)))
+    return out
+
+
+def split_history(spec: Spec, h: History, depth: int = 1,
+                  init_state=None, max_children: int = 256
+                  ) -> Optional[List[Tuple[History, Tuple[int, ...]]]]:
+    """Decompose ``h`` into root-split children at the given depth, or
+    None when splitting does not apply (pending ops, empty, or the
+    frontier would exceed ``max_children``).
+
+    Children are deduplicated by (remaining-op identity set, state):
+    depth ≥ 2 reaches the same configuration along every permutation of
+    the removed ops, and deciding it once is enough (any-path semantics).
+    An EMPTY returned list is meaningful: every first choice failed its
+    postcondition, i.e. the history is a proven VIOLATION.
+    """
+    if len(h.ops) == 0 or h.n_pending or depth < 1:
+        return None
+    state = tuple(int(v) for v in (spec.initial_state()
+                                   if init_state is None else init_state))
+    frontier = [(h, state)]
+    for _ in range(depth):
+        nxt: List[Tuple[History, Tuple[int, ...]]] = []
+        seen = set()
+        for hist, st in frontier:
+            if len(hist.ops) == 0:
+                # already fully linearized along this branch: keep as a
+                # trivially-LINEARIZABLE child rather than re-splitting
+                key = ((), st)
+                if key not in seen:
+                    seen.add(key)
+                    nxt.append((hist, st))
+                continue
+            for child, cst in _split_once(spec, hist, st):
+                key = (tuple((o.pid, o.invoke_time) for o in child.ops),
+                       cst)
+                if key in seen:
+                    continue
+                seen.add(key)
+                nxt.append((child, cst))
+        frontier = nxt
+        if len(frontier) > max_children:
+            return None  # splitting would flood the batch; caller decides
+        if not frontier:
+            return []  # every branch died: proven VIOLATION
+    return frontier
+
+
+def _check_with_inits(inner: LineariseBackend, spec: Spec,
+                      hists: Sequence[History],
+                      inits: Sequence[Tuple[int, ...]]) -> np.ndarray:
+    """Batched init-state check on backends that support it (JaxTPU,
+    CppOracle); per-history ``check_from`` loop otherwise (oracle).
+    Capability by signature inspection (same detection as SegDC) — an
+    ``except TypeError`` around the call would swallow genuine TypeErrors
+    raised inside a capable inner."""
+    import inspect
+
+    sig = inspect.signature(inner.check_histories)
+    if "init_states" in sig.parameters:
+        return inner.check_histories(spec, hists, init_states=list(inits))
+    return np.asarray(
+        [int(inner.check_from(spec, h, np.asarray(s, np.int32)))
+         for h, s in zip(hists, inits)], np.int8)
+
+
+class RootSplit:
+    """Backend combinator: parallelize the HARD TAIL by root splitting.
+
+    Two modes, chosen by measurement (docs/EXPERIMENTS.md):
+
+    * ``eager=False`` (default, ESCALATION): run the inner backend on the
+      whole histories first; only those it returns BUDGET_EXCEEDED for are
+      split and re-decided as children.  A parent search had to explore
+      all root subtrees *sequentially* within one lane's budget; its
+      children each get a full budget for ONE subtree — splitting
+      multiplies the effective iteration budget by the fanout exactly
+      where the search is pathological, and costs nothing anywhere else.
+    * ``eager=True``: split every history of ≥ ``min_ops`` ops up front.
+      Measured 31× MORE total lockstep work on the CAS bench corpus
+      (children forfeit the shared in-kernel memo cache and multiply the
+      padded batch) — kept for experiments, not the default.
+
+    ``depth`` is the number of root levels to expand (fanout ≈ number of
+    concurrent pids per level).
+    """
+
+    def __init__(self, spec: Spec, inner: LineariseBackend = None,
+                 depth: int = 1, min_ops: int = 8,
+                 max_children: int = 256, eager: bool = False):
+        from .wing_gong_cpu import WingGongCPU
+
+        self.spec = spec
+        self.inner = inner if inner is not None else WingGongCPU(memo=True)
+        self.depth = depth
+        self.min_ops = min_ops
+        self.max_children = max_children
+        self.eager = eager
+        self.name = f"rootsplit({self.inner.name})"
+        self.split_histories = 0   # inputs that were actually decomposed
+        self.children_checked = 0
+
+    # -- shared: split a set of histories, decide children, aggregate ----
+    def _decide_split(self, spec: Spec, idx: List[int],
+                      histories: Sequence[History],
+                      verdicts: np.ndarray) -> List[int]:
+        """Split ``histories[i]`` for i in idx; write aggregated verdicts;
+        return the indices that could NOT be split (caller routes them)."""
+        unsplit: List[int] = []
+        flat: List[History] = []
+        flat_inits: List[Tuple[int, ...]] = []
+        groups: List[Tuple[int, slice]] = []
+        for i in idx:
+            h = histories[i]
+            kids = (split_history(spec, h, depth=self.depth,
+                                  max_children=self.max_children)
+                    if len(h.ops) >= self.min_ops else None)
+            if kids is None:
+                unsplit.append(i)
+            elif not kids:
+                verdicts[i] = int(Verdict.VIOLATION)  # all roots died
+                self.split_histories += 1
+            else:
+                groups.append(
+                    (i, slice(len(flat), len(flat) + len(kids))))
+                flat.extend(k for k, _ in kids)
+                flat_inits.extend(s for _, s in kids)
+                self.split_histories += 1
+        if flat:
+            sub = _check_with_inits(self.inner, spec, flat, flat_inits)
+            self.children_checked += len(flat)
+            for i, g in groups:
+                v = sub[g]
+                if (v == int(Verdict.LINEARIZABLE)).any():
+                    verdicts[i] = int(Verdict.LINEARIZABLE)
+                elif (v == int(Verdict.BUDGET_EXCEEDED)).any():
+                    verdicts[i] = int(Verdict.BUDGET_EXCEEDED)
+                else:
+                    verdicts[i] = int(Verdict.VIOLATION)
+        return unsplit
+
+    def check_histories(self, spec: Spec, histories: Sequence[History]
+                        ) -> np.ndarray:
+        assert spec is self.spec, "RootSplit is bound to one spec"
+        verdicts = np.full(len(histories), int(Verdict.BUDGET_EXCEEDED),
+                           np.int8)
+        if self.eager:
+            unsplit = self._decide_split(
+                spec, list(range(len(histories))), histories, verdicts)
+            if unsplit:
+                sub = self.inner.check_histories(
+                    spec, [histories[i] for i in unsplit])
+                for k, i in enumerate(unsplit):
+                    verdicts[i] = sub[k]
+            return verdicts
+        # escalation (default): whole pass first, split only the hard tail
+        verdicts[:] = self.inner.check_histories(spec, histories)
+        hard = [i for i, v in enumerate(verdicts)
+                if v == int(Verdict.BUDGET_EXCEEDED)]
+        if hard:
+            # unsplittable hard histories keep their BUDGET_EXCEEDED —
+            # the property layer resolves those via the oracle as usual
+            self._decide_split(spec, hard, histories, verdicts)
+        return verdicts

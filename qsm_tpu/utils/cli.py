@@ -26,7 +26,8 @@ from .stats import schedule_coverage
 # one list for every subcommand: a backend added to only one
 # parser would silently be unselectable from the other
 _BACKENDS = ("cpu", "cpp", "tpu", "pcomp", "pcomp-cpp", "pcomp-tpu",
-             "segdc", "segdc-cpp", "segdc-tpu")
+             "segdc", "segdc-cpp", "segdc-tpu", "rootsplit",
+             "rootsplit-tpu")
 
 
 def _ensure_device_reachable(timeout_s: float = 45.0) -> None:
@@ -140,6 +141,16 @@ def _make_backend_inner(name: str, spec):
         from ..ops.segdc import SegDC
 
         return SegDC(spec, lambda s: JaxTPU(s))
+    if name == "rootsplit":
+        from ..ops.rootsplit import RootSplit
+
+        return RootSplit(spec)
+    if name == "rootsplit-tpu":
+        _ensure_device_reachable()
+        from ..ops.jax_kernel import JaxTPU
+        from ..ops.rootsplit import RootSplit
+
+        return RootSplit(spec, JaxTPU(spec))
     raise SystemExit(f"unknown backend {name!r}")
 
 

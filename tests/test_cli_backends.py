@@ -46,6 +46,7 @@ def test_every_backend_choice_constructs(healthy_probe):
     from qsm_tpu.native import CppOracle
     from qsm_tpu.ops.jax_kernel import JaxTPU
     from qsm_tpu.ops.pcomp import PComp
+    from qsm_tpu.ops.rootsplit import RootSplit
     from qsm_tpu.ops.segdc import SegDC
     from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
 
@@ -62,6 +63,8 @@ def test_every_backend_choice_constructs(healthy_probe):
         "segdc": (SegDC, QueueSpec),
         "segdc-cpp": (SegDC, QueueSpec),
         "segdc-tpu": (SegDC, QueueSpec),
+        "rootsplit": (RootSplit, QueueSpec),
+        "rootsplit-tpu": (RootSplit, QueueSpec),
     }
     assert set(want) == set(_BACKENDS)
     for name, (ty, mk_spec) in want.items():
@@ -76,6 +79,9 @@ def test_every_backend_choice_constructs(healthy_probe):
     assert isinstance(b.inner, CppOracle)
     b = _make_backend("pcomp-tpu", KvSpec())
     assert isinstance(b.inner, JaxTPU)
+    b = _make_backend("rootsplit-tpu", CasSpec())
+    assert isinstance(b.inner, JaxTPU)
+    assert not b.eager  # the shipped default is hard-tail escalation
 
 
 def test_unknown_backend_refused():

@@ -1,0 +1,121 @@
+"""Root splitting (ops/rootsplit.py): the first-op decomposition must
+partition the search exactly — verdict parity with the oracle through
+both host and device inners — and the frontier bookkeeping (dedupe,
+all-roots-die, pending routing) must hold."""
+
+import numpy as np
+
+from qsm_tpu import (Verdict, WingGongCPU, generate_program, run_concurrent,
+                     sequential_history)
+from qsm_tpu.core.history import History, Op
+from qsm_tpu.models.cas import AtomicCasSUT, CasSpec, RacyCasSUT
+from qsm_tpu.models.register import READ, WRITE, RegisterSpec
+from qsm_tpu.ops.jax_kernel import JaxTPU
+from qsm_tpu.ops.rootsplit import RootSplit, split_history
+
+SPEC = CasSpec(n_values=5)
+
+
+def _corpus(n=40, n_pids=8, max_ops=24):
+    hists = []
+    for seed in range(n // 2):
+        prog = generate_program(SPEC, seed=seed, n_pids=n_pids,
+                                max_ops=max_ops)
+        for sut in (AtomicCasSUT(SPEC), RacyCasSUT(SPEC)):
+            hists.append(run_concurrent(sut, prog, seed=f"rs{seed}"))
+    return hists
+
+
+def test_split_children_are_first_choice_partition():
+    rspec = RegisterSpec(n_values=5)
+    # two overlapping ops: both minimal, both ok as the FIRST choice
+    # (read -> 0 sees the initial value; write -> 0 is uncondition-ok), so
+    # two children of one op each
+    h = History([Op(0, WRITE, 3, 0, 0, 5), Op(1, READ, 0, 0, 1, 2)])
+    kids = split_history(rspec, h, depth=1)
+    assert kids is not None and len(kids) == 2
+    assert all(len(k.ops) == 1 for k, _ in kids)
+    states = sorted(s for _, s in kids)
+    assert states == [(0,), (3,)]  # read-first keeps 0, write-first sets 3
+
+    # sequential history: only ONE minimal op at the root
+    h2 = sequential_history([(0, WRITE, 2, 0), (0, READ, 0, 2)])
+    kids2 = split_history(rspec, h2, depth=1)
+    assert kids2 is not None and len(kids2) == 1
+
+
+def test_split_all_roots_die_is_violation():
+    rspec = RegisterSpec(n_values=5)
+    # single op whose postcondition fails from the initial state: read -> 4
+    h = sequential_history([(0, READ, 0, 4)])
+    assert split_history(rspec, h, depth=1) == []
+    rs = RootSplit(rspec, WingGongCPU(memo=True), min_ops=0, eager=True)
+    assert rs.check_histories(rspec, [h])[0] == int(Verdict.VIOLATION)
+    assert rs.split_histories == 1
+
+
+def test_split_depth2_dedupes_permutations():
+    rspec = RegisterSpec(n_values=5)
+    # two overlapping READS of the initial value: both orders reach the
+    # same (empty-rest, state) configuration -> deduped to fewer children
+    h = History([Op(0, READ, 0, 0, 0, 5), Op(1, READ, 0, 0, 1, 4)])
+    kids = split_history(rspec, h, depth=2)
+    assert kids is not None and len(kids) == 1  # not 2
+
+
+def test_pending_histories_route_whole():
+    rspec = RegisterSpec(n_values=5)
+    h = History([Op(0, WRITE, 1, -1, 0, 1 << 30),
+                 Op(1, READ, 0, 1, 2, 3)])
+    assert split_history(rspec, h, depth=1) is None
+    rs = RootSplit(rspec, WingGongCPU(memo=True), min_ops=0, eager=True)
+    want = WingGongCPU().check_histories(rspec, [h])
+    np.testing.assert_array_equal(rs.check_histories(rspec, [h]), want)
+
+
+def test_rootsplit_parity_host_inner_eager():
+    hists = _corpus()
+    want = WingGongCPU(memo=True).check_histories(SPEC, hists)
+    for depth in (1, 2):
+        rs = RootSplit(SPEC, WingGongCPU(memo=True), depth=depth,
+                       min_ops=0, eager=True)
+        got = rs.check_histories(SPEC, hists)
+        np.testing.assert_array_equal(got, want, err_msg=f"depth={depth}")
+        assert rs.split_histories > 0 and rs.children_checked > 0
+    assert (want == int(Verdict.VIOLATION)).any()
+    assert (want == int(Verdict.LINEARIZABLE)).any()
+
+
+def test_rootsplit_parity_device_inner_eager():
+    hists = _corpus(n=20, max_ops=20)
+    want = WingGongCPU(memo=True).check_histories(SPEC, hists)
+    rs = RootSplit(SPEC, JaxTPU(SPEC), depth=1, min_ops=0, eager=True)
+    got = rs.check_histories(SPEC, hists)
+    # the device inner may defer (BUDGET_EXCEEDED) — decided must agree
+    undecided = got == int(Verdict.BUDGET_EXCEEDED)
+    np.testing.assert_array_equal(got[~undecided], want[~undecided])
+    assert (~undecided).sum() >= 0.9 * len(hists)
+    assert rs.split_histories > 0
+
+
+def test_rootsplit_escalation_rescues_budget_lanes():
+    """Escalation (the default): a budget-starved device inner defers
+    some histories; splitting multiplies the effective per-lane budget by
+    the fanout, so the combinator decides strictly more of them — and
+    every decided verdict still matches the oracle."""
+    hists = _corpus(n=40, max_ops=24)
+    want = WingGongCPU(memo=True).check_histories(SPEC, hists)
+
+    def starved():
+        return JaxTPU(SPEC, budget=150, mid_budget=0, rescue_budget=0)
+
+    plain = starved().check_histories(SPEC, hists)
+    n_undecided_plain = int((plain == int(Verdict.BUDGET_EXCEEDED)).sum())
+    assert n_undecided_plain > 0, "corpus too easy to exercise escalation"
+
+    rs = RootSplit(SPEC, starved(), depth=1)
+    got = rs.check_histories(SPEC, hists)
+    undecided = got == int(Verdict.BUDGET_EXCEEDED)
+    np.testing.assert_array_equal(got[~undecided], want[~undecided])
+    assert int(undecided.sum()) < n_undecided_plain
+    assert rs.split_histories > 0
