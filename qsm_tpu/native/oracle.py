@@ -5,11 +5,13 @@ Routing per history:
 * scalar-state specs with a declared state bound → the C++ DFS driven by
   the dense domain step table (wg.cpp kind 0);
 * vector-state specs that declare a built-in C++ kernel
-  (``Spec.native_kernel``: queue, kv) → the same DFS with the native step
-  function (kinds 1-2), total in the response like ``step_py``;
-* everything else — unknown specs, out-of-domain args, histories over 64
-  ops, missing toolchain — falls back to the Python oracle, so verdicts
-  are always available and always exact.
+  (``Spec.native_kernel``: queue, kv, stack) → the same DFS with the
+  native step function (kinds 1-3), total in the response like
+  ``step_py``;
+* everything else — unknown specs, out-of-domain args, histories over
+  128 ops (the encoder's largest bucket; the C++ search picks a 64- or
+  128-bit taken mask per history), missing toolchain — falls back to the
+  Python oracle, so verdicts are always available and always exact.
 
 For the TABLE path, out-of-domain RESPONSES also route to the fallback:
 the table only covers declared domains, and staying exact for arbitrary
@@ -30,11 +32,30 @@ from ..core.spec import Spec, compile_step_table
 from ..ops.backend import Verdict
 from ..ops.wing_gong_cpu import WingGongCPU
 
-# public: the native checker's coverage cap (one uint64 taken mask) —
-# consumers (bench.py's sweep caps) must derive from this, not hardcode
-NATIVE_MAX_OPS = 64
+# public: the native checker's coverage cap (an unsigned __int128 taken
+# mask — the encoder's largest long-context bucket) — consumers
+# (bench.py's sweep caps) must derive from this, not hardcode
+NATIVE_MAX_OPS = 128
 _MAX_OPS = NATIVE_MAX_OPS
 _MAX_STATE = 64  # wg.cpp MAX_STATE
+
+
+def _blockers2(prec: np.ndarray) -> np.ndarray:
+    """Per-op precedence blockers as [n][2] little-endian uint64 words
+    (the wg.cpp mask wire format): word w of blockers2[j] has bit b set
+    iff op ``64*w + b`` strictly precedes op j.  Fully vectorized — the
+    per-edge Python loop was 80% of the whole native check wall-clock."""
+    n = prec.shape[0]
+    out = np.zeros((n, 2), np.uint64)
+    idx = np.arange(n)
+    word = idx >> 6                              # word of predecessor i
+    bit = np.uint64(1) << np.uint64(idx & 63)
+    for w in range(2):
+        rows = word == w
+        if rows.any():
+            contrib = np.where(prec[rows], bit[rows, None], np.uint64(0))
+            out[:, w] = np.bitwise_or.reduce(contrib, axis=0)
+    return out
 
 
 class CppOracle:
@@ -190,11 +211,7 @@ class CppOracle:
         cmd = np.asarray([o.cmd for o in seg.ops], np.int32)
         arg = np.asarray([o.arg for o in seg.ops], np.int32)
         resp = np.asarray([o.resp for o in seg.ops], np.int32)
-        prec = seg.precedes_matrix().astype(bool)
-        bit = np.uint64(1) << np.arange(n, dtype=np.uint64)
-        blockers = np.asarray(
-            [np.bitwise_or.reduce(bit[prec[:, j]]) if prec[:, j].any()
-             else np.uint64(0) for j in range(n)], np.uint64)
+        blockers = _blockers2(seg.precedes_matrix().astype(bool))
         inits = np.asarray(starts, np.int32).reshape(len(starts), dim)
         out = np.empty((max_out, dim), np.int32)
         if node_budget is None:
@@ -257,7 +274,7 @@ class CppOracle:
         arg = np.empty(total, np.int32)
         resp = np.empty(total, np.int32)
         pending = np.empty(total, np.uint8)
-        blockers = np.empty(total, np.uint64)
+        blockers = np.empty((total, 2), np.uint64)
         inits = np.empty((len(idx), dim), np.int32)
         default_init = np.asarray(spec.initial_state(), np.int32)
         pos = 0
@@ -265,15 +282,13 @@ class CppOracle:
             h = histories[i]
             n = len(h)
             offsets[k + 1] = pos + n
-            bit = np.uint64(1) << np.arange(n, dtype=np.uint64)
-            prec = h.precedes_matrix().astype(bool)
+            blockers[pos:pos + n] = _blockers2(
+                h.precedes_matrix().astype(bool))
             for j, o in enumerate(h.ops):
                 cmd[pos + j] = o.cmd
                 arg[pos + j] = o.arg
                 resp[pos + j] = 0 if o.is_pending else o.resp
                 pending[pos + j] = 1 if o.is_pending else 0
-                blockers[pos + j] = np.bitwise_or.reduce(
-                    bit[prec[:, j]]) if prec[:, j].any() else np.uint64(0)
             inits[k] = (default_init if init_states is None
                         or init_states[i] is None
                         else np.asarray(init_states[i], np.int32))

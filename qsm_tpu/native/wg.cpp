@@ -24,8 +24,11 @@
 // like step_py), so only ARG domains need host-side routing; parity with
 // the Python oracle is pinned by tests/test_native.py.
 //
-// Histories are capped at 64 ops (the encoder's bucket cap), so the taken
-// set is one uint64 and precedence is a per-op blocker bitmask.
+// Histories are capped at 128 ops (the encoder's largest long-context
+// bucket): the taken set is an unsigned __int128 (GCC builtin; this
+// library is built with g++ per native/__init__.py) and precedence is a
+// per-op blocker bitmask of the same width, passed from Python as
+// little-endian (lo, hi) uint64 pairs.
 
 #include <cstddef>
 #include <cstdint>
@@ -33,10 +36,13 @@
 #include <string>
 #include <unordered_set>
 #include <utility>
+#include <vector>
 
 namespace {
 
 constexpr int MAX_STATE = 64;  // state vector length cap (router enforces)
+
+using Mask = unsigned __int128;  // widest taken/blocker bitmask, ops <= 128
 
 struct SpecDesc {
     int kind;        // 0 table, 1 queue, 2 kv, 3 stack
@@ -139,45 +145,61 @@ static inline bool do_step(const SpecDesc& sp, const int32_t* s,
 // Exact memo keys.  Scalar states use a (taken, state) pair set — the hot
 // path; vector states serialize taken + the raw state bytes into a string
 // set.  Both are exact (full-key storage), collisions impossible.
-using Key = std::pair<uint64_t, uint64_t>;
+//
+// The search is TEMPLATED on the mask type M: uint64_t for histories of
+// <= 64 ops (the common case — one-word bit ops, 16-byte keys), Mask
+// (unsigned __int128) for the 96/128-op long-context buckets.  Measured:
+// running everything at 128 bits costs ~2x on the <= 64-op bench corpus
+// (bigger hash keys dominate), so the width is chosen per history.
 
-struct KeyHash {
-    size_t operator()(const Key& k) const {
-        auto mix = [](uint64_t x) {
-            x += 0x9E3779B97F4A7C15ull;
-            x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
-            x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
-            return x ^ (x >> 31);
-        };
-        return mix(k.first) ^ (mix(k.second) * 0x9E3779B9ull);
+static inline uint64_t mix64(uint64_t x) {
+    x += 0x9E3779B97F4A7C15ull;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+    return x ^ (x >> 31);
+}
+
+template <typename M>
+struct KeyHashT {
+    size_t operator()(const std::pair<M, uint64_t>& k) const {
+        uint64_t h = mix64(static_cast<uint64_t>(k.first));
+        if (sizeof(M) > 8)
+            h ^= mix64(static_cast<uint64_t>(
+                     static_cast<Mask>(k.first) >> 64))
+                 * 0xC2B2AE3D27D4EB4Full;
+        return h ^ (mix64(k.second) * 0x9E3779B9ull);
     }
 };
 
-struct Ctx {
+template <typename M>
+struct CtxT {
+    using Key = std::pair<M, uint64_t>;
     int n;
     const int32_t* cmd;
     const int32_t* arg;
     const int32_t* resp;
     const uint8_t* pending;
-    const uint64_t* blockers;
+    const M* blockers;
     SpecDesc sp;
     const int32_t* n_resps;  // per command
     int n_required;
     long long budget;
     long long nodes;
     bool use_memo;
-    std::unordered_set<Key, KeyHash>* seen;          // state_dim == 1
+    std::unordered_set<Key, KeyHashT<M>>* seen;      // state_dim == 1
     std::unordered_set<std::string>* seen_vec;       // state_dim  > 1
 };
 
-static inline Key key_of(uint64_t taken, int state) {
+template <typename M>
+static inline std::pair<M, uint64_t> key_of(M taken, int state) {
     return {taken, static_cast<uint64_t>(static_cast<uint32_t>(state))};
 }
 
 // Packs a small-domain state vector into the pair key's second word; the
 // caller guarantees every element fits elem_bits (spec domain bound).
-static inline Key key_packed(uint64_t taken, const int32_t* state, int dim,
-                             int elem_bits) {
+template <typename M>
+static inline std::pair<M, uint64_t> key_packed(
+    M taken, const int32_t* state, int dim, int elem_bits) {
     uint64_t packed = 0;
     for (int i = 0; i < dim; ++i)
         packed |= static_cast<uint64_t>(static_cast<uint32_t>(state[i]))
@@ -185,7 +207,8 @@ static inline Key key_packed(uint64_t taken, const int32_t* state, int dim,
     return {taken, packed};
 }
 
-static std::string vec_key(uint64_t taken, const int32_t* state, int dim) {
+template <typename M>
+static std::string vec_key(M taken, const int32_t* state, int dim) {
     std::string k(sizeof(taken) + sizeof(int32_t) * dim, '\0');
     std::memcpy(&k[0], &taken, sizeof(taken));
     std::memcpy(&k[sizeof(taken)], state, sizeof(int32_t) * dim);
@@ -193,7 +216,8 @@ static std::string vec_key(uint64_t taken, const int32_t* state, int dim) {
 }
 
 // returns Verdict {0, 1, 2}
-static int dfs(Ctx& c, uint64_t taken, const int32_t* state,
+template <typename M>
+static int dfs(CtxT<M>& c, M taken, const int32_t* state,
                int got_required) {
     if (got_required == c.n_required) return 1;
     if (c.budget <= 0) return 2;
@@ -204,7 +228,7 @@ static int dfs(Ctx& c, uint64_t taken, const int32_t* state,
     const bool packed = !scalar
         && c.sp.elem_bits > 0
         && c.sp.state_dim * c.sp.elem_bits <= 64;
-    Key key{};
+    std::pair<M, uint64_t> key{};
     std::string vkey;
     if (c.use_memo) {
         if (scalar) {
@@ -232,7 +256,7 @@ static int dfs(Ctx& c, uint64_t taken, const int32_t* state,
             ++c.nodes;
             if (c.budget <= 0) return 2;
             if (!do_step(c.sp, state, child, cm, a, r)) continue;
-            const int sub = dfs(c, taken | (1ull << j), child,
+            const int sub = dfs(c, static_cast<M>(taken | (static_cast<M>(1) << j)), child,
                                 got_required + (pend ? 0 : 1));
             if (sub == 1) return 1;
             if (sub == 2) saw_budget = true;
@@ -246,6 +270,42 @@ static int dfs(Ctx& c, uint64_t taken, const int32_t* state,
     return 0;
 }
 
+// blockers2 (lo, hi) uint64 pairs -> per-op masks at width M: the ONE
+// wire-format decode, shared by both entry paths.
+template <typename M>
+static std::vector<M> widen_blockers(int n, const uint64_t* blockers2) {
+    std::vector<M> out(n);
+    for (int j = 0; j < n; ++j) {
+        M b = static_cast<M>(blockers2[2 * j]);
+        if (sizeof(M) > 8)
+            b |= static_cast<M>(
+                static_cast<Mask>(blockers2[2 * j + 1]) << 64);
+        out[j] = b;
+    }
+    return out;
+}
+
+// one complete history decided at mask width M
+template <typename M>
+static int check_one(int n, const int32_t* cmd, const int32_t* arg,
+                     const int32_t* resp, const uint8_t* pending,
+                     const uint64_t* blockers2, const SpecDesc& sp,
+                     const int32_t* n_resps, const int32_t* init,
+                     long long node_budget, bool use_memo,
+                     long long* nodes_out) {
+    int n_required = 0;
+    for (int j = 0; j < n; ++j)
+        if (!pending[j]) ++n_required;
+    const std::vector<M> blockers = widen_blockers<M>(n, blockers2);
+    std::unordered_set<std::pair<M, uint64_t>, KeyHashT<M>> seen;
+    std::unordered_set<std::string> seen_vec;
+    CtxT<M> c{n, cmd, arg, resp, pending, blockers.data(), sp, n_resps,
+              n_required, node_budget, 0, use_memo, &seen, &seen_vec};
+    const int v = dfs(c, static_cast<M>(0), init, 0);
+    *nodes_out = c.nodes;
+    return v;
+}
+
 // --- end-state enumeration (decrease-and-conquer middle segments) -------
 // Explores EVERY valid complete linearization of a (pending-free) segment
 // from one start state, collecting the set of distinct reachable end
@@ -253,27 +313,32 @@ static int dfs(Ctx& c, uint64_t taken, const int32_t* state,
 // budget accounting (one unit per step evaluation) and same visited-set
 // pruning semantics.
 
-struct EndCtx {
+template <typename M>
+struct EndCtxT {
+    using Key = std::pair<M, uint64_t>;
     int n;
     const int32_t* cmd;
     const int32_t* arg;
     const int32_t* resp;
-    const uint64_t* blockers;
+    const M* blockers;
     SpecDesc sp;
     long long budget;
     long long nodes;
     bool overflow;   // hit max_out (distinct from budget exhaustion)
     bool oob;        // kind-0 state escaped the table (caller must defer)
-    std::unordered_set<Key, KeyHash>* visited;      // packed/scalar states
+    std::unordered_set<Key, KeyHashT<M>>* visited;  // packed/scalar states
     std::unordered_set<std::string>* visited_vec;   // string-key states
     std::unordered_set<std::string>* ends;          // distinct end states
     int32_t* out;       // [max_out][state_dim]
     int max_out;
 };
 
-static bool end_dfs(EndCtx& c, uint64_t taken, const int32_t* state) {
+template <typename M>
+static bool end_dfs(EndCtxT<M>& c, M taken, const int32_t* state) {
     const int dim = c.sp.state_dim;
-    const uint64_t full = (c.n == 64) ? ~0ull : ((1ull << c.n) - 1);
+    const M full = (c.n == static_cast<int>(sizeof(M) * 8))
+                       ? ~static_cast<M>(0)
+                       : ((static_cast<M>(1) << c.n) - 1);
     if (taken == full) {
         std::string k(reinterpret_cast<const char*>(state),
                       sizeof(int32_t) * dim);
@@ -295,8 +360,8 @@ static bool end_dfs(EndCtx& c, uint64_t taken, const int32_t* state) {
     const bool packed = !scalar && c.sp.elem_bits > 0
                         && dim * c.sp.elem_bits <= 64;
     if (scalar || packed) {
-        Key key = scalar ? key_of(taken, state[0])
-                         : key_packed(taken, state, dim, c.sp.elem_bits);
+        auto key = scalar ? key_of(taken, state[0])
+                          : key_packed(taken, state, dim, c.sp.elem_bits);
         if (!c.visited->insert(key).second) return true;
     } else {
         if (!c.visited_vec->insert(vec_key(taken, state, dim)).second)
@@ -311,9 +376,42 @@ static bool end_dfs(EndCtx& c, uint64_t taken, const int32_t* state) {
         if (c.budget <= 0) return false;
         if (!do_step(c.sp, state, child, c.cmd[j], c.arg[j], c.resp[j]))
             continue;
-        if (!end_dfs(c, taken | (1ull << j), child)) return false;
+        if (!end_dfs(c, static_cast<M>(taken | (static_cast<M>(1) << j)),
+                     child))
+            return false;
     }
     return true;
+}
+
+// all starts of one segment enumerated at mask width M; returns rc
+// (0 ok, -1 budget, -2 overflow, -3 oob/invalid-start) and charges
+// *nodes_used
+template <typename M>
+static long long end_states_run(
+    int n, const int32_t* cmd, const int32_t* arg, const int32_t* resp,
+    const uint64_t* blockers2, const SpecDesc& sp,
+    const int32_t* init_states, int n_inits, long long node_budget,
+    int32_t* out_states, int max_out,
+    std::unordered_set<std::string>* ends, long long* nodes_used) {
+    const std::vector<M> blockers = widen_blockers<M>(n, blockers2);
+    EndCtxT<M> c{n, cmd, arg, resp, blockers.data(), sp, node_budget, 0,
+                 false, false, nullptr, nullptr, ends, out_states, max_out};
+    long long rc = 0;
+    for (int i = 0; i < n_inits && rc == 0; ++i) {
+        // fresh visited set per start, exactly like the Python version
+        std::unordered_set<std::pair<M, uint64_t>, KeyHashT<M>> visited;
+        std::unordered_set<std::string> visited_vec;
+        c.visited = &visited;
+        c.visited_vec = &visited_vec;
+        const int32_t* init = init_states + i * sp.state_dim;
+        if (start_state_invalid(sp, init)) {
+            rc = -3;  // caller falls back to the exact Python walk
+        } else if (!end_dfs(c, static_cast<M>(0), init)) {
+            rc = c.oob ? -3 : (c.overflow ? -2 : -1);
+        }
+    }
+    *nodes_used = c.nodes;
+    return rc;
 }
 
 }  // namespace
@@ -326,9 +424,10 @@ extern "C" {
 // -3 (a scalar state escaped the domain table — caller must defer).
 // *nodes_used always reports step evaluations consumed, so the caller can
 // charge its shared budget before falling back to the exact Python walk.
+// ``blockers2`` is [n][2] uint64 little-endian (lo, hi) words per op.
 long long wg_end_states(
     int n, const int32_t* cmd, const int32_t* arg, const int32_t* resp,
-    const uint64_t* blockers,
+    const uint64_t* blockers2,
     int kind, int state_dim, int32_t p0, int32_t p1, int elem_bits,
     const int32_t* trans, const uint8_t* ok,
     int S, int C, int A, int R,
@@ -337,23 +436,15 @@ long long wg_end_states(
     long long* nodes_used) {
     SpecDesc sp{kind, state_dim, p0, p1, trans, ok, S, C, A, R, elem_bits};
     std::unordered_set<std::string> ends;
-    EndCtx c{n, cmd, arg, resp, blockers, sp, node_budget, 0, false, false,
-             nullptr, nullptr, &ends, out_states, max_out};
-    long long rc = 0;
-    for (int i = 0; i < n_inits && rc == 0; ++i) {
-        // fresh visited set per start, exactly like the Python version
-        std::unordered_set<Key, KeyHash> visited;
-        std::unordered_set<std::string> visited_vec;
-        c.visited = &visited;
-        c.visited_vec = &visited_vec;
-        const int32_t* init = init_states + i * state_dim;
-        if (start_state_invalid(sp, init)) {
-            rc = -3;  // caller falls back to the exact Python walk
-        } else if (!end_dfs(c, 0ull, init)) {
-            rc = c.oob ? -3 : (c.overflow ? -2 : -1);
-        }
-    }
-    *nodes_used = c.nodes;
+    const long long rc =
+        (n <= 64)
+            ? end_states_run<uint64_t>(n, cmd, arg, resp, blockers2, sp,
+                                       init_states, n_inits, node_budget,
+                                       out_states, max_out, &ends,
+                                       nodes_used)
+            : end_states_run<Mask>(n, cmd, arg, resp, blockers2, sp,
+                                   init_states, n_inits, node_budget,
+                                   out_states, max_out, &ends, nodes_used);
     return rc == 0 ? static_cast<long long>(ends.size()) : rc;
 }
 
@@ -362,11 +453,12 @@ long long wg_end_states(
 // is [n_hist][state_dim] (per-lane start states — the segmentation
 // combinator's route).  kind/p0/p1 select the spec semantics; trans/ok
 // carry the scalar domain table for kind 0 (pass null otherwise).
+// ``blockers2`` is [total][2] uint64 little-endian (lo, hi) words per op.
 // Returns total nodes explored; verdicts land in out_verdicts.
 long long wg_check_batch(
     int n_hist, const int64_t* offsets,
     const int32_t* cmd, const int32_t* arg, const int32_t* resp,
-    const uint8_t* pending, const uint64_t* blockers,
+    const uint8_t* pending, const uint64_t* blockers2,
     int kind, int state_dim, int32_t p0, int32_t p1, int elem_bits,
     const int32_t* trans, const uint8_t* ok,
     int S, int C, int A, int R, const int32_t* n_resps,
@@ -377,22 +469,24 @@ long long wg_check_batch(
     for (int i = 0; i < n_hist; ++i) {
         const int64_t lo = offsets[i];
         const int n = static_cast<int>(offsets[i + 1] - lo);
-        int n_required = 0;
-        for (int j = 0; j < n; ++j)
-            if (!pending[lo + j]) ++n_required;
-        std::unordered_set<Key, KeyHash> seen;
-        std::unordered_set<std::string> seen_vec;
-        Ctx c{n, cmd + lo, arg + lo, resp + lo, pending + lo,
-              blockers + lo, sp, n_resps, n_required, node_budget, 0,
-              use_memo != 0, &seen, &seen_vec};
         const int32_t* init = init_states + i * state_dim;
-        if (n == 0)
+        long long nodes = 0;
+        if (n == 0) {
             out_verdicts[i] = 1;
-        else if (start_state_invalid(sp, init))
+        } else if (start_state_invalid(sp, init)) {
             out_verdicts[i] = 2;  // defer: the Python oracle is exact here
-        else
-            out_verdicts[i] = dfs(c, 0ull, init, 0);
-        total += c.nodes;
+        } else if (n <= 64) {     // per-history mask width (see above)
+            out_verdicts[i] = check_one<uint64_t>(
+                n, cmd + lo, arg + lo, resp + lo, pending + lo,
+                blockers2 + 2 * lo, sp, n_resps, init, node_budget,
+                use_memo != 0, &nodes);
+        } else {
+            out_verdicts[i] = check_one<Mask>(
+                n, cmd + lo, arg + lo, resp + lo, pending + lo,
+                blockers2 + 2 * lo, sp, n_resps, init, node_budget,
+                use_memo != 0, &nodes);
+        }
+        total += nodes;
     }
     return total;
 }

@@ -1,8 +1,8 @@
 """Beyond the reference's scale: 96- and 128-op histories (the largest
 BASELINE config is 64×16).  The device kernel and host oracles handle the
-new buckets directly; the native C++ checker's 64-op taken mask routes
-longer histories to its exact Python fallback; segmentation keeps the
-long-history cost decomposed (SURVEY.md §5 long-context row)."""
+new buckets directly; the native C++ checker's 128-bit taken mask covers
+the full bucket range natively; segmentation keeps the long-history cost
+decomposed (SURVEY.md §5 long-context row)."""
 
 import numpy as np
 
@@ -34,6 +34,22 @@ def test_cas_96ops_device_parity():
     assert (want == int(Verdict.VIOLATION)).any()
 
 
+def test_cas_128ops_native_parity():
+    """The top bucket: 128-op histories fill the whole __int128 mask."""
+    from qsm_tpu.native import CppOracle
+
+    spec = CasSpec()
+    corpus = build_corpus(spec, (AtomicCasSUT, RacyCasSUT), n=16,
+                          n_pids=8, max_ops=128, seed_base=2000,
+                          seed_prefix="long128")
+    assert any(len(h) > 96 for h in corpus)
+    want = WingGongCPU(memo=True).check_histories(spec, corpus)
+    cpp = CppOracle(spec)
+    np.testing.assert_array_equal(cpp.check_histories(spec, corpus), want)
+    assert cpp.native_histories == len(corpus)
+    assert (want == int(Verdict.VIOLATION)).any()
+
+
 def test_queue_96ops_segdc_and_native_fallback_parity():
     from qsm_tpu.native import CppOracle
     from qsm_tpu.ops.segdc import SegDC
@@ -51,5 +67,6 @@ def test_queue_96ops_segdc_and_native_fallback_parity():
 
     cpp = CppOracle(spec)
     np.testing.assert_array_equal(cpp.check_histories(spec, corpus), want)
-    # >64-op histories must have routed to the exact fallback
-    assert cpp.fallback_histories > 0
+    # the 128-bit taken mask decides >64-op histories NATIVELY now
+    assert cpp.fallback_histories == 0
+    assert cpp.native_histories == len(corpus)
