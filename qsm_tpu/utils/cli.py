@@ -331,6 +331,22 @@ def cmd_coverage(args) -> int:
     return 0
 
 
+def cmd_list(args) -> int:
+    """Discoverability: every registry model (with sizes + impls) and
+    every backend choice, as one JSON object."""
+    from ..native import native_available
+
+    print(json.dumps({
+        "models": {
+            name: {"pids": e.default_pids, "ops": e.default_ops,
+                   "impls": sorted(e.impls)}
+            for name, e in sorted(MODELS.items())},
+        "backends": list(_BACKENDS),
+        "native_available": native_available(),
+    }))
+    return 0
+
+
 def cmd_fuzz(args) -> int:
     from .fuzz import fuzz_parity
 
@@ -376,6 +392,9 @@ def main(argv=None) -> int:
     p.add_argument("--ops", type=int, default=None)
     p.add_argument("--corpus", type=int, default=256)
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser("list", help="models, impls, and backend choices")
+    p.set_defaults(fn=cmd_list)
 
     p = sub.add_parser(
         "fuzz", help="differential backend fuzzing over random specs")

@@ -34,6 +34,15 @@ def test_registry_covers_all_five_configs():
         assert "atomic" in entry.impls
 
 
+def test_cli_list(capsys):
+    assert cli_main(["list"]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert set(out["models"]) == set(MODELS)
+    assert out["models"]["cas"]["impls"] == ["atomic", "racy"]
+    assert "rootsplit-tpu" in out["backends"]
+    assert out["native_available"] is True  # toolchain is baked in
+
+
 def test_format_counterexample_mentions_every_op():
     res = _failing_result()
     text = format_counterexample(SPEC, res.counterexample)

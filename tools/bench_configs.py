@@ -1,9 +1,10 @@
-"""Per-config benchmark artifact — one JSON line per BASELINE config
-(VERDICT.md round 2, "Next round" #7; BASELINE.json:6-12).
+"""Per-config benchmark artifact — one JSON line per model config
+(VERDICT.md round 2, "Next round" #7; BASELINE.json:6-12, plus the extra
+set/stack families).
 
-For each of the five model configs at full default size, measures
-histories/sec for the memoised host oracle and for the config's natural
-device path (JaxTPU for scalar-state specs; SegDC(JaxTPU) for queue-48;
+For each model config at full default size, measures histories/sec for
+the memoised host oracle and for the config's natural device path
+(JaxTPU for scalar-state specs; SegDC(JaxTPU) for queue-48;
 PComp(JaxTPU) for multi-key KV-64), with verdict-parity accounting.
 
 Probe-guarded exactly like bench.py: real chip when the tunnel answers,
@@ -54,6 +55,8 @@ def _backends_for(model: str, spec, on_tpu: bool):
     if model == "queue":
         out["device"] = SegDC(spec,
                               make_inner=lambda s: JaxTPU(s, **vec_kw))
+    elif model == "stack":
+        out["device"] = JaxTPU(spec, **vec_kw)  # vector state, no table
     else:
         out["device"] = JaxTPU(spec)
     if native_available():
@@ -120,7 +123,8 @@ def main(argv=None) -> int:
                                                  args.probe_timeout)
     n_corpus = args.corpus or (256 if on_tpu else 128)
     lines = [{"artifact": "bench_configs", **header}]
-    for model in ("register", "ticket", "cas", "queue", "kv"):
+    for model in ("register", "ticket", "cas", "queue", "kv",
+                  "set", "stack"):
         rec = bench_config(model, on_tpu, n_corpus)
         lines.append(rec)
         print(json.dumps(rec), flush=True)
