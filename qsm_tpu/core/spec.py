@@ -135,6 +135,21 @@ class Spec:
         """
         return None
 
+    def state_elem_bounds(self) -> Optional[Sequence[int]]:
+        """Per-element EXCLUSIVE upper bounds on the state vector, or None.
+
+        The contract: from any state whose elements are within bounds,
+        any ok step whose ARG is in the declared command domains (resps
+        arbitrary) yields a state whose elements are within bounds, and
+        the initial state is within bounds.  Declaring this lets the
+        device backend pack small vector states into one scalar
+        (ops/scalarize.py) and ride the step-table gather fast path the
+        scalar specs use; the packing is a bijection, so verdicts are
+        unchanged (iteration counts agree up to memo hash-collision
+        luck — the cache key width changes).
+        """
+        return None
+
     def native_kernel(self) -> Optional[Tuple[int, int, int]]:
         """(kind, p0, p1) selecting a built-in C++ step kernel in
         qsm_tpu/native/wg.cpp, or None.  Scalar-table specs need none (the

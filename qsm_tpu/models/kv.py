@@ -50,6 +50,9 @@ class KvSpec(Spec):
     def native_kernel(self):
         return (2, self.n_keys, self.n_values)  # wg.cpp kind 2
 
+    def state_elem_bounds(self):
+        return [self.n_values] * self.n_keys  # one value per key
+
     def step_py(self, state, cmd, arg, resp):
         state = list(state)
         if cmd == GET:

@@ -55,6 +55,11 @@ class QueueSpec(Spec):
     def native_kernel(self):
         return (1, self.capacity, self.n_values)  # wg.cpp kind 1
 
+    def state_elem_bounds(self):
+        # length in [0, cap]; slots in [0, n_values) with vacated slots
+        # zeroed (canonical form keeps every element in its domain)
+        return [self.capacity + 1] + [self.n_values] * self.capacity
+
     def step_py(self, state, cmd, arg, resp):
         length = state[0]
         slots = list(state[1:])

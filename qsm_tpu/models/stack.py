@@ -60,6 +60,10 @@ class StackSpec(Spec):
     def native_kernel(self):
         return (3, self.capacity, self.n_values)  # wg.cpp kind 3
 
+    def state_elem_bounds(self):
+        # length in [0, cap]; slots in [0, n_values), vacated top zeroed
+        return [self.capacity + 1] + [self.n_values] * self.capacity
+
     def step_py(self, state, cmd, arg, resp):
         length = state[0]
         slots = list(state[1:])

@@ -473,13 +473,22 @@ class JaxTPU:
         self.total_budget = budget + mid_budget + rescue_budget
         self._steppers: Dict[Tuple[int, int], tuple] = {}
         self._compiled: Dict[Tuple, object] = {}
+        # Vector specs with declared element bounds get a SCALARIZED
+        # shadow (ops/scalarize.py): the kernel then runs the step-table
+        # gather fast path with one-word memo keys instead of a vmapped
+        # step sweep per iteration.  Bijective packing — verdicts and
+        # iteration counts are identical either way (tests pin this).
+        from .scalarize import scalar_shadow
+
+        self._shadow = scalar_shadow(spec)
+        self.kspec = self._shadow if self._shadow is not None else spec
         # Step-table specs guarantee their state bound only for histories
         # whose ARGS are in the declared command domains (resps may be
         # arbitrary — SUTs can return anything; args come from the
         # generator).  Out-of-domain histories are deferred to the oracle
         # (BUDGET_EXCEEDED) instead of risking a table/oracle divergence.
-        self._uses_table = (spec.STATE_DIM == 1
-                            and spec.scalar_state_bound(1) is not None)
+        self._uses_table = (self.kspec.STATE_DIM == 1
+                            and self.kspec.scalar_state_bound(1) is not None)
         self.deferred_out_of_domain = 0
         self.batches_run = 0
         self.device_histories = 0
@@ -505,7 +514,7 @@ class JaxTPU:
         key = (n_ops, slots)
         fns = self._steppers.get(key)
         if fns is None:
-            fns = build_stepper(self.spec, n_ops, self.total_budget,
+            fns = build_stepper(self.kspec, n_ops, self.total_budget,
                                 cache_slots=slots,
                                 cache_write=self.cache_write)
             self._steppers[key] = fns
@@ -605,16 +614,21 @@ class JaxTPU:
         groups: List[Tuple[int, int]] = []  # (start, count) per input
         flat: List[History] = []
         flat_inits: List = []
-        overflow: List[int] = []
         for idx, h in enumerate(histories):
             if self._uses_table and not self._args_in_domain(h):
                 self.deferred_out_of_domain += 1
-                overflow.append(idx)
+                groups.append((len(flat), 0))
+                continue
+            if (self._shadow is not None and init_states is not None
+                    and not self._shadow.in_bounds(init_states[idx])):
+                # a start state outside the declared element bounds would
+                # pack onto a DIFFERENT valid state (wrong verdict, not a
+                # crash) — defer it to the oracle instead
+                self.deferred_out_of_domain += 1
                 groups.append((len(flat), 0))
                 continue
             exp = self._expand(h)
             if exp is None:
-                overflow.append(idx)
                 groups.append((len(flat), 0))
             else:
                 groups.append((len(flat), len(exp)))
@@ -657,7 +671,7 @@ class JaxTPU:
                 for i in range(0, len(flat), top)])
 
         n_ops = bucket_for(max(len(h) for h in flat) or 1)
-        enc = encode_batch(flat, self.spec.initial_state(), max_ops=n_ops)
+        enc = encode_batch(flat, self.kspec.initial_state(), max_ops=n_ops)
         b = len(flat)
         cmd = enc.ops[:, :, 1].astype(np.int32)
         arg = enc.ops[:, :, 2].astype(np.int32)
@@ -667,7 +681,11 @@ class JaxTPU:
         inits = np.tile(np.asarray(enc.init_state, np.int32), (b, 1))
         if flat_inits is not None:
             for i, s in enumerate(flat_inits):
-                inits[i] = np.asarray(s, np.int32)
+                # caller states are in the SPEC's representation; the
+                # kernel runs the shadow's (validated in check_histories)
+                inits[i] = (np.asarray([self._shadow.pack(s)], np.int32)
+                            if self._shadow is not None
+                            else np.asarray(s, np.int32))
 
         out_status = np.full(b, BUDGET, np.int32)
         active = np.arange(b)          # indices into the flat batch

@@ -1,0 +1,139 @@
+"""Scalarization — pack small vector states into one scalar.
+
+The device kernel has two step regimes (ops/jax_kernel.py): scalar-state
+specs with a declared bound get a per-history ``[S, n_ops]`` step TABLE
+built once per chunk call, and the while-loop body does a single dynamic
+row gather per iteration; vector-state specs re-evaluate a vmapped
+``step_jax`` over all ops EVERY iteration — the dominant per-iteration
+cost, and the reason the round-2 verdict called vector specs the device's
+worst case.
+
+When a vector spec declares per-element domain bounds
+(``Spec.state_elem_bounds``), its reachable states embed injectively into
+``[0, prod(bounds))`` by mixed-radix packing.  :class:`Scalarized` is the
+resulting scalar spec: ``step`` = unpack → inner step → pack.  The
+packing is a bijection between reachable vector states and their images,
+so the search tree, the candidate order, and the verdict are identical
+to running the inner spec directly; iteration counts agree up to memo
+hash-collision luck (the cache keys change width, so single-slot
+collisions land on different entries).  What changes is the
+per-iteration cost: a table row gather instead of a vmapped step sweep,
+one-word memo keys instead of STATE_DIM words — measured 1.85× on the
+queue-48 device corpus (docs/EXPERIMENTS.md).
+
+``JaxTPU`` applies this transparently when the packed domain is small
+(see ``scalar_shadow``); the queue/stack/KV parity suites pin the
+equivalence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.spec import Spec
+
+# Packed domains beyond this get no table: rows × n_ops × 4 bytes must
+# stay small next to the kernel's own carry (65536 × 64 ops ≈ 16 MB of
+# table per chunk call is the ceiling we accept before the sweep regime
+# is the better trade).
+MAX_PACKED_STATES = 65_536
+
+
+class Scalarized(Spec):
+    """Scalar shadow of a vector spec with declared element bounds."""
+
+    STATE_DIM = 1
+
+    def __init__(self, inner: Spec):
+        bounds = inner.state_elem_bounds()
+        if bounds is None or inner.STATE_DIM != len(bounds):
+            raise ValueError(
+                f"{inner.name}: state_elem_bounds must give one exclusive "
+                f"bound per state element to scalarize")
+        self.inner = inner
+        self.bounds = [int(b) for b in bounds]
+        self.CMDS = inner.CMDS
+        self.name = f"scalarized({inner.name})"
+        # mixed-radix place values: element i contributes state[i]*radix[i]
+        self.radix = [1] * len(self.bounds)
+        for i in range(1, len(self.bounds)):
+            self.radix[i] = self.radix[i - 1] * self.bounds[i - 1]
+        self.n_packed = self.radix[-1] * self.bounds[-1]
+
+    # -- packing ----------------------------------------------------------
+    def pack(self, state: Sequence[int]) -> int:
+        if len(state) != len(self.bounds):
+            raise ValueError(
+                f"state has {len(state)} elements, spec declares "
+                f"{len(self.bounds)}")
+        total = 0
+        for v, r, b in zip(state, self.radix, self.bounds):
+            v = int(v)
+            if not 0 <= v < b:
+                raise ValueError(
+                    f"state element {v} outside declared bound {b}")
+            total += v * r
+        return total
+
+    def unpack(self, packed: int) -> list:
+        out = []
+        for b in self.bounds:
+            out.append(packed % b)
+            packed //= b
+        return out
+
+    def in_bounds(self, state: Sequence[int]) -> bool:
+        return (len(state) == len(self.bounds)
+                and all(0 <= int(v) < b
+                        for v, b in zip(state, self.bounds)))
+
+    # -- Spec protocol ----------------------------------------------------
+    def initial_state(self) -> np.ndarray:
+        return np.asarray([self.pack(self.inner.initial_state())], np.int32)
+
+    def scalar_state_bound(self, n_ops):
+        return self.n_packed
+
+    def spec_kwargs(self):
+        return self.inner.spec_kwargs()
+
+    def step_py(self, state, cmd, arg, resp):
+        vec, ok = self.inner.step_py(self.unpack(int(state[0])), cmd, arg,
+                                     resp)
+        return [self.pack(vec)], ok
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        packed = state[0]
+        vec = []
+        for b in self.bounds:
+            vec.append(packed % b)
+            packed = packed // b
+        nxt, ok = self.inner.step_jax(
+            jnp.stack(vec).astype(jnp.int32), cmd, arg, resp)
+        total = jnp.int32(0)
+        for i, r in enumerate(self.radix):
+            total = total + nxt[i].astype(jnp.int32) * jnp.int32(r)
+        return jnp.stack([total]), ok
+
+
+def scalar_shadow(spec: Spec,
+                  max_states: int = MAX_PACKED_STATES
+                  ) -> Optional[Scalarized]:
+    """A :class:`Scalarized` shadow of ``spec`` if it declares element
+    bounds and the packed domain is small enough to tabulate, else None
+    (scalar specs need no shadow; they already ride the table path)."""
+    if spec.STATE_DIM == 1:
+        return None
+    bounds = spec.state_elem_bounds()
+    if bounds is None:
+        return None
+    n = 1
+    for b in bounds:
+        n *= int(b)
+        if n > max_states:
+            return None
+    return Scalarized(spec)

@@ -1,0 +1,95 @@
+"""Scalarization (ops/scalarize.py): packing is a bijection on bounded
+states, the shadow applies exactly where the packed domain is small, and
+JaxTPU defers out-of-bounds start states instead of mispacking them."""
+
+import numpy as np
+import pytest
+
+from qsm_tpu import Verdict, WingGongCPU, generate_program, run_concurrent
+from qsm_tpu.models.kv import KvSpec
+from qsm_tpu.models.queue import (AtomicQueueSUT, QueueSpec,
+                                  RacyTwoPhaseQueueSUT)
+from qsm_tpu.models.register import RegisterSpec
+from qsm_tpu.ops.jax_kernel import JaxTPU
+from qsm_tpu.ops.scalarize import Scalarized, scalar_shadow
+
+
+def test_pack_unpack_roundtrip_exhaustive():
+    import itertools
+
+    spec = QueueSpec(capacity=2, n_values=3)
+    sh = Scalarized(spec)
+    seen = set()
+    for state in itertools.product(range(3), range(3), range(3)):
+        if state[0] > spec.capacity:
+            continue
+        packed = sh.pack(list(state))
+        assert 0 <= packed < sh.n_packed
+        assert sh.unpack(packed) == list(state)
+        assert packed not in seen  # injective
+        seen.add(packed)
+
+
+def test_step_py_matches_inner_through_packing():
+    import random
+
+    spec = QueueSpec()
+    sh = Scalarized(spec)
+    rng = random.Random(5)
+    state = list(spec.initial_state())
+    for _ in range(200):
+        cmd = rng.randrange(len(spec.CMDS))
+        arg = rng.randrange(spec.CMDS[cmd].n_args)
+        resp = rng.randrange(spec.CMDS[cmd].n_resps)
+        want_vec, want_ok = spec.step_py(state, cmd, arg, resp)
+        got_packed, got_ok = sh.step_py([sh.pack(state)], cmd, arg, resp)
+        assert got_ok == want_ok
+        assert sh.unpack(got_packed[0]) == [int(v) for v in want_vec]
+        state = [int(v) for v in want_vec]
+
+
+def test_shadow_applicability():
+    assert scalar_shadow(RegisterSpec()) is None       # already scalar
+    assert scalar_shadow(QueueSpec()) is not None      # 1,280 states
+    assert scalar_shadow(KvSpec(n_keys=4)) is not None  # 256 states
+    assert scalar_shadow(KvSpec(n_keys=16)) is None    # 4^16: too big
+    assert Scalarized(QueueSpec()).n_packed == 5 * 4 ** 4
+
+
+def test_jax_tpu_uses_shadow_and_stays_exact():
+    spec = QueueSpec()
+    b = JaxTPU(spec, budget=2_000, mid_budget=10_000,
+               rescue_budget=100_000)
+    assert b._shadow is not None and b._uses_table
+    hists = []
+    for seed in range(10):
+        prog = generate_program(spec, seed=seed, n_pids=4, max_ops=16)
+        for sut in (AtomicQueueSUT(spec), RacyTwoPhaseQueueSUT(spec)):
+            hists.append(run_concurrent(sut, prog, seed=f"sc{seed}"))
+    want = WingGongCPU(memo=True).check_histories(spec, hists)
+    got = b.check_histories(spec, hists)
+    decided = got != int(Verdict.BUDGET_EXCEEDED)
+    np.testing.assert_array_equal(got[decided], np.asarray(want)[decided])
+    assert decided.all(), "capped budgets should decide this small corpus"
+
+
+def test_out_of_bounds_start_state_deferred_not_mispacked():
+    spec = QueueSpec()
+    b = JaxTPU(spec)
+    prog = generate_program(spec, seed=3, n_pids=2, max_ops=8)
+    h = run_concurrent(AtomicQueueSUT(spec), prog, seed="oob")
+    bad = np.asarray([99] + [0] * spec.capacity, np.int32)  # length 99
+    v = b.check_histories(spec, [h], init_states=[bad])
+    assert int(v[0]) == int(Verdict.BUDGET_EXCEEDED)  # honest deferral
+    assert b.deferred_out_of_domain == 1
+
+
+def test_bad_bounds_declaration_refused():
+    class Broken(QueueSpec):
+        def state_elem_bounds(self):
+            return [2]  # wrong arity vs STATE_DIM
+
+    with pytest.raises(ValueError, match="one exclusive"):
+        Scalarized(Broken())
+    with pytest.raises(ValueError, match="outside declared bound"):
+        Scalarized(QueueSpec()).pack([99, 0, 0, 0, 0])

@@ -55,9 +55,10 @@ def _backends_for(model: str, spec, on_tpu: bool):
     if model == "queue":
         out["device"] = SegDC(spec,
                               make_inner=lambda s: JaxTPU(s, **vec_kw))
-    elif model == "stack":
-        out["device"] = JaxTPU(spec, **vec_kw)  # vector state, no table
     else:
+        # stack included: its state scalarizes (ops/scalarize.py), so it
+        # rides the table-gather path at the same default budgets as the
+        # scalar configs
         out["device"] = JaxTPU(spec)
     if native_available():
         out["cpp"] = CppOracle(spec)
