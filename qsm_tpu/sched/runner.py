@@ -82,6 +82,7 @@ def prepare_run(
     faults: Optional[FaultPlan] = None,
     max_steps: int = 100_000,
     transport=None,  # None/"memory"/"tcp" or a Transport instance
+    choices=None,  # scripted delivery choices (sched/systematic.py)
 ) -> tuple:
     """(scheduler, recorder) wired up and ready to ``sched.run()``.
 
@@ -100,7 +101,7 @@ def prepare_run(
         transport = make_transport(transport)
     try:
         sched = Scheduler(seed=seed, faults=faults, max_steps=max_steps,
-                          transport=transport)
+                          transport=transport, choices=choices)
         rec = HistoryRecorder(sched)
         sut.setup(sched)
         for pid, ops in enumerate(program.per_pid()):
@@ -121,6 +122,7 @@ def run_concurrent(
     faults: Optional[FaultPlan] = None,
     max_steps: int = 100_000,
     transport=None,
+    choices=None,  # scripted delivery choices (sched/systematic.py)
 ) -> History:
     """Execute ``program`` concurrently; return its history.
 
@@ -131,7 +133,7 @@ def run_concurrent(
     complete/prune.
     """
     sched, rec = prepare_run(sut, program, seed, faults, max_steps,
-                             transport=transport)
+                             transport=transport, choices=choices)
     try:
         sched.run()
     finally:

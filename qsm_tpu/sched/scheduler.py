@@ -149,10 +149,22 @@ class Scheduler:
     """Single-threaded deterministic actor scheduler."""
 
     def __init__(self, seed: int, faults: Optional[FaultPlan] = None,
-                 max_steps: int = 100_000, transport=None):
+                 max_steps: int = 100_000, transport=None,
+                 choices: Optional[List[int]] = None):
         self.rng = random.Random(seed)
         self.faults = faults
         self.max_steps = max_steps
+        # Scripted delivery choices (sched/systematic.py): when set, the
+        # k-th delivery takes eligible[choices[k]] instead of a seeded
+        # draw, choices past the script's end take eligible[0], and
+        # ``choice_log`` records the branching factor at every delivery —
+        # the protocol systematic exploration enumerates the full
+        # interleaving tree with.  Delivery choice is the ONLY
+        # nondeterminism in a faultless run (process step order is fixed),
+        # so the script captures the whole schedule.
+        self.choices = choices
+        self.choice_log: List[int] = []
+        self._choice_pos = 0
         # transport carries the bytes; the scheduler keeps every ordering
         # decision (sched/transport.py — None = in-memory, zero overhead).
         # owns_transport: set by prepare_run when the transport was created
@@ -237,7 +249,15 @@ class Scheduler:
             # holds expire, so delivering early is history-equivalent —
             # and avoids wedging the run on a pure bookkeeping state
             eligible = list(range(len(self.pool)))
-        inf = self.pool.pop(eligible[self.rng.randrange(len(eligible))])
+        if self.choices is not None:
+            self.choice_log.append(len(eligible))
+            k = (self.choices[self._choice_pos]
+                 if self._choice_pos < len(self.choices) else 0)
+            self._choice_pos += 1
+            pick = eligible[min(k, len(eligible) - 1)]
+        else:
+            pick = eligible[self.rng.randrange(len(eligible))]
+        inf = self.pool.pop(pick)
         msg = inf.msg
         action = (self.faults.decide(msg, self.rng)
                   if self.faults and not inf.decided else FaultPlan.DELIVER)
@@ -284,6 +304,8 @@ class Scheduler:
         self.clock = 0
         self.pool.clear()
         self.trace.clear()
+        self.choice_log.clear()
+        self._choice_pos = 0
         while True:
             runnable = self._runnable()
             if runnable:

@@ -1,0 +1,135 @@
+"""Systematic schedule exploration — bounded-exhaustive model checking.
+
+The property layer samples k SEEDED schedules per program (core/property.py,
+the reference's QuickCheck approach).  This module replaces sampling with
+ENUMERATION for small programs: every delivery-order decision the scheduler
+can make is explored depth-first, every distinct history is collected, and
+the whole set is decided in ONE batched checker call — turning "k random
+schedules found nothing" into "all N interleavings explored, none violate",
+a certainty the reference family cannot produce.
+
+How it composes with the scheduler: delivery choice is the scheduler's only
+nondeterminism (process step order is fixed — sched/scheduler.py), and
+``Scheduler(choices=...)`` replays a scripted prefix then defaults to
+choice 0, logging the branching factor at every delivery.  Determinism
+makes tree search stateless: running prefix ``p`` reveals the branching
+factors along ``p``'s leftmost completion, and lexicographic backtracking
+over the logged factors enumerates the full tree without ever storing it.
+
+Fault injection is refused here: fault decisions draw from the seeded RNG,
+which scripted replay deliberately bypasses — sampling (prop_concurrent
+with a FaultPlan) remains the way to explore faulty executions.
+
+The batching story is the TPU story: enumeration yields hundreds-to-
+thousands of small histories per program, exactly the shape the device
+kernel's vmap batch wants (SURVEY.md §2b trial/batch parallelism).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.history import History
+from ..ops.backend import LineariseBackend, Verdict
+from .runner import prepare_run
+from .scheduler import FaultPlan
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    """Outcome of exploring one program's interleaving tree."""
+
+    schedules_run: int
+    distinct_histories: int
+    exhausted: bool         # True: the WHOLE tree fit under max_schedules
+    violations: int
+    undecided: int
+    seconds: float
+    violating: Optional[History] = None  # first violating history, if any
+
+    @property
+    def ok(self) -> bool:
+        return self.violations == 0
+
+    @property
+    def verified(self) -> bool:
+        """Every interleaving explored AND decided, none violating — the
+        certainty claim.  False whenever the tree was truncated or any
+        history came back undecided."""
+        return self.exhausted and self.violations == 0 and self.undecided == 0
+
+
+def _next_prefix(choices: List[int], factors: List[int]
+                 ) -> Optional[List[int]]:
+    """Lexicographic successor: the deepest position that still has an
+    untried sibling, bumped; None when the tree is exhausted."""
+    for i in range(len(factors) - 1, -1, -1):
+        c = choices[i] if i < len(choices) else 0
+        if c + 1 < factors[i]:
+            return (choices[:i] if i < len(choices)
+                    else choices + [0] * (i - len(choices))) + [c + 1]
+    return None
+
+
+def explore_program(
+    sut_factory: Callable[[], object],
+    program,
+    spec,
+    backend: Optional[LineariseBackend] = None,
+    max_schedules: int = 10_000,
+    max_steps: int = 100_000,
+    faults: Optional[FaultPlan] = None,
+) -> ExploreResult:
+    """Enumerate every delivery schedule of ``program`` (up to
+    ``max_schedules``), then decide all distinct histories in one batched
+    checker call.
+
+    ``backend`` picks the checker (default: the framework's fastest host
+    oracle via ``core.property._default_oracle``); a fresh SUT is built
+    per schedule from ``sut_factory`` (state must not leak between
+    runs — same contract as the property layer's executions).
+    """
+    if faults is not None:
+        raise ValueError(
+            "systematic exploration is incompatible with fault injection "
+            "(fault decisions are seeded draws, which scripted replay "
+            "bypasses); use prop_concurrent sampling for faulty runs")
+    t0 = time.perf_counter()
+    histories: Dict[Tuple, History] = {}
+    prefix: Optional[List[int]] = []
+    schedules = 0
+    exhausted = True
+    while prefix is not None:
+        if schedules >= max_schedules:
+            exhausted = False
+            break
+        sched, rec = prepare_run(sut_factory(), program, seed=0,
+                                 max_steps=max_steps, choices=prefix)
+        sched.run()
+        schedules += 1
+        h = rec.history(seed=f"explore:{','.join(map(str, prefix))}")
+        histories.setdefault(h.fingerprint(), h)
+        prefix = _next_prefix(prefix, sched.choice_log)
+
+    hists = list(histories.values())
+    if backend is None:
+        from ..core.property import _default_oracle
+
+        backend = _default_oracle(spec)
+    verdicts = (backend.check_histories(spec, hists) if hists
+                else np.empty(0, np.int8))
+    violations = int((verdicts == int(Verdict.VIOLATION)).sum())
+    undecided = int((verdicts == int(Verdict.BUDGET_EXCEEDED)).sum())
+    violating = None
+    for h, v in zip(hists, verdicts):
+        if int(v) == int(Verdict.VIOLATION):
+            violating = h
+            break
+    return ExploreResult(
+        schedules_run=schedules, distinct_histories=len(hists),
+        exhausted=exhausted, violations=violations, undecided=undecided,
+        seconds=round(time.perf_counter() - t0, 3), violating=violating)

@@ -347,6 +347,34 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_explore(args) -> int:
+    """Bounded-exhaustive schedule exploration of one generated program
+    (sched/systematic.py): every interleaving, one batched verdict."""
+    from ..core.generator import generate_program
+    from ..sched.systematic import explore_program
+
+    spec, _ = make(args.model, args.impl)
+    # explore defaults SMALL (2 pids x 6 ops): enumeration is exponential
+    # in deliveries, so registry-default sizes are never implied here
+    prog = generate_program(spec, seed=args.seed, n_pids=args.pids,
+                            max_ops=args.ops)
+    backend = (_make_backend(args.backend, spec)
+               if args.backend else None)
+    res = explore_program(
+        lambda: make(args.model, args.impl)[1], prog, spec,
+        backend=backend, max_schedules=args.max_schedules)
+    out = {"model": args.model, "impl": args.impl, "ops": len(prog),
+           "schedules_run": res.schedules_run,
+           "distinct_histories": res.distinct_histories,
+           "exhausted": res.exhausted, "violations": res.violations,
+           "undecided": res.undecided, "verified": res.verified,
+           "seconds": res.seconds}
+    print(json.dumps(out))
+    if res.violating is not None:
+        print(format_history(spec, res.violating), file=sys.stderr)
+    return 0 if res.ok else 1
+
+
 def cmd_fuzz(args) -> int:
     from .fuzz import fuzz_parity
 
@@ -395,6 +423,18 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("list", help="models, impls, and backend choices")
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser(
+        "explore",
+        help="bounded-exhaustive schedule exploration of one program")
+    p.add_argument("--model", required=True, choices=sorted(MODELS))
+    p.add_argument("--impl", default="racy")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--pids", type=int, default=2)
+    p.add_argument("--ops", type=int, default=6)
+    p.add_argument("--max-schedules", type=int, default=10_000)
+    p.add_argument("--backend", default=None, choices=_BACKENDS)
+    p.set_defaults(fn=cmd_explore)
 
     p = sub.add_parser(
         "fuzz", help="differential backend fuzzing over random specs")

@@ -1,0 +1,100 @@
+"""Systematic schedule exploration (sched/systematic.py): scripted
+delivery choices replay deterministically, lexicographic backtracking
+visits every leaf exactly once, the racy TOCTOU is FOUND exhaustively
+(no sampling luck), and the atomic impl earns the `verified` certainty
+claim."""
+
+import json
+
+from qsm_tpu.core.generator import ProgOp, Program
+from qsm_tpu.models.register import (AtomicRegisterSUT,
+                                     RacyCachedRegisterSUT, RegisterSpec)
+from qsm_tpu.models.set import ADD, AtomicSetSUT, RacyCheckThenActSetSUT, SetSpec
+from qsm_tpu.sched.runner import run_concurrent
+from qsm_tpu.sched.scheduler import FaultPlan
+from qsm_tpu.sched.systematic import _next_prefix, explore_program
+
+import pytest
+
+# the crisp 2-pid TOCTOU program: both pids add the same key
+SET_SPEC = SetSpec(n_keys=2)
+SET_PROG = Program(ops=(ProgOp(0, ADD, 0), ProgOp(1, ADD, 0)), n_pids=2)
+
+
+def test_next_prefix_enumerates_constant_tree_exactly_once():
+    """DFS over a synthetic 2x2 choice tree: 4 leaves, each once."""
+    leaves = []
+    prefix = []
+    while prefix is not None:
+        full = (prefix + [0, 0])[:2]
+        leaves.append(tuple(full))
+        prefix = _next_prefix(prefix, [2, 2])
+    assert leaves == [(0, 0), (0, 1), (1, 0), (1, 1)]
+
+
+def test_scripted_choices_are_deterministic():
+    h1 = run_concurrent(RacyCheckThenActSetSUT(SET_SPEC), SET_PROG,
+                        seed=0, choices=[1, 0, 1])
+    h2 = run_concurrent(RacyCheckThenActSetSUT(SET_SPEC), SET_PROG,
+                        seed=99, choices=[1, 0, 1])  # seed must not matter
+    assert h1.fingerprint() == h2.fingerprint()
+    # that scripts actually STEER schedules is proven by the exploration
+    # tests below (distinct_histories >= 2 over the same program)
+
+
+def test_explore_finds_toctou_with_certainty():
+    res = explore_program(lambda: RacyCheckThenActSetSUT(SET_SPEC),
+                          SET_PROG, SET_SPEC)
+    assert res.exhausted, "tiny program must fully enumerate"
+    assert res.violations > 0, "exhaustive exploration missed the race"
+    assert not res.ok and not res.verified
+    assert res.violating is not None
+    # the violating history is the double-insert: both adds returned 1
+    assert [o.resp for o in res.violating.ops] == [1, 1]
+
+
+def test_explore_verifies_atomic_impl():
+    res = explore_program(lambda: AtomicSetSUT(SET_SPEC), SET_PROG,
+                          SET_SPEC)
+    assert res.exhausted and res.violations == 0 and res.undecided == 0
+    assert res.verified and res.ok
+    assert res.schedules_run >= res.distinct_histories >= 2
+
+
+def test_explore_register_program():
+    spec = RegisterSpec(n_values=3)
+    # pid0 writes 1; pid1 reads twice — the stale-cache race needs the
+    # SECOND read (served from cache, no round trip) to land real-time
+    # after the write completed while the first read's response carried 0
+    prog = Program(ops=(ProgOp(0, 1, 1), ProgOp(1, 0, 0),
+                        ProgOp(1, 0, 0)), n_pids=2)
+    racy = explore_program(lambda: RacyCachedRegisterSUT(), prog, spec)
+    atomic = explore_program(lambda: AtomicRegisterSUT(), prog, spec)
+    assert atomic.verified
+    assert racy.exhausted
+    # the cached-read impl serves a stale value under SOME interleaving
+    assert racy.violations > 0
+
+
+def test_explore_truncation_reported():
+    res = explore_program(lambda: AtomicSetSUT(SET_SPEC), SET_PROG,
+                          SET_SPEC, max_schedules=2)
+    assert res.schedules_run == 2
+    assert not res.exhausted and not res.verified
+
+
+def test_explore_refuses_faults():
+    with pytest.raises(ValueError, match="fault"):
+        explore_program(lambda: AtomicSetSUT(SET_SPEC), SET_PROG,
+                        SET_SPEC, faults=FaultPlan(p_drop=0.5))
+
+
+def test_explore_cli(capsys):
+    from qsm_tpu.utils.cli import main
+
+    rc = main(["explore", "--model", "set", "--impl", "racy",
+               "--pids", "2", "--ops", "4", "--seed", "1"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert out["schedules_run"] >= 1
+    assert out["exhausted"] in (True, False)
+    assert rc in (0, 1)

@@ -48,9 +48,12 @@ def _log(**rec) -> None:
         f.write(json.dumps(rec) + "\n")
 
 
-def _run_window_bench(bench_timeout: float, extra_args, label: str) -> bool:
+def _run_window_bench(bench_timeout: float, extra_args, label: str,
+                      bank: bool = True) -> bool:
     """One bounded bench.py run; writes the artifact iff it really ran on
-    the device.  Returns True on a captured device line."""
+    the device AND ``bank`` is set (profiled runs pass bank=False: their
+    timings include tracer overhead and must never become the headline).
+    Returns True on a captured device line."""
     t0 = time.time()
     try:
         r = subprocess.run(
@@ -82,7 +85,7 @@ def _run_window_bench(bench_timeout: float, extra_args, label: str) -> bool:
          rc=r.returncode, seconds=round(time.time() - t0, 1),
          detail=(result.get("extras", {}).get("device", "")
                  if result else (r.stderr or "")[-200:]))
-    if on_device:
+    if on_device and bank:
         result["captured_iso"] = datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds")
         with open(WINDOW_ARTIFACT, "w") as f:
@@ -149,6 +152,14 @@ def _seize_window(bench_timeout: float) -> bool:
         # after a failed bank the flicker closed — a full sweep on the
         # CPU fallback would block probing for up to bench_timeout
         _run_window_bench(bench_timeout, [], "window_bench_full")
+        # separate PROFILED run, never banked: the tracer overhead must
+        # not deflate the headline artifact; this only captures the
+        # first real-TPU jax.profiler trace (PROFILE_r03.md's CPU trace
+        # awaits its device twin)
+        _run_window_bench(bench_timeout / 2,
+                          ["--no-sweep", "--profile", os.path.join(
+                              REPO, "profiles", "r03_tpu")],
+                          "window_profile", bank=False)
         _run_tool("bench_configs.py",
                   os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"),
                   bench_timeout, "window_configs")
