@@ -24,7 +24,8 @@ from .core.sequential import (ModelSUT, prop_sequential,
                               run_sequential)
 from .core.property import (Counterexample, PropertyConfig, PropertyResult,
                             prop_concurrent, replay, trial_seed)
-from .ops.backend import LineariseBackend, Verdict, check_one
+from .ops.backend import (LineariseBackend, Verdict, check_one,
+                          verify_witness)
 from .ops.wing_gong_cpu import WingGongCPU
 from .sched.scheduler import FaultPlan, Recv, Scheduler, Send
 from .sched.runner import ConcurrentSUT, run_concurrent

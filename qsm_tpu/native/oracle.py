@@ -176,6 +176,13 @@ class CppOracle:
         v = self.check_histories(spec, [history], init_states=[init_state])
         return Verdict(int(v[0]))
 
+    def check_witness(self, spec: Spec, history: History):
+        """(verdict, witness) — delegated to the Python oracle: witness
+        extraction is a debugging/audit path, and the fallback shares
+        this backend's candidate order and budget config, so the verdict
+        agrees with :meth:`check_histories` wherever both decide."""
+        return self.fallback.check_witness(spec, history)
+
     # ------------------------------------------------------------------
     def end_states(self, spec: Spec, ops, starts, budget=None,
                    node_budget: Optional[int] = None,

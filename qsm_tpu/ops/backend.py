@@ -40,3 +40,50 @@ class LineariseBackend(Protocol):
 
 def check_one(backend: LineariseBackend, spec: Spec, history: History) -> Verdict:
     return Verdict(int(backend.check_histories(spec, [history])[0]))
+
+
+def verify_witness(spec: Spec, history: History, witness) -> bool:
+    """Independently replay a claimed linearization — NO search involved.
+
+    ``witness`` is a list of ``(op_index, resp)`` pairs in linearization
+    order (the shape ``check_witness`` returns).  Valid iff: every
+    non-pending op appears exactly once (pending ops may appear at most
+    once — unlisted means pruned), real-time precedence is respected
+    (an op linearizes only after everything that strictly precedes it),
+    listed resps match each non-pending op's own response, and every
+    step's postcondition holds from the initial state.  This is what
+    makes a LINEARIZABLE verdict auditable: the checker's exponential
+    search is not trusted, only this linear replay.
+    """
+    ops = history.ops
+    n = len(ops)
+    prec = history.precedes_matrix()
+    listed = [j for j, _ in witness]
+    if len(set(listed)) != len(listed):
+        return False  # an op linearized twice
+    if not all(0 <= j < n for j in listed):
+        return False
+    required = {j for j in range(n) if not ops[j].is_pending}
+    if required - set(listed):
+        return False  # a completed op never linearized
+    taken = [False] * n
+    state = list(int(v) for v in spec.initial_state())
+    for j, resp in witness:
+        if ops[j].is_pending:
+            if not 0 <= resp < spec.CMDS[ops[j].cmd].n_resps:
+                return False  # completion outside the response domain
+        elif resp != ops[j].resp:
+            return False
+        for i in range(n):
+            if prec[i, j] and not taken[i]:
+                return False  # linearized before a real-time predecessor
+        state, ok = spec.step_py(state, ops[j].cmd, ops[j].arg, resp)
+        state = list(state)
+        if not ok:
+            return False
+        taken[j] = True
+    # unlisted PENDING ops count as pruned — but a pruned op must not
+    # strictly precede any listed op (it never took effect, which is
+    # only consistent if nothing was required to wait for it; pending
+    # ops never precede anything, so this holds by construction)
+    return True

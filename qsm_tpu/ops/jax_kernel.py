@@ -659,16 +659,59 @@ class JaxTPU:
         v = self.check_histories(spec, [history], init_states=[init_state])
         return Verdict(int(v[0]))
 
+    def check_witness(self, spec: Spec, history: History):
+        """(verdict, witness) for one history — the device counterpart of
+        ``WingGongCPU.check_witness``: the kernel's ``chosen`` stack IS
+        the successful linearization, read back on success.  Witnesses
+        only for pending-free histories (pending completion happens in
+        host-side expansion, so the in-kernel stack describes an expanded
+        variant, not the input); (verdict, None) otherwise.  Like the
+        oracle's, the witness replays independently via
+        ``verify_witness`` — the kernel is not trusted, its proof is.
+        """
+        if history.n_pending or (
+                self._uses_table and not self._args_in_domain(history)):
+            # pending or out-of-domain: the witness path can't apply —
+            # route through the normal (expanding/deferring) entry
+            return Verdict(
+                int(self.check_histories(spec, [history])[0])), None
+        if not history.ops:
+            return Verdict.LINEARIZABLE, []
+        # ONE device search, witness read back from the same run (a
+        # second search just to collect `chosen` would double the
+        # dominant cost for hard histories)
+        statuses, chosen = self._run_device([history], collect_chosen=True)
+        v = {SUCCESS: Verdict.LINEARIZABLE, FAILURE: Verdict.VIOLATION,
+             BUDGET: Verdict.BUDGET_EXCEEDED}[int(statuses[0])]
+        if v != Verdict.LINEARIZABLE:
+            return v, None
+        order = [int(j) for j in chosen[0][:len(history.ops)]]
+        return v, [(j, history.ops[j].resp) for j in order]
+
     # -- the chunked, lane-compacting driver -------------------------------
     def _run_device(self, flat: Sequence[History],
-                    flat_inits: Optional[List] = None) -> np.ndarray:
+                    flat_inits: Optional[List] = None,
+                    collect_chosen: bool = False):
+        """Statuses for a flat batch; with ``collect_chosen`` also the
+        final ``chosen`` stack per lane (the linearization witness for
+        SUCCESS lanes — :meth:`check_witness`)."""
         top = _BATCH_BUCKETS[-1]
         if len(flat) > top:
-            return np.concatenate([
+            parts = [
                 self._run_device(
                     flat[i:i + top],
-                    flat_inits[i:i + top] if flat_inits else None)
-                for i in range(0, len(flat), top)])
+                    flat_inits[i:i + top] if flat_inits else None,
+                    collect_chosen=collect_chosen)
+                for i in range(0, len(flat), top)]
+            if collect_chosen:
+                # chunks bucket n_ops independently; pad chosen to the
+                # widest before concatenating (sentinel -1 beyond depth)
+                width = max(p[1].shape[1] for p in parts)
+                padded = [np.pad(p[1], ((0, 0), (0, width - p[1].shape[1])),
+                                 constant_values=-1) for p in parts]
+                return (np.concatenate([p[0] for p in parts]),
+                        np.concatenate(padded))
+            return np.concatenate(parts)
 
         n_ops = bucket_for(max(len(h) for h in flat) or 1)
         enc = encode_batch(flat, self.kspec.initial_state(), max_ops=n_ops)
@@ -688,6 +731,8 @@ class JaxTPU:
                             else np.asarray(s, np.int32))
 
         out_status = np.full(b, BUDGET, np.int32)
+        out_chosen = (np.full((b, n_ops + 1), -1, np.int32)
+                      if collect_chosen else None)
         active = np.arange(b)          # indices into the flat batch
         carry = None                   # device carry for current bucket
         args = None
@@ -734,6 +779,9 @@ class JaxTPU:
             done = lane_status != RUNNING
             if done.any():
                 out_status[active[done]] = lane_status[done]
+                if collect_chosen:
+                    out_chosen[active[done]] = np.asarray(
+                        carry["chosen"])[lanes[done]]
                 decided = lane_status[done] != BUDGET
                 self.rescued += int(np.sum(
                     decided & (iters[lanes][done] > self.budget)))
@@ -743,6 +791,8 @@ class JaxTPU:
             round_i += 1
 
         self.device_histories += b
+        if collect_chosen:
+            return out_status, out_chosen
         return out_status
 
     def _fresh_carry(self, active, bucket, slots, n_ops, valid, inits):

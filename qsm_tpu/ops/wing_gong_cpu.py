@@ -62,8 +62,22 @@ class WingGongCPU:
         return self._check(spec, history, init_state=init_state)
 
     # ------------------------------------------------------------------
+    def check_witness(self, spec: Spec, history: History):
+        """(verdict, witness) — the witness is the successful
+        linearization as a list of ``(op_index, resp)`` pairs in
+        linearization order (op_index into ``history.ops``; resp is the
+        chosen completion for pending ops, the op's own otherwise), or
+        None when the verdict is not LINEARIZABLE.  A LINEARIZABLE
+        verdict thus carries its own proof: ``verify_witness``
+        (ops/backend.py) replays it independently of any search."""
+        witness: List[tuple] = []
+        v = self._check(spec, history, witness_out=witness)
+        return v, (list(reversed(witness))
+                   if v == Verdict.LINEARIZABLE else None)
+
+    # ------------------------------------------------------------------
     def _check(self, spec: Spec, history: History,
-               init_state=None) -> Verdict:
+               init_state=None, witness_out=None) -> Verdict:
         ops = history.ops
         n = len(ops)
         if n == 0:
@@ -120,6 +134,10 @@ class WingGongCPU:
                               got_required + (0 if pending[j] else 1))
                     taken[j] = False
                     if sub == Verdict.LINEARIZABLE:
+                        if witness_out is not None:
+                            # success unwinds deepest-first; caller
+                            # reverses into linearization order
+                            witness_out.append((j, resp))
                         return sub
                     if sub == Verdict.BUDGET_EXCEEDED:
                         saw_budget = True

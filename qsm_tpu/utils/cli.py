@@ -369,6 +369,11 @@ def cmd_explore(args) -> int:
            "exhausted": res.exhausted, "violations": res.violations,
            "undecided": res.undecided, "verified": res.verified,
            "seconds": res.seconds}
+    if res.violating is not None:
+        # "explore:<comma-joined delivery choices>" — the exact schedule
+        # script that produced this history (replayable via
+        # run_concurrent(..., choices=[...]))
+        out["violating_schedule"] = res.violating.seed
     print(json.dumps(out))
     if res.violating is not None:
         print(format_history(spec, res.violating), file=sys.stderr)
