@@ -63,6 +63,22 @@ class ExploreResult:
         return self.exhausted and self.violations == 0 and self.undecided == 0
 
 
+def schedule_key(choices: Sequence[int]) -> str:
+    """Stamp a delivery-choice script into a history seed string.  The
+    ONE encode site; :func:`parse_schedule_key` is its inverse (replay
+    regressions store this string, so the pair must never drift)."""
+    return "explore:" + ",".join(map(str, choices))
+
+
+def parse_schedule_key(seed_key) -> Optional[List[int]]:
+    """The choice script from a :func:`schedule_key` stamp, or None when
+    ``seed_key`` is not an exploration stamp (an ordinary seeded run)."""
+    if not (isinstance(seed_key, str) and seed_key.startswith("explore:")):
+        return None
+    body = seed_key[len("explore:"):]
+    return [int(x) for x in body.split(",") if x != ""]
+
+
 def _next_prefix(choices: List[int], factors: List[int]
                  ) -> Optional[List[int]]:
     """Lexicographic successor: the deepest position that still has an
@@ -111,7 +127,7 @@ def explore_program(
                                  max_steps=max_steps, choices=prefix)
         sched.run()
         schedules += 1
-        h = rec.history(seed=f"explore:{','.join(map(str, prefix))}")
+        h = rec.history(seed=schedule_key(prefix))
         histories.setdefault(h.fingerprint(), h)
         prefix = _next_prefix(prefix, sched.choice_log)
 

@@ -267,7 +267,12 @@ def cmd_replay(args) -> int:
             load_regression(args.regression)
         spec, sut = make(model, impl, spec_kwargs)
         print(f"replaying {model}/{impl} trial seed {seed_key!r}")
-        h = run_concurrent(sut, prog, seed=seed_key, faults=faults)
+        # an exploration finding replays by its delivery-choice SCRIPT,
+        # not by seeded randomness (schedule_key stamps it into the seed)
+        from ..sched.systematic import parse_schedule_key
+
+        h = run_concurrent(sut, prog, seed=seed_key, faults=faults,
+                           choices=parse_schedule_key(seed_key))
         same = h.fingerprint() == hist.fingerprint()
         print(f"history reproduced bit-identically: {same}")
     else:
@@ -377,6 +382,17 @@ def cmd_explore(args) -> int:
     print(json.dumps(out))
     if res.violating is not None:
         print(format_history(spec, res.violating), file=sys.stderr)
+        if args.save_regression:
+            from ..core.property import Counterexample
+
+            cx = Counterexample(program=prog, history=res.violating,
+                                trial=0, trial_seed=res.violating.seed,
+                                shrink_steps=0)
+            cfg = PropertyConfig(n_pids=args.pids, max_ops=args.ops)
+            save_regression(args.save_regression, args.model, args.impl,
+                            spec, cfg, cx)
+            print(f"regression saved to {args.save_regression}",
+                  file=sys.stderr)
     return 0 if res.ok else 1
 
 
@@ -439,6 +455,9 @@ def main(argv=None) -> int:
     p.add_argument("--ops", type=int, default=6)
     p.add_argument("--max-schedules", type=int, default=10_000)
     p.add_argument("--backend", default=None, choices=_BACKENDS)
+    p.add_argument("--save-regression", default=None,
+                   help="persist the violating (program, schedule) as a "
+                        "replayable regression file")
     p.set_defaults(fn=cmd_explore)
 
     p = sub.add_parser(

@@ -99,6 +99,69 @@ class RandomTableSpec(Spec):
                 ok[s, cmd, arg, resp])
 
 
+class RandomVectorSpec(Spec):
+    """A random VECTOR-state spec: each state element evolves by its own
+    seeded table (values within its declared bound), ``ok`` by a table
+    over (element0, op).  Exists to fuzz the vector-state machinery the
+    scalar table spec cannot reach: the device kernel's step sweep, the
+    scalarization shadow (ops/scalarize.py — applied iff the bounds
+    product is small), and the oracle's vector memo keys.
+    """
+
+    name = "random_vector"
+
+    def __init__(self, seed: int, bounds: Tuple[int, ...] = (4, 4, 4),
+                 n_cmds: int = 3, max_args: int = 3, max_resps: int = 3,
+                 ok_bias: float = 0.7):
+        self.seed = seed
+        self.bounds = tuple(int(b) for b in bounds)
+        self.STATE_DIM = len(self.bounds)
+        self.ok_bias = ok_bias
+        self._max_args_bound = max_args
+        self._max_resps_bound = max_resps
+        rng = np.random.default_rng(seed)
+        self.CMDS = tuple(
+            CmdSig(f"c{i}", n_args=int(rng.integers(1, max_args + 1)),
+                   n_resps=int(rng.integers(1, max_resps + 1)))
+            for i in range(n_cmds))
+        a = max(c.n_args for c in self.CMDS)
+        r = max(c.n_resps for c in self.CMDS)
+        self._trans = [rng.integers(0, b, size=(b, n_cmds, a, r),
+                                    dtype=np.int32)
+                       for b in self.bounds]
+        self._ok = rng.random((self.bounds[0], n_cmds, a, r)) < ok_bias
+        self._jnp_tables = None
+
+    def initial_state(self) -> np.ndarray:
+        return np.zeros(self.STATE_DIM, np.int32)
+
+    def state_elem_bounds(self):
+        return list(self.bounds)
+
+    def spec_kwargs(self):
+        return {"seed": self.seed, "bounds": self.bounds,
+                "n_cmds": len(self.CMDS),
+                "max_args": self._max_args_bound,
+                "max_resps": self._max_resps_bound,
+                "ok_bias": self.ok_bias}
+
+    def step_py(self, state, cmd, arg, resp):
+        nxt = [int(t[int(s), cmd, arg, resp])
+               for s, t in zip(state, self._trans)]
+        return nxt, bool(self._ok[int(state[0]), cmd, arg, resp])
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        if self._jnp_tables is None:
+            self._jnp_tables = ([jnp.asarray(t) for t in self._trans],
+                                jnp.asarray(self._ok))
+        trans, ok = self._jnp_tables
+        nxt = jnp.stack([t[state[i], cmd, arg, resp]
+                         for i, t in enumerate(trans)])
+        return nxt.astype(state.dtype), ok[state[0], cmd, arg, resp]
+
+
 def random_history(spec: Spec, rng: random.Random, n_pids: int,
                    n_ops: int, p_pending: float = 0.0) -> History:
     """A random well-formed concurrent history against ``spec``.
@@ -162,12 +225,19 @@ def fuzz_parity(n_specs: int = 10, hists_per_spec: int = 32,
                 seed: int = 0, n_pids: int = 4, n_ops: int = 10,
                 p_pending: float = 0.1,
                 backends: Sequence[str] = ("memo", "cpp", "device"),
-                spec_kwargs: Optional[dict] = None) -> FuzzReport:
+                spec_kwargs: Optional[dict] = None,
+                vector_bounds: Optional[Tuple[int, ...]] = None
+                ) -> FuzzReport:
     """Differential sweep: for each random spec, every requested backend
     must agree with the exact (memo-free) Python oracle on every random
     history.  BUDGET_EXCEEDED never counts as a mismatch on its own —
     backends may defer — but a decided verdict that contradicts the
     oracle's decided verdict always does.
+
+    ``vector_bounds`` switches the spec family from scalar random tables
+    to :class:`RandomVectorSpec` with those element bounds — small
+    products exercise the scalarization shadow, large ones the vector
+    step-sweep kernel path.
     """
     from ..native import CppOracle
     from ..ops.backend import Verdict
@@ -179,7 +249,11 @@ def fuzz_parity(n_specs: int = 10, hists_per_spec: int = 32,
     mismatches: List[Tuple[int, int, str, int, int]] = []
     for k in range(n_specs):
         spec_seed = seed * 1_000_003 + k
-        spec = RandomTableSpec(spec_seed, **(spec_kwargs or {}))
+        if vector_bounds is not None:
+            spec = RandomVectorSpec(spec_seed, bounds=vector_bounds,
+                                    **(spec_kwargs or {}))
+        else:
+            spec = RandomTableSpec(spec_seed, **(spec_kwargs or {}))
         rng = random.Random(f"fuzz:{spec_seed}")
         hists = [random_history(spec, rng, n_pids, n_ops,
                                 p_pending=p_pending)

@@ -71,6 +71,34 @@ def test_fuzz_device_backend():
     assert rep.linearizable > 0 and rep.violations > 0
 
 
+def test_fuzz_vector_specs_scalarized_device():
+    """Small bounds product: the device rides the scalarize shadow; its
+    decided verdicts must match the oracle on arbitrary vector specs."""
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.utils.fuzz import RandomVectorSpec
+
+    assert JaxTPU(RandomVectorSpec(1))._shadow is not None  # 64 states
+    rep = fuzz_parity(n_specs=2, hists_per_spec=20, seed=3,
+                      backends=("memo", "device"),
+                      vector_bounds=(4, 4, 4))
+    assert rep.ok, rep.mismatches[:10]
+    assert rep.linearizable > 0 and rep.violations > 0
+
+
+def test_fuzz_vector_specs_sweep_path():
+    """Bounds product over the cap: no shadow — the vmapped step-sweep
+    kernel path is what gets fuzzed."""
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.utils.fuzz import RandomVectorSpec
+
+    bounds = (7, 7, 7, 7, 7, 7, 7)  # 7^7 = 823,543 > MAX_PACKED_STATES
+    assert JaxTPU(RandomVectorSpec(1, bounds=bounds))._shadow is None
+    rep = fuzz_parity(n_specs=2, hists_per_spec=16, seed=4, n_ops=8,
+                      backends=("memo", "device"), vector_bounds=bounds)
+    assert rep.ok, rep.mismatches[:10]
+    assert rep.linearizable > 0
+
+
 def test_fuzz_cli(capsys):
     from qsm_tpu.utils.cli import main
 
