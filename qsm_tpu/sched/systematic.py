@@ -149,3 +149,55 @@ def explore_program(
         schedules_run=schedules, distinct_histories=len(hists),
         exhausted=exhausted, violations=violations, undecided=undecided,
         seconds=round(time.perf_counter() - t0, 3), violating=violating)
+
+
+def shrink_explored(
+    sut_factory: Callable[[], object],
+    program,
+    spec,
+    backend: Optional[LineariseBackend] = None,
+    max_schedules: int = 2_000,
+    max_rounds: int = 50,
+    initial: Optional[ExploreResult] = None,
+):
+    """Minimize a program whose exploration found a violation.
+
+    QuickCheck-style greedy shrink, but the predicate is EXPLORATION:
+    a candidate program survives iff exhaustively exploring it (bounded
+    per candidate by ``max_schedules``) still finds a violating
+    interleaving.  The result is therefore stronger than the property
+    layer's shrink — the minimal program is violating under SOME
+    schedule, found by search rather than by replaying one seed's
+    schedule, so shrinking cannot lose the race by schedule drift.
+
+    Returns ``(program, ExploreResult, shrink_steps)`` for the smallest
+    still-violating program (the input's own result if nothing smaller
+    violates).  Pass the program's already-computed result as
+    ``initial`` to skip re-exploring it (exploration is deterministic,
+    so the caller's result is exactly what a fresh run would produce).
+    """
+    from ..core.generator import dedupe, shrink_candidates
+
+    best_prog = program
+    best_res = (initial if initial is not None
+                else explore_program(sut_factory, program, spec,
+                                     backend=backend,
+                                     max_schedules=max_schedules))
+    if best_res.violations == 0:
+        return best_prog, best_res, 0
+    steps = 0
+    for _ in range(max_rounds):
+        improved = False
+        for cand in dedupe(shrink_candidates(spec, best_prog), limit=256):
+            if len(cand) >= len(best_prog):
+                continue
+            res = explore_program(sut_factory, cand, spec, backend=backend,
+                                  max_schedules=max_schedules)
+            if res.violations > 0:
+                best_prog, best_res = cand, res
+                steps += 1
+                improved = True
+                break  # greedy: restart candidate stream from the smaller
+        if not improved:
+            return best_prog, best_res, steps
+    return best_prog, best_res, steps

@@ -356,7 +356,7 @@ def cmd_explore(args) -> int:
     """Bounded-exhaustive schedule exploration of one generated program
     (sched/systematic.py): every interleaving, one batched verdict."""
     from ..core.generator import generate_program
-    from ..sched.systematic import explore_program
+    from ..sched.systematic import explore_program, shrink_explored
 
     spec, _ = make(args.model, args.impl)
     # explore defaults SMALL (2 pids x 6 ops): enumeration is exponential
@@ -368,12 +368,18 @@ def cmd_explore(args) -> int:
     res = explore_program(
         lambda: make(args.model, args.impl)[1], prog, spec,
         backend=backend, max_schedules=args.max_schedules)
+    shrink_steps = 0
+    if res.violations and args.shrink:
+        prog, res, shrink_steps = shrink_explored(
+            lambda: make(args.model, args.impl)[1], prog, spec,
+            backend=backend, max_schedules=args.max_schedules,
+            initial=res)  # exploration is deterministic: reuse, don't redo
     out = {"model": args.model, "impl": args.impl, "ops": len(prog),
            "schedules_run": res.schedules_run,
            "distinct_histories": res.distinct_histories,
            "exhausted": res.exhausted, "violations": res.violations,
            "undecided": res.undecided, "verified": res.verified,
-           "seconds": res.seconds}
+           "shrink_steps": shrink_steps, "seconds": res.seconds}
     if res.violating is not None:
         # "explore:<comma-joined delivery choices>" — the exact schedule
         # script that produced this history (replayable via
@@ -387,7 +393,7 @@ def cmd_explore(args) -> int:
 
             cx = Counterexample(program=prog, history=res.violating,
                                 trial=0, trial_seed=res.violating.seed,
-                                shrink_steps=0)
+                                shrink_steps=shrink_steps)
             cfg = PropertyConfig(n_pids=args.pids, max_ops=args.ops)
             save_regression(args.save_regression, args.model, args.impl,
                             spec, cfg, cx)
@@ -455,6 +461,10 @@ def main(argv=None) -> int:
     p.add_argument("--ops", type=int, default=6)
     p.add_argument("--max-schedules", type=int, default=10_000)
     p.add_argument("--backend", default=None, choices=_BACKENDS)
+    p.add_argument("--shrink", action="store_true",
+                   help="minimize a violating program by re-exploring "
+                        "shrink candidates (violation by search, not by "
+                        "one schedule's replay)")
     p.add_argument("--save-regression", default=None,
                    help="persist the violating (program, schedule) as a "
                         "replayable regression file")

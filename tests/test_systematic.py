@@ -89,6 +89,29 @@ def test_explore_refuses_faults():
                         SET_SPEC, faults=FaultPlan(p_drop=0.5))
 
 
+def test_shrink_explored_minimizes_to_the_double_add():
+    """Start from a padded 4-op program; exploration shrink must strip
+    the noise down to the 2-op double-add core (or smaller-equivalent),
+    still violating under SOME schedule."""
+    from qsm_tpu.sched.systematic import shrink_explored
+
+    noisy = Program(ops=(ProgOp(0, ADD, 0), ProgOp(0, 2, 1),
+                         ProgOp(1, ADD, 0), ProgOp(1, 2, 1)), n_pids=2)
+    prog, res, steps = shrink_explored(
+        lambda: RacyCheckThenActSetSUT(SET_SPEC), noisy, SET_SPEC)
+    assert res.violations > 0
+    assert steps > 0 and len(prog) == 2, (len(prog), steps)
+    assert all(op.cmd == ADD and op.arg == 0 for op in prog.ops)
+
+
+def test_shrink_explored_passing_program_untouched():
+    from qsm_tpu.sched.systematic import shrink_explored
+
+    prog, res, steps = shrink_explored(
+        lambda: AtomicSetSUT(SET_SPEC), SET_PROG, SET_SPEC)
+    assert steps == 0 and res.violations == 0 and prog is SET_PROG
+
+
 def test_explore_regression_roundtrip(tmp_path, capsys):
     """explore --save-regression persists the violating schedule script;
     replay --regression re-runs it and reproduces the history bit for
