@@ -99,6 +99,7 @@ def explore_program(
     max_schedules: int = 10_000,
     max_steps: int = 100_000,
     faults: Optional[FaultPlan] = None,
+    check: bool = True,
 ) -> ExploreResult:
     """Enumerate every delivery schedule of ``program`` (up to
     ``max_schedules``), then decide all distinct histories in one batched
@@ -108,6 +109,10 @@ def explore_program(
     oracle via ``core.property._default_oracle``); a fresh SUT is built
     per schedule from ``sut_factory`` (state must not leak between
     runs — same contract as the property layer's executions).
+
+    ``check=False`` enumerates only (for coverage ground truth): every
+    history reports as undecided, so ``verified`` can never be claimed
+    from an unchecked run.
     """
     if faults is not None:
         raise ValueError(
@@ -132,6 +137,11 @@ def explore_program(
         prefix = _next_prefix(prefix, sched.choice_log)
 
     hists = list(histories.values())
+    if not check:
+        return ExploreResult(
+            schedules_run=schedules, distinct_histories=len(hists),
+            exhausted=exhausted, violations=0, undecided=len(hists),
+            seconds=round(time.perf_counter() - t0, 3))
     if backend is None:
         from ..core.property import _default_oracle
 

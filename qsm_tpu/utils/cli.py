@@ -328,11 +328,26 @@ def cmd_coverage(args) -> int:
     stats = schedule_coverage(
         lambda: make(args.model, args.impl)[1], prog,
         seeds=[f"{args.seed}:{i}" for i in range(args.runs)])
-    print(json.dumps({
+    out = {
         "model": args.model, "ops": len(prog), "runs": stats.seeds,
         "distinct_schedules": stats.distinct_schedules,
         "distinct_histories": stats.distinct_histories,
-        "schedule_diversity": round(stats.schedule_diversity, 3)}))
+        "schedule_diversity": round(stats.schedule_diversity, 3)}
+    if args.exact:
+        # ground truth from bounded-exhaustive enumeration: how much of
+        # the real interleaving space did sampling actually touch?
+        from ..sched.systematic import explore_program
+
+        res = explore_program(lambda: make(args.model, args.impl)[1],
+                              prog, spec, max_schedules=args.max_schedules,
+                              check=False)  # counts only: skip verdicts
+        out["exact"] = {"schedules": res.schedules_run,
+                        "distinct_histories": res.distinct_histories,
+                        "exhausted": res.exhausted}
+        if res.exhausted and res.distinct_histories:
+            out["sampled_history_coverage"] = round(
+                stats.distinct_histories / res.distinct_histories, 3)
+    print(json.dumps(out))
     return 0
 
 
@@ -489,6 +504,11 @@ def main(argv=None) -> int:
     p.add_argument("--pids", type=int, default=None)
     p.add_argument("--ops", type=int, default=None)
     p.add_argument("--runs", type=int, default=100)
+    p.add_argument("--exact", action="store_true",
+                   help="also enumerate the interleaving tree (bounded) "
+                        "and report what fraction of distinct histories "
+                        "the sampled runs reached")
+    p.add_argument("--max-schedules", type=int, default=10_000)
     p.set_defaults(fn=cmd_coverage)
 
     args = ap.parse_args(argv)

@@ -132,6 +132,19 @@ def test_explore_regression_roundtrip(tmp_path, capsys):
     assert "history reproduced bit-identically: True" in printed
 
 
+def test_coverage_exact_cli(capsys):
+    """--exact grounds sampled coverage against the enumerated tree."""
+    from qsm_tpu.utils.cli import main
+
+    rc = main(["coverage", "--model", "set", "--impl", "racy",
+               "--pids", "2", "--ops", "4", "--runs", "30", "--exact"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0
+    assert out["exact"]["exhausted"] is True
+    assert out["exact"]["distinct_histories"] >= out["distinct_histories"]
+    assert 0 < out["sampled_history_coverage"] <= 1.0
+
+
 def test_explore_cli(capsys):
     from qsm_tpu.utils.cli import main
 
