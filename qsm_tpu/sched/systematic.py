@@ -91,6 +91,28 @@ def _next_prefix(choices: List[int], factors: List[int]
     return None
 
 
+def _enumerate(sut_factory, program, max_schedules: int, max_steps: int
+               ) -> Tuple[List[History], int, bool]:
+    """Walk one program's delivery-choice tree depth-first: (distinct
+    histories, schedules run, whole tree fit under max_schedules)."""
+    histories: Dict[Tuple, History] = {}
+    prefix: Optional[List[int]] = []
+    schedules = 0
+    exhausted = True
+    while prefix is not None:
+        if schedules >= max_schedules:
+            exhausted = False
+            break
+        sched, rec = prepare_run(sut_factory(), program, seed=0,
+                                 max_steps=max_steps, choices=prefix)
+        sched.run()
+        schedules += 1
+        h = rec.history(seed=schedule_key(prefix))
+        histories.setdefault(h.fingerprint(), h)
+        prefix = _next_prefix(prefix, sched.choice_log)
+    return list(histories.values()), schedules, exhausted
+
+
 def explore_program(
     sut_factory: Callable[[], object],
     program,
@@ -120,23 +142,8 @@ def explore_program(
             "(fault decisions are seeded draws, which scripted replay "
             "bypasses); use prop_concurrent sampling for faulty runs")
     t0 = time.perf_counter()
-    histories: Dict[Tuple, History] = {}
-    prefix: Optional[List[int]] = []
-    schedules = 0
-    exhausted = True
-    while prefix is not None:
-        if schedules >= max_schedules:
-            exhausted = False
-            break
-        sched, rec = prepare_run(sut_factory(), program, seed=0,
-                                 max_steps=max_steps, choices=prefix)
-        sched.run()
-        schedules += 1
-        h = rec.history(seed=schedule_key(prefix))
-        histories.setdefault(h.fingerprint(), h)
-        prefix = _next_prefix(prefix, sched.choice_log)
-
-    hists = list(histories.values())
+    hists, schedules, exhausted = _enumerate(sut_factory, program,
+                                             max_schedules, max_steps)
     if not check:
         return ExploreResult(
             schedules_run=schedules, distinct_histories=len(hists),
@@ -159,6 +166,64 @@ def explore_program(
         schedules_run=schedules, distinct_histories=len(hists),
         exhausted=exhausted, violations=violations, undecided=undecided,
         seconds=round(time.perf_counter() - t0, 3), violating=violating)
+
+
+def explore_many(
+    sut_factory: Callable[[], object],
+    programs: Sequence,
+    spec,
+    backend: Optional[LineariseBackend] = None,
+    max_schedules: int = 10_000,
+    max_steps: int = 100_000,
+) -> List[ExploreResult]:
+    """Explore MANY programs, deciding the union of all their distinct
+    histories in ONE batched checker call — the vmap-shaped workload the
+    device kernel exists for (BASELINE.json:9: ≥1024 histories per
+    batch): N small interleaving trees enumerate host-side, the
+    exponential decisions all ride one dispatch.  Returns one
+    :class:`ExploreResult` per program.
+
+    Enumeration is identical to :func:`explore_program` per program
+    (same default ``max_schedules``); DECIDED verdicts are identical
+    too, but a budget-bounded device backend may defer differently —
+    its memo-cache size depends on the batch bucket (JaxTPU
+    ``MAX_SLOTS_FOR_BATCH``), so a history decided in a small
+    per-program batch can come back BUDGET_EXCEEDED in the larger
+    union batch (never the reverse direction of a wrong verdict; the
+    per-program ``undecided`` count reports it).
+    """
+    if backend is None:
+        from ..core.property import _default_oracle
+
+        backend = _default_oracle(spec)
+    t0 = time.perf_counter()
+    per_prog = []
+    flat: List[History] = []
+    for prog in programs:
+        hists, schedules, exhausted = _enumerate(sut_factory, prog,
+                                                 max_schedules, max_steps)
+        per_prog.append((slice(len(flat), len(flat) + len(hists)),
+                         schedules, exhausted))
+        flat.extend(hists)
+    verdicts = (backend.check_histories(spec, flat) if flat
+                else np.empty(0, np.int8))
+    dt = round(time.perf_counter() - t0, 3)
+    out = []
+    for sl, schedules, exhausted in per_prog:
+        v = verdicts[sl]
+        hs = flat[sl]
+        violating = None
+        for h, verdict in zip(hs, v):
+            if int(verdict) == int(Verdict.VIOLATION):
+                violating = h
+                break
+        out.append(ExploreResult(
+            schedules_run=schedules, distinct_histories=len(hs),
+            exhausted=exhausted,
+            violations=int((v == int(Verdict.VIOLATION)).sum()),
+            undecided=int((v == int(Verdict.BUDGET_EXCEEDED)).sum()),
+            seconds=dt, violating=violating))
+    return out
 
 
 def shrink_explored(

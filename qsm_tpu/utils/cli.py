@@ -371,15 +371,43 @@ def cmd_explore(args) -> int:
     """Bounded-exhaustive schedule exploration of one generated program
     (sched/systematic.py): every interleaving, one batched verdict."""
     from ..core.generator import generate_program
-    from ..sched.systematic import explore_program, shrink_explored
+    from ..sched.systematic import (explore_many, explore_program,
+                                    shrink_explored)
 
     spec, _ = make(args.model, args.impl)
+    backend = (_make_backend(args.backend, spec)
+               if args.backend else None)
+    if args.programs > 1:
+        # batched sweep: N trees enumerate host-side, ALL their histories
+        # decide in one backend batch (the device-shaped workload)
+        if args.shrink or args.save_regression:
+            raise SystemExit(
+                "--programs is a sweep; combine --shrink/--save-regression "
+                "with a single program (drop --programs)")
+        progs = [generate_program(spec, seed=args.seed + i,
+                                  n_pids=args.pids, max_ops=args.ops)
+                 for i in range(args.programs)]
+        results = explore_many(
+            lambda: make(args.model, args.impl)[1], progs, spec,
+            backend=backend, max_schedules=args.max_schedules)
+        total_vio = sum(r.violations for r in results)
+        for i, r in enumerate(results):
+            print(json.dumps({
+                "seed": args.seed + i, "ops": len(progs[i]),
+                "schedules_run": r.schedules_run,
+                "distinct_histories": r.distinct_histories,
+                "exhausted": r.exhausted, "violations": r.violations,
+                "undecided": r.undecided, "verified": r.verified}))
+        print(json.dumps({
+            "programs": len(results), "total_violations": total_vio,
+            "total_undecided": sum(r.undecided for r in results),
+            "all_verified": all(r.verified for r in results),
+            "seconds": results[0].seconds if results else 0.0}))
+        return 0 if total_vio == 0 else 1
     # explore defaults SMALL (2 pids x 6 ops): enumeration is exponential
     # in deliveries, so registry-default sizes are never implied here
     prog = generate_program(spec, seed=args.seed, n_pids=args.pids,
                             max_ops=args.ops)
-    backend = (_make_backend(args.backend, spec)
-               if args.backend else None)
     res = explore_program(
         lambda: make(args.model, args.impl)[1], prog, spec,
         backend=backend, max_schedules=args.max_schedules)
@@ -475,6 +503,9 @@ def main(argv=None) -> int:
     p.add_argument("--pids", type=int, default=2)
     p.add_argument("--ops", type=int, default=6)
     p.add_argument("--max-schedules", type=int, default=10_000)
+    p.add_argument("--programs", type=int, default=1,
+                   help="sweep N generated programs (seeds seed..seed+N-1)"
+                        "; all trees' histories decide in ONE batch")
     p.add_argument("--backend", default=None, choices=_BACKENDS)
     p.add_argument("--shrink", action="store_true",
                    help="minimize a violating program by re-exploring "
