@@ -91,6 +91,20 @@ def _next_prefix(choices: List[int], factors: List[int]
     return None
 
 
+def _summarize(hists: List[History], verdicts
+               ) -> Tuple[int, int, Optional[History]]:
+    """(violations, undecided, first violating history) — the ONE verdict
+    accounting site for explore_program and explore_many."""
+    violations = int((verdicts == int(Verdict.VIOLATION)).sum())
+    undecided = int((verdicts == int(Verdict.BUDGET_EXCEEDED)).sum())
+    violating = None
+    for h, v in zip(hists, verdicts):
+        if int(v) == int(Verdict.VIOLATION):
+            violating = h
+            break
+    return violations, undecided, violating
+
+
 def _enumerate(sut_factory, program, max_schedules: int, max_steps: int
                ) -> Tuple[List[History], int, bool]:
     """Walk one program's delivery-choice tree depth-first: (distinct
@@ -155,13 +169,7 @@ def explore_program(
         backend = _default_oracle(spec)
     verdicts = (backend.check_histories(spec, hists) if hists
                 else np.empty(0, np.int8))
-    violations = int((verdicts == int(Verdict.VIOLATION)).sum())
-    undecided = int((verdicts == int(Verdict.BUDGET_EXCEEDED)).sum())
-    violating = None
-    for h, v in zip(hists, verdicts):
-        if int(v) == int(Verdict.VIOLATION):
-            violating = h
-            break
+    violations, undecided, violating = _summarize(hists, verdicts)
     return ExploreResult(
         schedules_run=schedules, distinct_histories=len(hists),
         exhausted=exhausted, violations=violations, undecided=undecided,
@@ -196,33 +204,33 @@ def explore_many(
         from ..core.property import _default_oracle
 
         backend = _default_oracle(spec)
-    t0 = time.perf_counter()
     per_prog = []
     flat: List[History] = []
     for prog in programs:
+        t0 = time.perf_counter()
         hists, schedules, exhausted = _enumerate(sut_factory, prog,
                                                  max_schedules, max_steps)
         per_prog.append((slice(len(flat), len(flat) + len(hists)),
-                         schedules, exhausted))
+                         schedules, exhausted,
+                         time.perf_counter() - t0))
         flat.extend(hists)
+    t0 = time.perf_counter()
     verdicts = (backend.check_histories(spec, flat) if flat
                 else np.empty(0, np.int8))
-    dt = round(time.perf_counter() - t0, 3)
+    check_dt = time.perf_counter() - t0
     out = []
-    for sl, schedules, exhausted in per_prog:
-        v = verdicts[sl]
+    for sl, schedules, exhausted, enum_dt in per_prog:
         hs = flat[sl]
-        violating = None
-        for h, verdict in zip(hs, v):
-            if int(verdict) == int(Verdict.VIOLATION):
-                violating = h
-                break
+        violations, undecided, violating = _summarize(hs, verdicts[sl])
+        # per-program seconds like explore_program's: own enumeration
+        # time plus this program's share of the one batched check call
+        # (apportioned by history count — the batch cost driver)
+        share = check_dt * (len(hs) / len(flat)) if flat else 0.0
         out.append(ExploreResult(
             schedules_run=schedules, distinct_histories=len(hs),
-            exhausted=exhausted,
-            violations=int((v == int(Verdict.VIOLATION)).sum()),
-            undecided=int((v == int(Verdict.BUDGET_EXCEEDED)).sum()),
-            seconds=dt, violating=violating))
+            exhausted=exhausted, violations=violations,
+            undecided=undecided, seconds=round(enum_dt + share, 3),
+            violating=violating))
     return out
 
 

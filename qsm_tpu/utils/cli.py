@@ -374,16 +374,19 @@ def cmd_explore(args) -> int:
     from ..sched.systematic import (explore_many, explore_program,
                                     shrink_explored)
 
+    # usage errors before any backend construction: _make_backend can
+    # probe a device (seconds) or exit on its own account, which must
+    # never mask a cheap argument mistake
+    if args.programs > 1 and (args.shrink or args.save_regression):
+        raise SystemExit(
+            "--programs is a sweep; combine --shrink/--save-regression "
+            "with a single program (drop --programs)")
     spec, _ = make(args.model, args.impl)
     backend = (_make_backend(args.backend, spec)
                if args.backend else None)
     if args.programs > 1:
         # batched sweep: N trees enumerate host-side, ALL their histories
         # decide in one backend batch (the device-shaped workload)
-        if args.shrink or args.save_regression:
-            raise SystemExit(
-                "--programs is a sweep; combine --shrink/--save-regression "
-                "with a single program (drop --programs)")
         progs = [generate_program(spec, seed=args.seed + i,
                                   n_pids=args.pids, max_ops=args.ops)
                  for i in range(args.programs)]
@@ -392,17 +395,23 @@ def cmd_explore(args) -> int:
             backend=backend, max_schedules=args.max_schedules)
         total_vio = sum(r.violations for r in results)
         for i, r in enumerate(results):
-            print(json.dumps({
+            line = {
                 "seed": args.seed + i, "ops": len(progs[i]),
                 "schedules_run": r.schedules_run,
                 "distinct_histories": r.distinct_histories,
                 "exhausted": r.exhausted, "violations": r.violations,
-                "undecided": r.undecided, "verified": r.verified}))
+                "undecided": r.undecided, "verified": r.verified}
+            if r.violating is not None:
+                # the replayable schedule script, same as the
+                # single-program path (a sweep finding must not force a
+                # re-run to recover it)
+                line["violating_schedule"] = r.violating.seed
+            print(json.dumps(line))
         print(json.dumps({
             "programs": len(results), "total_violations": total_vio,
             "total_undecided": sum(r.undecided for r in results),
             "all_verified": all(r.verified for r in results),
-            "seconds": results[0].seconds if results else 0.0}))
+            "seconds": round(sum(r.seconds for r in results), 3)}))
         return 0 if total_vio == 0 else 1
     # explore defaults SMALL (2 pids x 6 ops): enumeration is exponential
     # in deliveries, so registry-default sizes are never implied here
