@@ -42,6 +42,19 @@ def test_device_gate_follows_probe(monkeypatch):
         cli_mod._ensure_device_reachable()
 
 
+def test_fuzz_device_backend_is_probe_gated():
+    """`fuzz --backends device` must refuse on a cpu-pinned process / a
+    wedged tunnel exactly like `--backend tpu` — constructing JaxTPU
+    bare would hang the first in-process jax.devices() forever
+    (regression: the pre-guard CLI wedged a soak run)."""
+    from qsm_tpu.utils.cli import main
+
+    # this test process IS cpu-pinned (conftest), which the gate refuses
+    with pytest.raises(SystemExit, match="pinned to the CPU platform"):
+        main(["fuzz", "--specs", "1", "--histories", "2",
+              "--backends", "device"])
+
+
 def test_every_backend_choice_constructs(healthy_probe):
     from qsm_tpu.native import CppOracle
     from qsm_tpu.ops.jax_kernel import JaxTPU
@@ -65,6 +78,8 @@ def test_every_backend_choice_constructs(healthy_probe):
         "segdc-tpu": (SegDC, QueueSpec),
         "rootsplit": (RootSplit, QueueSpec),
         "rootsplit-tpu": (RootSplit, QueueSpec),
+        # auto = fastest exact host checker (native here: toolchain baked)
+        "auto": (CppOracle, QueueSpec),
     }
     assert set(want) == set(_BACKENDS)
     for name, (ty, mk_spec) in want.items():

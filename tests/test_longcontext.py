@@ -50,6 +50,22 @@ def test_cas_128ops_native_parity():
     assert (want == int(Verdict.VIOLATION)).any()
 
 
+def test_cas_128ops_device_parity():
+    """The device kernel at the 128-op bucket (4 taken-mask words in the
+    packed precedence path) — decided verdicts must match the oracle."""
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+
+    spec = CasSpec()
+    corpus = build_corpus(spec, (AtomicCasSUT, RacyCasSUT), n=12,
+                          n_pids=8, max_ops=128, seed_base=2000,
+                          seed_prefix="long128")
+    want = WingGongCPU(memo=True).check_histories(spec, corpus)
+    got = JaxTPU(spec).check_histories(spec, corpus)
+    decided = got != int(Verdict.BUDGET_EXCEEDED)
+    np.testing.assert_array_equal(got[decided], np.asarray(want)[decided])
+    assert decided.sum() >= 0.7 * len(corpus)
+
+
 def test_queue_96ops_segdc_and_native_fallback_parity():
     from qsm_tpu.native import CppOracle
     from qsm_tpu.ops.segdc import SegDC
