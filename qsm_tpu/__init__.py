@@ -27,7 +27,7 @@ from .core.property import (Counterexample, PropertyConfig, PropertyResult,
 from .ops.backend import (LineariseBackend, Verdict, check_one,
                           verify_witness)
 from .ops.wing_gong_cpu import WingGongCPU
-from .sched.scheduler import FaultPlan, Recv, Scheduler, Send
+from .sched.scheduler import FaultPlan, Monitor, Recv, Scheduler, Send
 from .sched.runner import ConcurrentSUT, run_concurrent
 
 __version__ = "0.1.0"

@@ -1,0 +1,161 @@
+"""Primary/backup register failover — the monitors/links showcase.
+
+The reference builds on distributed-process, whose monitors/links are the
+failure-detection primitive (SURVEY.md §5); this model family exercises
+the framework's equivalent end to end: a ROUTER process `Monitor`s the
+primary replica and fails over to the backup when the deterministic crash
+schedule kills it (`FaultPlan.crash_at` — replayable from the seed like
+everything else).
+
+Two implementations against the plain ``RegisterSpec``:
+
+* ``SyncReplFailoverSUT`` — a write is acked to the client only after the
+  backup acknowledged its replication.  Every acknowledged write is on
+  the backup at failover, so histories stay linearizable through the
+  crash.  Expected to PASS.
+* ``AsyncReplFailoverSUT`` — the write is acked as soon as the primary
+  applied it; replication trails behind.  A crash in that window loses
+  an acknowledged write: the promoted backup serves the OLD value after
+  a newer one was acknowledged — the classic failover lost-update.
+  Expected to FAIL under a crash schedule.
+
+Correctness subtleties the sync design must (and does) handle — each one
+is a real distributed-systems failover bug the checker caught during
+development of this very module:
+
+* replication carries the primary's APPLY-ORDER sequence number, and a
+  replica ignores stale sequences — the delivery pool is not FIFO, so
+  two in-flight replications can arrive reordered;
+* a replica stops accepting replication the moment it serves its first
+  direct client operation (it is the leader now) — otherwise a stale
+  in-flight replication arriving after failover would overwrite a write
+  the new leader already acknowledged.
+
+Reference citation: SURVEY.md §5 failure-detection row (the mount at
+/root/reference is empty; monitors/links are distributed-process public
+API knowledge anchored there).
+"""
+
+from __future__ import annotations
+
+from ..sched.scheduler import Monitor, Recv, Scheduler, Send
+
+READ = 0
+WRITE = 1
+
+
+def _replica(store: dict, me: str):
+    """One register replica.
+
+    Protocol: ("read", tag) / ("write", tag, v) from the router —
+    responds ("resp", tag, value-or-0, seq); ("repl", v, seq, tag) —
+    applies iff newer and not yet leader, always acks ("repl-ack", tag).
+    """
+    seq = 0          # local apply order; stamps write responses
+    applied = 0      # highest replicated seq applied
+    leader = False   # set on first direct client op: replication ends
+    while True:
+        msg = yield Recv()
+        kind, *rest = msg.payload
+        if kind == "read":
+            leader = leader or me == "backup"
+            yield Send(msg.src, ("resp", rest[0], store[me], seq))
+        elif kind == "write":
+            leader = leader or me == "backup"
+            tag, value = rest
+            seq += 1
+            store[me] = value
+            yield Send(msg.src, ("resp", tag, 0, seq))
+        elif kind == "repl":
+            value, rseq, tag = rest
+            if leader:
+                # A leader acking a replication it IGNORED would let the
+                # router acknowledge a write that is not durable — the
+                # lost-acked-write bug.  Stay silent: the writer stays
+                # un-acked (a pending op the checker completes/prunes).
+                continue
+            if rseq > applied:
+                applied = rseq
+                store[me] = value
+            yield Send(msg.src, ("repl-ack", tag))
+
+
+def _router(sync: bool):
+    """Client-facing front: forwards ops to the current leader; fails
+    over to the backup on the primary's DOWN notification; owns the
+    replication step so the replicas stay one simple state machine."""
+    leader = "primary"
+    yield Monitor("primary")
+    pending = {}  # tag -> (client, kind, value)
+    tag = 0
+    while True:
+        msg = yield Recv()
+        kind, *rest = msg.payload
+        if kind == "DOWN":
+            leader = "backup"
+        elif kind == "read":
+            tag += 1
+            pending[tag] = (msg.src, "r", None)
+            yield Send(leader, ("read", tag))
+        elif kind == "write":
+            tag += 1
+            pending[tag] = (msg.src, "w", rest[0])
+            yield Send(leader, ("write", tag, rest[0]))
+        elif kind == "resp":
+            t, value, seq = rest[0], rest[1], rest[2]
+            if t not in pending:
+                continue  # duplicated response (fault): already served
+            client, op_kind, wvalue = pending[t]
+            if op_kind == "r":
+                del pending[t]
+                yield Send(client, value)
+            elif msg.src == "primary" and sync:
+                # replicate BEFORE acking: the ack waits on repl-ack
+                yield Send("backup", ("repl", wvalue, seq, t))
+            else:
+                # async mode acks here (the bug: replication trails the
+                # ack); post-failover single-replica writes ack here too
+                del pending[t]
+                yield Send(client, 0)
+                if msg.src == "primary":
+                    yield Send("backup", ("repl", wvalue, seq, t))
+        elif kind == "repl-ack":
+            t = rest[0]
+            if t in pending:  # sync write completing; async already acked
+                client, _, _ = pending.pop(t)
+                yield Send(client, 0)
+
+
+class _FailoverBase:
+    sync = True
+
+    def __init__(self, spec=None):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {"primary": 0, "backup": 0}
+        sched.spawn("primary", _replica(self.store, "primary"),
+                    daemon=True)
+        sched.spawn("backup", _replica(self.store, "backup"), daemon=True)
+        sched.spawn("router", _router(self.sync), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        yield Send("router", ("read",) if cmd == READ
+                   else ("write", arg))
+        msg = yield Recv()
+        return msg.payload
+
+
+class SyncReplFailoverSUT(_FailoverBase):
+    """Synchronous replication: acked writes survive failover.
+    Expected to PASS prop_concurrent under crash schedules."""
+
+    sync = True
+
+
+class AsyncReplFailoverSUT(_FailoverBase):
+    """Asynchronous replication: a crash between client-ack and
+    replication loses an acknowledged write.  Expected to FAIL under
+    crash schedules."""
+
+    sync = False

@@ -16,6 +16,7 @@ from .kv import AtomicKvSUT, KvSpec, StaleCacheKvSUT
 from .queue import AtomicQueueSUT, QueueSpec, RacyTwoPhaseQueueSUT
 from .register import (AtomicRegisterSUT, RacyCachedRegisterSUT,
                        RegisterSpec, ReplicatedRegisterSUT)
+from .failover import AsyncReplFailoverSUT, SyncReplFailoverSUT
 from .set import AtomicSetSUT, RacyCheckThenActSetSUT, SetSpec
 from .stack import AtomicStackSUT, RacyTwoPhaseStackSUT, StackSpec
 
@@ -65,6 +66,15 @@ MODELS: Dict[str, ModelEntry] = {
         make_spec=StackSpec,
         impls={"atomic": AtomicStackSUT, "racy": RacyTwoPhaseStackSUT},
         default_pids=8, default_ops=32),
+    # failover register: atomic = synchronous replication, racy = async
+    # (the lost-acked-write bug).  Discriminated under a CRASH schedule
+    # (e.g. --crash-at primary:6); without one both behave like a plain
+    # register
+    "failover": ModelEntry(
+        make_spec=RegisterSpec,
+        impls={"atomic": SyncReplFailoverSUT,
+               "racy": AsyncReplFailoverSUT},
+        default_pids=3, default_ops=10),
 }
 
 

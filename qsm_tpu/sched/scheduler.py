@@ -52,6 +52,21 @@ class Recv:
 
 
 @dataclasses.dataclass(frozen=True)
+class Monitor:
+    """Watch another process: when it terminates the watcher's mailbox
+    receives ``("DOWN", target, reason)`` with reason "crashed" or
+    "done" (the distributed-process monitor/link primitive — SURVEY.md §5
+    failure-detection row: "distributed-process has monitors/links").
+    Non-blocking; a monitor on an already-dead target fires immediately.
+    The notification rides the ordinary delivery pool (its arrival order
+    interleaves under the same seeded choice as every other message) but
+    is exempt from fault injection, like dist-process's local reliable
+    notifications."""
+
+    target: str
+
+
+@dataclasses.dataclass(frozen=True)
 class Message:
     src: str
     dst: str
@@ -172,6 +187,7 @@ class Scheduler:
         self.transport = transport
         self.owns_transport = False
         self.procs: Dict[str, _Proc] = {}
+        self.monitors: Dict[str, List[str]] = {}  # target -> watchers
         self.pool: List[_InFlight] = []  # in-flight messages
         self.clock = 0  # logical event clock (history timestamps)
         self.trace: List[int] = []  # delivered message uids, in order
@@ -191,6 +207,23 @@ class Scheduler:
             p.crashed = True
             p.done = True
             p.gen.close()
+            self._notify_down(name, "crashed")
+
+    def _pool_down(self, target: str, watcher: str, reason: str) -> None:
+        """ONE construction site for the DOWN notification: pooled like
+        any send (arrival order is the scheduler's seeded choice) but
+        pre-decided so the fault plan never drops/delays it —
+        dist-process's reliable local monitor semantics."""
+        self._uid += 1
+        self.pool.append(_InFlight(
+            Message(src=target, dst=watcher,
+                    payload=("DOWN", target, reason), uid=self._uid),
+            decided=True))
+
+    def _notify_down(self, target: str, reason: str) -> None:
+        """Enqueue DOWN notifications for every watcher of ``target``."""
+        for watcher in self.monitors.pop(target, []):
+            self._pool_down(target, watcher, reason)
 
     def tick(self) -> int:
         """Advance the logical clock (history event timestamps)."""
@@ -219,6 +252,7 @@ class Scheduler:
                 eff = p.gen.send(p.send_value)
             except StopIteration:
                 p.done = True
+                self._notify_down(p.name, "done")
                 return
             p.send_value = None
             if isinstance(eff, Send):
@@ -235,6 +269,18 @@ class Scheduler:
                     continue
                 p.blocked = True
                 return
+            if isinstance(eff, Monitor):
+                tgt = self.procs.get(eff.target)
+                if tgt is None or tgt.done:
+                    # already dead: fire immediately (dist-process
+                    # notifies monitors of dead/unknown pids at once)
+                    reason = ("crashed" if tgt and tgt.crashed
+                              else "done" if tgt else "noproc")
+                    self._pool_down(eff.target, p.name, reason)
+                else:
+                    self.monitors.setdefault(eff.target,
+                                             []).append(p.name)
+                continue  # non-blocking: watcher keeps running
             raise TypeError(f"process {p.name} yielded {eff!r}")
 
     def _deliver_one(self) -> None:
@@ -304,6 +350,7 @@ class Scheduler:
         self.clock = 0
         self.pool.clear()
         self.trace.clear()
+        self.monitors.clear()
         self.choice_log.clear()
         self._choice_pos = 0
         while True:

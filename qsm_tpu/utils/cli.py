@@ -168,13 +168,24 @@ def _add_fault_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--p-delay", type=float, default=0.0)
     p.add_argument("--delay-steps", type=int, default=3,
                    help="delivery choices a delayed message is held for")
+    p.add_argument("--crash-at", action="append", default=[],
+                   metavar="NAME:N",
+                   help="crash process NAME after N delivery choices "
+                        "(repeatable; e.g. --crash-at primary:6)")
 
 
 def _faults_from_args(args):
-    if not (args.p_drop or args.p_duplicate or args.p_delay):
+    crash_at = {}
+    for spec in args.crash_at:
+        name, _, n = spec.rpartition(":")
+        if not name or not n.isdigit():
+            raise SystemExit(f"--crash-at wants NAME:N, got {spec!r}")
+        crash_at[name] = int(n)
+    if not (args.p_drop or args.p_duplicate or args.p_delay or crash_at):
         return None
     return FaultPlan(p_drop=args.p_drop, p_duplicate=args.p_duplicate,
-                     p_delay=args.p_delay, delay_steps=args.delay_steps)
+                     p_delay=args.p_delay, delay_steps=args.delay_steps,
+                     crash_at=crash_at)
 
 
 def _add_run_args(p: argparse.ArgumentParser) -> None:
@@ -262,6 +273,9 @@ def cmd_run(args) -> int:
                        f" --p-duplicate {args.p_duplicate}"
                        f" --p-delay {args.p_delay}"
                        f" --delay-steps {args.delay_steps}")
+        # every fault knob must round-trip through the hint, or the
+        # pasted command replays a DIFFERENT fault plan and diverges
+        fault_flags += "".join(f" --crash-at {c}" for c in args.crash_at)
     print(f"replay: python -m qsm_tpu replay --model {args.model} "
           f"--impl {args.impl} --trial-seed '{cx.trial_seed}' "
           f"--pids {cfg.n_pids} --ops {cfg.max_ops} "

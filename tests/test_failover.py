@@ -1,0 +1,133 @@
+"""Monitors/links (SURVEY.md §5 failure detection) and the failover
+model family: DOWN notifications are delivered deterministically and
+fault-exempt; synchronous replication survives every crash schedule,
+asynchronous replication loses acknowledged writes and the checker
+catches it."""
+
+from qsm_tpu import (FaultPlan, Monitor, PropertyConfig, Recv, Scheduler,
+                     Send, Verdict, WingGongCPU, check_one, prop_concurrent)
+from qsm_tpu.models.failover import (AsyncReplFailoverSUT,
+                                     SyncReplFailoverSUT)
+from qsm_tpu.models.register import RegisterSpec
+
+SPEC = RegisterSpec()
+CRASH = FaultPlan(crash_at={"primary": 4})
+CFG = PropertyConfig(n_trials=120, n_pids=3, max_ops=10, seed=3,
+                     faults=CRASH)
+
+
+# ---------------------------------------------------------------------------
+# Monitor primitive (scheduler level)
+# ---------------------------------------------------------------------------
+
+def _watcher(log):
+    yield Monitor("worker")
+    msg = yield Recv()
+    log.append(msg.payload)
+
+
+def _idle_worker():
+    yield Recv()  # blocks forever (until crashed)
+
+
+def test_monitor_fires_on_crash():
+    sched = Scheduler(seed=1, faults=FaultPlan(crash_at={"worker": 0}))
+    log = []
+    sched.spawn("worker", _idle_worker(), daemon=True)
+    sched.spawn("watcher", _watcher(log))
+    sched.run()
+    assert log == [("DOWN", "worker", "crashed")]
+
+
+def test_monitor_fires_on_normal_completion():
+    def quick_worker():
+        return
+        yield  # pragma: no cover — makes this a generator
+
+    sched = Scheduler(seed=1)
+    log = []
+    sched.spawn("worker", quick_worker())
+    sched.spawn("watcher", _watcher(log))
+    sched.run()
+    assert log == [("DOWN", "worker", "done")]
+
+
+def test_monitor_on_dead_or_unknown_target_fires_immediately():
+    sched = Scheduler(seed=1)
+    log = []
+
+    def watch_ghost(log):
+        yield Monitor("ghost")
+        msg = yield Recv()
+        log.append(msg.payload)
+
+    sched.spawn("watcher", watch_ghost(log))
+    sched.run()
+    assert log == [("DOWN", "ghost", "noproc")]
+
+
+def test_down_notification_is_fault_exempt():
+    """Heavy drop faults must never eat a DOWN notification."""
+    sched = Scheduler(seed=7, faults=FaultPlan(
+        p_drop=1.0, crash_at={"worker": 0},
+        protected={"nobody"}))  # protect nothing relevant: drop ALL sends
+    log = []
+    sched.spawn("worker", _idle_worker(), daemon=True)
+    sched.spawn("watcher", _watcher(log))
+    sched.run()
+    assert log == [("DOWN", "worker", "crashed")]
+
+
+def test_monitor_determinism():
+    def run_once():
+        sched = Scheduler(seed=5, faults=FaultPlan(crash_at={"worker": 2}))
+        log = []
+        sched.spawn("worker", _idle_worker(), daemon=True)
+
+        def chatty(n):
+            for i in range(n):
+                yield Send("worker", i)
+
+        sched.spawn("noise", chatty(4))
+        sched.spawn("watcher", _watcher(log))
+        sched.run()
+        return tuple(log), tuple(sched.trace)
+
+    assert run_once() == run_once()
+
+
+# ---------------------------------------------------------------------------
+# The failover family (property level)
+# ---------------------------------------------------------------------------
+
+def test_sync_failover_survives_crash_schedules():
+    for k in (2, 4, 8):
+        faults = FaultPlan(crash_at={"primary": k})
+        cfg = PropertyConfig(n_trials=120, n_pids=3, max_ops=10, seed=3,
+                             faults=faults)
+        res = prop_concurrent(SPEC, SyncReplFailoverSUT(), cfg)
+        assert res.ok, (k, res.counterexample)
+
+
+def test_async_failover_loses_acked_writes():
+    res = prop_concurrent(SPEC, AsyncReplFailoverSUT(), CFG)
+    assert not res.ok, "the lost acked write was never caught"
+    cx = res.counterexample
+    assert check_one(WingGongCPU(), SPEC, cx.history) == Verdict.VIOLATION
+
+
+def test_failover_without_crash_behaves_like_plain_register():
+    cfg = PropertyConfig(n_trials=60, n_pids=3, max_ops=10, seed=1)
+    assert prop_concurrent(SPEC, SyncReplFailoverSUT(), cfg).ok
+    assert prop_concurrent(SPEC, AsyncReplFailoverSUT(), cfg).ok
+
+
+def test_failover_cli_crash_at(capsys):
+    from qsm_tpu.utils.cli import main
+
+    rc = main(["run", "--model", "failover", "--impl", "racy",
+               "--trials", "120", "--seed", "3",
+               "--crash-at", "primary:4"])
+    assert rc == 1  # violation found
+    out = capsys.readouterr().out
+    assert "FAIL: failover/racy" in out

@@ -64,6 +64,7 @@ def test_cas_128ops_device_parity():
     decided = got != int(Verdict.BUDGET_EXCEEDED)
     np.testing.assert_array_equal(got[decided], np.asarray(want)[decided])
     assert decided.sum() >= 0.7 * len(corpus)
+    assert (want == int(Verdict.VIOLATION)).any()  # not vacuous
 
 
 def test_queue_96ops_segdc_and_native_fallback_parity():

@@ -27,7 +27,7 @@ def test_registry_covers_all_five_configs():
     # the five milestone configs (BASELINE.json:7-11) + extra families
     assert {"register", "ticket", "cas", "queue", "kv"} <= set(MODELS)
     assert set(MODELS) == {"register", "ticket", "cas", "queue", "kv",
-                           "set", "stack"}
+                           "set", "stack", "failover"}
     for name, entry in MODELS.items():
         spec, sut = make(name, "racy")
         assert hasattr(sut, "perform")
