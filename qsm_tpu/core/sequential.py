@@ -114,8 +114,13 @@ def prop_sequential(spec: Spec, sut: SequentialSUT, n_trials: int = 100,
     """The reference's ``prop_sequential`` (SURVEY.md §3.4): generate →
     run sequentially with inline postcondition checks → shrink failures.
     Deterministic from ``seed``; no scheduler, no lineariser.  Seed keys
-    come from the SAME per-trial derivation as the concurrent property,
-    so one (seed, trial) names one program on both paths."""
+    come from the SAME per-trial derivation as the concurrent property —
+    but programs only coincide across the two paths when the op counts
+    do: the concurrent property RAMPS sizes over the trial sequence by
+    default (``PropertyConfig.ramp_sizes``) while this path uses
+    ``max_ops`` throughout, so cross-referencing a trial seed between
+    the two replays the same generator stream at possibly different
+    lengths."""
     # function-local: property.py sits above this module in the layer
     # order (it imports sched/ops); a module-level import would invert it
     from .property import trial_seed

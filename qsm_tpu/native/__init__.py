@@ -44,6 +44,21 @@ def native_available() -> bool:
     return get_lib() is not None
 
 
+def native_status() -> str:
+    """Availability WITHOUT triggering the (up to 2-minute) first-use
+    compile: "loaded", "built" (cached .so present, not yet dlopened),
+    "failed: ..." or "unbuilt".  Metadata commands (the ``list`` CLI)
+    use this; checkers that actually need the library call
+    :func:`native_available`/:func:`get_lib`, which do compile."""
+    if _lib is not None:
+        return "loaded"
+    if _lib_error is not None:
+        return f"failed: {_lib_error}"
+    if os.path.exists(_build_lib_path()):
+        return "built"
+    return "unbuilt"
+
+
 def native_error() -> Optional[str]:
     get_lib()
     return _lib_error
@@ -115,4 +130,5 @@ def get_lib():
 from .oracle import NATIVE_MAX_OPS, CppOracle  # noqa: E402  (needs get_lib)
 
 __all__ = ["CppOracle", "NATIVE_MAX_OPS", "get_lib", "native_available",
+           "native_status",
            "native_error"]

@@ -47,6 +47,11 @@ def _init_worker(sut_factory, transport_spec: str) -> None:
                            else make_transport(transport_spec))
 
 
+def _probe_ok() -> bool:
+    """Initialization probe: only returns once _init_worker succeeded."""
+    return "sut" in _STATE
+
+
 def _run_one(job) -> History:
     from .runner import run_concurrent
 
@@ -60,6 +65,14 @@ class PoolExecutor:
     """Executes (program, seed) jobs over a persistent process pool,
     preserving input order (and therefore every downstream decision)."""
 
+    # generous ceiling for ONE job: spawn warmup is ~4 s/worker on this
+    # image; an in-tree job is sub-millisecond.  Exists to turn a
+    # worker-init crash into an error — multiprocessing.Pool silently
+    # respawns crashing workers forever, so a sut_factory that fails in
+    # the fresh interpreter (unpicklable closure, missing import) would
+    # otherwise wedge run_many with no diagnostic at all.
+    PROBE_TIMEOUT_S = 60.0
+
     def __init__(self, sut_factory, n_workers: Optional[int] = None,
                  transport: str = "memory"):
         self.n_workers = n_workers or min(8, os.cpu_count() or 2)
@@ -67,11 +80,29 @@ class PoolExecutor:
         self._pool = ctx.Pool(self.n_workers, initializer=_init_worker,
                               initargs=(sut_factory, transport))
         self.jobs_run = 0
+        self._probed = False
+
+    def _probe(self) -> None:
+        """Fail fast if workers cannot initialize (see PROBE_TIMEOUT_S)."""
+        if self._probed:
+            return
+        try:
+            self._pool.apply_async(_probe_ok).get(self.PROBE_TIMEOUT_S)
+        except multiprocessing.TimeoutError:
+            self.close()
+            raise RuntimeError(
+                "worker pool failed to initialize within "
+                f"{self.PROBE_TIMEOUT_S:.0f}s — the sut_factory probably "
+                "crashes in a fresh interpreter (it must be picklable and "
+                "importable under the spawn start method; use "
+                "models.registry.SutFactory)") from None
+        self._probed = True
 
     def run_many(self, jobs: Sequence[Tuple], faults, max_steps: int
                  ) -> List[History]:
         """Execute jobs = [(program, seed), ...]; returns histories in job
         order, bit-identical to serial execution."""
+        self._probe()
         payload = [(p, s, faults, max_steps) for p, s in jobs]
         # one chunk per worker: each run_many is a barrier anyway (its
         # verdicts gate the next step), so finer chunks only add IPC

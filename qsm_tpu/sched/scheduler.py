@@ -54,10 +54,12 @@ class Recv:
 @dataclasses.dataclass(frozen=True)
 class Monitor:
     """Watch another process: when it terminates the watcher's mailbox
-    receives ``("DOWN", target, reason)`` with reason "crashed" or
-    "done" (the distributed-process monitor/link primitive — SURVEY.md §5
-    failure-detection row: "distributed-process has monitors/links").
-    Non-blocking; a monitor on an already-dead target fires immediately.
+    receives ``("DOWN", target, reason)`` with reason "crashed", "done",
+    or — for a name that was never spawned — "noproc" (the
+    distributed-process monitor/link primitive, including its
+    DiedUnknownId case — SURVEY.md §5 failure-detection row:
+    "distributed-process has monitors/links").  Non-blocking; a monitor
+    on an already-dead or unknown target fires immediately.
     The notification rides the ordinary delivery pool (its arrival order
     interleaves under the same seeded choice as every other message) but
     is exempt from fault injection, like dist-process's local reliable

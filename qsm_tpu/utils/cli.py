@@ -379,16 +379,20 @@ def cmd_coverage(args) -> int:
 
 def cmd_list(args) -> int:
     """Discoverability: every registry model (with sizes + impls) and
-    every backend choice, as one JSON object."""
-    from ..native import native_available
+    every backend choice, as one JSON object.  Uses the compile-free
+    native_status — a metadata command must never block on the
+    first-use g++ build."""
+    from ..native import native_status
 
+    status = native_status()
     print(json.dumps({
         "models": {
             name: {"pids": e.default_pids, "ops": e.default_ops,
                    "impls": sorted(e.impls)}
             for name, e in sorted(MODELS.items())},
         "backends": list(_BACKENDS),
-        "native_available": native_available(),
+        "native": status,
+        "native_available": status in ("loaded", "built"),
     }))
     return 0
 
@@ -492,11 +496,18 @@ def cmd_fuzz(args) -> int:
                       seed=args.seed, n_pids=args.pids, n_ops=args.ops,
                       p_pending=args.p_pending,
                       backends=tuple(args.backends.split(",")))
-    print(json.dumps({
+    out = {
         "specs": rep.specs, "histories": rep.histories,
         "linearizable": rep.linearizable, "violations": rep.violations,
         "budget_exceeded": rep.budget_exceeded,
-        "mismatches": rep.mismatches[:20], "ok": rep.ok}))
+        "mismatches": rep.mismatches[:20], "ok": rep.ok}
+    if "cpp" in args.backends.split(","):
+        out["cpp_native_histories"] = rep.cpp_native_histories
+        if rep.cpp_native_histories == 0:
+            # zero mismatches would prove nothing: every history fell
+            # back to the same Python oracle being compared against
+            out["cpp_vacuous"] = True
+    print(json.dumps(out))
     return 0 if rep.ok else 1
 
 

@@ -215,6 +215,10 @@ class FuzzReport:
     budget_exceeded: int
     mismatches: List[Tuple[int, int, str, int, int]]
     # (spec_seed, history_index, backend_name, oracle_verdict, got)
+    # histories the cpp lane decided NATIVELY (0 = the lane was vacuous:
+    # every history fell back to the same Python oracle being compared
+    # against, so "zero mismatches" proves nothing about the C++ code)
+    cpp_native_histories: int = 0
 
     @property
     def ok(self) -> bool:
@@ -246,6 +250,7 @@ def fuzz_parity(n_specs: int = 10, hists_per_spec: int = 32,
 
     oracle = WingGongCPU(memo=False)
     lin = vio = bud = 0
+    cpp_native = 0
     mismatches: List[Tuple[int, int, str, int, int]] = []
     for k in range(n_specs):
         spec_seed = seed * 1_000_003 + k
@@ -272,6 +277,8 @@ def fuzz_parity(n_specs: int = 10, hists_per_spec: int = 32,
             else:
                 raise ValueError(f"unknown fuzz backend {name!r}")
             got = backend.check_histories(spec, hists)
+            if name == "cpp":
+                cpp_native += backend.native_histories
             for i, (w, g) in enumerate(zip(want, got)):
                 undecided = int(Verdict.BUDGET_EXCEEDED)
                 if int(g) == undecided or int(w) == undecided:
@@ -281,4 +288,5 @@ def fuzz_parity(n_specs: int = 10, hists_per_spec: int = 32,
                     mismatches.append((spec_seed, i, name, int(w), int(g)))
     return FuzzReport(specs=n_specs, histories=n_specs * hists_per_spec,
                       linearizable=lin, violations=vio,
-                      budget_exceeded=bud, mismatches=mismatches)
+                      budget_exceeded=bud, mismatches=mismatches,
+                      cpp_native_histories=cpp_native)

@@ -35,11 +35,16 @@ def test_registry_covers_all_five_configs():
 
 
 def test_cli_list(capsys):
+    from qsm_tpu.native import native_available
+
+    assert native_available()  # ensure the .so exists (compiles once);
+    # `list` itself must NOT compile — it reports the compile-free status
     assert cli_main(["list"]) == 0
     out = json.loads(capsys.readouterr().out.strip())
     assert set(out["models"]) == set(MODELS)
     assert out["models"]["cas"]["impls"] == ["atomic", "racy"]
     assert "rootsplit-tpu" in out["backends"]
+    assert out["native"] in ("loaded", "built")
     assert out["native_available"] is True  # toolchain is baked in
 
 
