@@ -61,7 +61,6 @@ class RandomTableSpec(Spec):
         self._trans = rng.integers(
             0, n_states, size=(n_states, n_cmds, a, r), dtype=np.int32)
         self._ok = rng.random((n_states, n_cmds, a, r)) < ok_bias
-        self._jnp_tables = None
 
     def initial_state(self) -> np.ndarray:
         return np.zeros(1, np.int32)
@@ -90,10 +89,15 @@ class RandomTableSpec(Spec):
     def step_jax(self, state, cmd, arg, resp):
         import jax.numpy as jnp
 
-        if self._jnp_tables is None:
-            self._jnp_tables = (jnp.asarray(self._trans),
-                                jnp.asarray(self._ok))
-        trans, ok = self._jnp_tables
+        # NO caching of the jnp arrays: inside a jit trace jnp.asarray
+        # yields a TRACER (the constant is staged into that jaxpr);
+        # caching it on self and reusing it in a LATER trace (the
+        # kernel's next chunk-size compilation) is a leaked-tracer crash
+        # (regression: tests/test_fuzz.py::..._safe_across_retraces).
+        # Fresh asarray per call embeds the constant per-trace, like the
+        # in-tree specs do.
+        trans = jnp.asarray(self._trans)
+        ok = jnp.asarray(self._ok)
         s = state[0]
         return (jnp.stack([trans[s, cmd, arg, resp]]),
                 ok[s, cmd, arg, resp])
@@ -130,7 +134,6 @@ class RandomVectorSpec(Spec):
                                     dtype=np.int32)
                        for b in self.bounds]
         self._ok = rng.random((self.bounds[0], n_cmds, a, r)) < ok_bias
-        self._jnp_tables = None
 
     def initial_state(self) -> np.ndarray:
         return np.zeros(self.STATE_DIM, np.int32)
@@ -153,13 +156,12 @@ class RandomVectorSpec(Spec):
     def step_jax(self, state, cmd, arg, resp):
         import jax.numpy as jnp
 
-        if self._jnp_tables is None:
-            self._jnp_tables = ([jnp.asarray(t) for t in self._trans],
-                                jnp.asarray(self._ok))
-        trans, ok = self._jnp_tables
-        nxt = jnp.stack([t[state[i], cmd, arg, resp]
-                         for i, t in enumerate(trans)])
-        return nxt.astype(state.dtype), ok[state[0], cmd, arg, resp]
+        # fresh asarray per call — NEVER cache jnp arrays created under
+        # a trace (see RandomTableSpec.step_jax)
+        nxt = jnp.stack([jnp.asarray(t)[state[i], cmd, arg, resp]
+                         for i, t in enumerate(self._trans)])
+        ok = jnp.asarray(self._ok)[state[0], cmd, arg, resp]
+        return nxt.astype(state.dtype), ok
 
 
 def random_history(spec: Spec, rng: random.Random, n_pids: int,

@@ -99,6 +99,32 @@ def test_fuzz_vector_specs_sweep_path():
     assert rep.linearizable > 0
 
 
+def test_fuzz_spec_step_jax_safe_across_retraces():
+    """Regression: caching jnp tables on the spec leaked a tracer from
+    the first chunk compilation into the second (UnexpectedTracerError
+    the moment a fuzz batch needed chunk escalation).  Force multiple
+    chunk compiles and require clean decided-verdict parity."""
+    import random as _random
+
+    import numpy as np
+
+    from qsm_tpu import WingGongCPU
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.utils.fuzz import RandomTableSpec, random_history
+
+    spec = RandomTableSpec(seed=9)
+    rng = _random.Random("retrace")
+    hists = [random_history(spec, rng, 4, 10) for _ in range(16)]
+    b = JaxTPU(spec)
+    b.CHUNK_SCHEDULE = (4, 64, 4096)  # guarantee >= 2 chunk compiles
+    want = WingGongCPU().check_histories(spec, hists)
+    got = b.check_histories(spec, hists)  # crashed before the fix
+    decided = got != 2
+    np.testing.assert_array_equal(got[decided],
+                                  np.asarray(want)[decided])
+    assert b.rounds_run >= 2  # the escalation really happened
+
+
 def test_fuzz_cli(capsys):
     from qsm_tpu.utils.cli import main
 
