@@ -57,6 +57,31 @@ def test_pool_matches_serial_on_pass_and_with_faults():
     assert pooled.undecided == serial.undecided
 
 
+def test_pool_worker_init_failure_fails_fast():
+    """A sut_factory that crashes in the fresh worker interpreter must
+    surface as an error, not an infinite respawn hang (regression: the
+    pre-probe PoolExecutor wedged run_many forever)."""
+    from qsm_tpu.sched.pool import PoolExecutor
+
+    pool = PoolExecutor(_ExplodingFactory(), n_workers=1)
+    # the probe can only detect the crash by TIMING OUT (the probe task
+    # never runs when the initializer raises), so this whole duration is
+    # always spent — keep it tiny
+    pool.PROBE_TIMEOUT_S = 2.0
+    try:
+        with pytest.raises(RuntimeError, match="failed to initialize"):
+            pool.run_many([(None, "s")], faults=None, max_steps=10)
+    finally:
+        pool.close()
+
+
+class _ExplodingFactory:
+    """Picklable, but construction fails in the worker."""
+
+    def __call__(self):
+        raise RuntimeError("boom in worker")
+
+
 def test_pool_ignored_without_factory():
     spec, sut = make("register", "atomic")
     res = prop_concurrent(
