@@ -314,9 +314,25 @@ def cmd_replay(args) -> int:
                              max_ops=args.ops or entry.default_ops,
                              faults=faults)
         h = replay(spec, sut, args.trial_seed, cfg)
-    v = WingGongCPU().check_histories(spec, [h])[0]
+    if args.witness:
+        # ONE search: the witness run's own verdict is the verdict (a
+        # second check_histories would double the dominant cost)
+        verdict, w = WingGongCPU().check_witness(spec, h)
+        v = int(verdict)
+    else:
+        v = WingGongCPU().check_histories(spec, [h])[0]
     print(format_history(spec, h))
     print(f"verdict: {['VIOLATION', 'LINEARIZABLE', 'BUDGET_EXCEEDED'][v]}")
+    if args.witness and w is not None:
+        # the verdict's own proof: the linearization order, replayed
+        # search-free by verify_witness (ops/backend.py)
+        from ..ops.backend import verify_witness
+
+        steps = " -> ".join(
+            f"{spec.CMDS[h.ops[j].cmd].name}[op{j}]" for j, _ in w)
+        print(f"witness: {steps}")
+        print(f"witness verifies (search-free replay): "
+              f"{verify_witness(spec, h, w)}")
     return 0 if v == 1 else 1
 
 
@@ -524,6 +540,10 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("replay", help="reproduce a failure from seed or file")
     p.add_argument("--regression", default=None)
+    p.add_argument("--witness", action="store_true",
+                   help="on a LINEARIZABLE verdict, print the "
+                        "linearization order and its search-free "
+                        "verification")
     p.add_argument("--model", default=None, choices=sorted(MODELS))
     p.add_argument("--impl", default="racy")
     p.add_argument("--trial-seed", default=None)

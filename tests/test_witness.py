@@ -93,6 +93,16 @@ def test_tampered_witnesses_rejected():
     assert verify_witness(spec, h2, [(0, 0), (1, 3)])
 
 
+def test_replay_witness_cli(capsys):
+    from qsm_tpu.utils.cli import main
+
+    rc = main(["replay", "--model", "cas", "--impl", "atomic",
+               "--trial-seed", "2:3", "--witness"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "verdict: LINEARIZABLE" in out
+    assert "witness verifies (search-free replay): True" in out
+
+
 def test_vector_state_witness():
     spec = QueueSpec()
     prog = generate_program(spec, seed=2, n_pids=4, max_ops=14)
