@@ -122,6 +122,21 @@ def test_failover_without_crash_behaves_like_plain_register():
     assert prop_concurrent(SPEC, AsyncReplFailoverSUT(), cfg).ok
 
 
+def test_failover_over_tcp_bit_identical():
+    """Monitors + crash schedules + the loopback-TCP transport: DOWN
+    notifications ride the pool (never uplinked) yet deliver through the
+    transport's downlink — histories must stay bit-identical to the
+    in-memory transport."""
+    from qsm_tpu import generate_program, run_concurrent
+
+    prog = generate_program(SPEC, seed=5, n_pids=3, max_ops=8)
+    for impl in (SyncReplFailoverSUT, AsyncReplFailoverSUT):
+        mem = run_concurrent(impl(), prog, seed="t5", faults=CRASH)
+        tcp = run_concurrent(impl(), prog, seed="t5", faults=CRASH,
+                             transport="tcp")
+        assert mem.fingerprint() == tcp.fingerprint(), impl.__name__
+
+
 def test_failover_cli_crash_at(capsys):
     from qsm_tpu.utils.cli import main
 
