@@ -9,27 +9,30 @@ everything else).
 
 Two implementations against the plain ``RegisterSpec``:
 
-* ``SyncReplFailoverSUT`` — a write is acked to the client only after the
-  backup acknowledged its replication.  Every acknowledged write is on
-  the backup at failover, so histories stay linearizable through the
-  crash.  Expected to PASS.
-* ``AsyncReplFailoverSUT`` — the write is acked as soon as the primary
-  applied it; replication trails behind.  A crash in that window loses
-  an acknowledged write: the promoted backup serves the OLD value after
-  a newer one was acknowledged — the classic failover lost-update.
-  Expected to FAIL under a crash schedule.
+* ``SyncReplFailoverSUT`` — a write is acked only after the backup
+  acknowledged its replication, and reads serve only the COMMITTED
+  (replication-acked) value.  Expected to PASS under crash schedules.
+* ``AsyncReplFailoverSUT`` — writes ack immediately and reads serve the
+  primary's freshly-applied state.  A crash loses acknowledged writes
+  AND rolls back values reads already observed.  Expected to FAIL.
 
-Correctness subtleties the sync design must (and does) handle — each one
-is a real distributed-systems failover bug the checker caught during
-development of this very module:
+Every rule in the sync design exists because this framework's own
+checker caught its absence as a real linearizability violation during
+development — the tool debugging its author's distributed systems:
 
-* replication carries the primary's APPLY-ORDER sequence number, and a
-  replica ignores stale sequences — the delivery pool is not FIFO, so
-  two in-flight replications can arrive reordered;
-* a replica stops accepting replication the moment it serves its first
-  direct client operation (it is the leader now) — otherwise a stale
-  in-flight replication arriving after failover would overwrite a write
-  the new leader already acknowledged.
+* *acked writes must be durable*: ack only after the backup's repl-ack
+  (else: crash loses an acked write — the async impl's bug #1);
+* *reads must not observe unreplicated state*: the primary serves the
+  last COMMITTED value, not its latest applied one (else: a read
+  returns v, the primary crashes before v replicates, and a
+  post-failover read returns the older value — observations went back
+  in time; caught by the 400-trial burn-in as read(1) ... read(0));
+* *replication must be ordered*: sequence numbers assigned in the
+  primary's apply order, stale ones ignored (the delivery pool is not
+  FIFO);
+* *a promoted replica must refuse stale replication silently*: acking
+  a repl it ignored would let an un-durable write ack (lost-ack bug),
+  and applying it would overwrite post-failover writes.
 
 Reference citation: SURVEY.md §5 failure-detection row (the mount at
 /root/reference is empty; monitors/links are distributed-process public
@@ -44,49 +47,85 @@ READ = 0
 WRITE = 1
 
 
-def _replica(store: dict, me: str):
-    """One register replica.
+def _primary(sync: bool, backup: str = "backup"):
+    """The primary replica: applies writes, replicates to the backup.
 
-    Protocol: ("read", tag) / ("write", tag, v) from the router —
-    responds ("resp", tag, value-or-0, seq); ("repl", v, seq, tag) —
-    applies iff newer and not yet leader, always acks ("repl-ack", tag).
+    sync mode: stage the write, replicate, ack the router only on the
+    backup's repl-ack; reads serve the committed value.  async mode:
+    ack immediately, replicate behind, serve applied state (two bugs).
     """
-    seq = 0          # local apply order; stamps write responses
-    applied = 0      # highest replicated seq applied
-    leader = False   # set on first direct client op: replication ends
+    committed = 0       # last replication-acked value — what reads see
+    committed_seq = 0
+    applied = 0         # latest applied value incl. unreplicated staging
+    seq = 0             # apply-order sequence, stamped into replication
+    staged = {}         # seq -> (router_tag, value) awaiting repl-ack
     while True:
         msg = yield Recv()
         kind, *rest = msg.payload
         if kind == "read":
-            leader = leader or me == "backup"
-            yield Send(msg.src, ("resp", rest[0], store[me], seq))
+            value = committed if sync else applied
+            yield Send(msg.src, ("resp", rest[0], value))
         elif kind == "write":
-            leader = leader or me == "backup"
             tag, value = rest
             seq += 1
-            store[me] = value
-            yield Send(msg.src, ("resp", tag, 0, seq))
-        elif kind == "repl":
-            value, rseq, tag = rest
+            if sync:
+                staged[seq] = (tag, value)
+                yield Send(backup, ("repl", value, seq))
+            else:
+                # acked before durable (bug #1), and reads serve this
+                # un-replicated state (bug #2, via `applied` above)
+                applied = value
+                yield Send(msg.src, ("resp", tag, 0))
+                yield Send(backup, ("repl", value, seq))
+        elif kind == "repl-ack":
+            aseq = rest[0]
+            if aseq in staged:  # duplication faults may re-deliver acks
+                tag, value = staged.pop(aseq)
+                if aseq > committed_seq:
+                    committed_seq = aseq
+                    committed = value
+                yield Send("router", ("resp", tag, 0))
+
+
+def _backup():
+    """The backup: applies ordered replication until promoted (acks go
+    to ``msg.src``, so no wiring to the primary's name); serves clients
+    directly afterwards (its first direct op IS the promotion — the
+    router only routes here after the primary's DOWN)."""
+    value = 0
+    applied = 0     # highest replicated seq applied; continues as the
+    leader = False  # local write order after promotion
+    while True:
+        msg = yield Recv()
+        kind, *rest = msg.payload
+        if kind == "repl":
+            v, rseq = rest
             if leader:
-                # A leader acking a replication it IGNORED would let the
-                # router acknowledge a write that is not durable — the
-                # lost-acked-write bug.  Stay silent: the writer stays
-                # un-acked (a pending op the checker completes/prunes).
+                # silence, not an ack: acking an IGNORED replication
+                # would let an un-durable write ack (lost-ack bug), and
+                # applying it would overwrite post-failover writes
                 continue
-            if rseq > applied:
+            if rseq > applied:  # stale out-of-order replication ignored
                 applied = rseq
-                store[me] = value
-            yield Send(msg.src, ("repl-ack", tag))
+                value = v
+            yield Send(msg.src, ("repl-ack", rseq))
+        elif kind == "read":
+            leader = True
+            yield Send(msg.src, ("resp", rest[0], value))
+        elif kind == "write":
+            leader = True
+            tag, v = rest
+            applied += 1
+            value = v
+            yield Send(msg.src, ("resp", tag, 0))
 
 
-def _router(sync: bool):
-    """Client-facing front: forwards ops to the current leader; fails
-    over to the backup on the primary's DOWN notification; owns the
-    replication step so the replicas stay one simple state machine."""
+def _router():
+    """Client-facing front: forwards ops to the current leader, fails
+    over to the backup on the primary's DOWN notification."""
     leader = "primary"
     yield Monitor("primary")
-    pending = {}  # tag -> (client, kind, value)
+    pending = {}  # tag -> client
     tag = 0
     while True:
         msg = yield Recv()
@@ -95,35 +134,16 @@ def _router(sync: bool):
             leader = "backup"
         elif kind == "read":
             tag += 1
-            pending[tag] = (msg.src, "r", None)
+            pending[tag] = msg.src
             yield Send(leader, ("read", tag))
         elif kind == "write":
             tag += 1
-            pending[tag] = (msg.src, "w", rest[0])
+            pending[tag] = msg.src
             yield Send(leader, ("write", tag, rest[0]))
         elif kind == "resp":
-            t, value, seq = rest[0], rest[1], rest[2]
-            if t not in pending:
-                continue  # duplicated response (fault): already served
-            client, op_kind, wvalue = pending[t]
-            if op_kind == "r":
-                del pending[t]
-                yield Send(client, value)
-            elif msg.src == "primary" and sync:
-                # replicate BEFORE acking: the ack waits on repl-ack
-                yield Send("backup", ("repl", wvalue, seq, t))
-            else:
-                # async mode acks here (the bug: replication trails the
-                # ack); post-failover single-replica writes ack here too
-                del pending[t]
-                yield Send(client, 0)
-                if msg.src == "primary":
-                    yield Send("backup", ("repl", wvalue, seq, t))
-        elif kind == "repl-ack":
-            t = rest[0]
-            if t in pending:  # sync write completing; async already acked
-                client, _, _ = pending.pop(t)
-                yield Send(client, 0)
+            t, value = rest
+            if t in pending:  # duplication faults: already served
+                yield Send(pending.pop(t), value)
 
 
 class _FailoverBase:
@@ -133,11 +153,9 @@ class _FailoverBase:
         self.spec = spec
 
     def setup(self, sched: Scheduler) -> None:
-        self.store = {"primary": 0, "backup": 0}
-        sched.spawn("primary", _replica(self.store, "primary"),
-                    daemon=True)
-        sched.spawn("backup", _replica(self.store, "backup"), daemon=True)
-        sched.spawn("router", _router(self.sync), daemon=True)
+        sched.spawn("primary", _primary(self.sync), daemon=True)
+        sched.spawn("backup", _backup(), daemon=True)
+        sched.spawn("router", _router(), daemon=True)
 
     def perform(self, pid: int, cmd: int, arg: int):
         yield Send("router", ("read",) if cmd == READ
@@ -147,15 +165,14 @@ class _FailoverBase:
 
 
 class SyncReplFailoverSUT(_FailoverBase):
-    """Synchronous replication: acked writes survive failover.
-    Expected to PASS prop_concurrent under crash schedules."""
+    """Synchronous replication + committed reads: linearizable through
+    crashes.  Expected to PASS prop_concurrent under crash schedules."""
 
     sync = True
 
 
 class AsyncReplFailoverSUT(_FailoverBase):
-    """Asynchronous replication: a crash between client-ack and
-    replication loses an acknowledged write.  Expected to FAIL under
-    crash schedules."""
+    """Asynchronous replication + uncommitted reads: a crash loses acked
+    writes and rolls back observed values.  Expected to FAIL."""
 
     sync = False

@@ -109,6 +109,18 @@ def test_sync_failover_survives_crash_schedules():
         assert res.ok, (k, res.counterexample)
 
 
+def test_sync_failover_reads_do_not_go_back_in_time():
+    """Regression (caught by a 400-trial burn-in of the FIRST sync
+    design): a primary serving reads from unreplicated state lets a read
+    observe a value that failover rolls back — read(1) ... read(0).
+    The committed-reads design must survive the exact trial sequence
+    that exposed it (seed 9, crash at 4, trial 175)."""
+    cfg = PropertyConfig(n_trials=400, n_pids=3, max_ops=10, seed=9,
+                         faults=FaultPlan(crash_at={"primary": 4}))
+    res = prop_concurrent(SPEC, SyncReplFailoverSUT(), cfg)
+    assert res.ok, res.counterexample
+
+
 def test_async_failover_loses_acked_writes():
     res = prop_concurrent(SPEC, AsyncReplFailoverSUT(), CFG)
     assert not res.ok, "the lost acked write was never caught"
