@@ -93,6 +93,29 @@ def test_tampered_witnesses_rejected():
     assert verify_witness(spec, h2, [(0, 0), (1, 3)])
 
 
+def test_fuzz_spec_witnesses_verify():
+    """Witnesses on ARBITRARY random specs — including pending-op
+    completions, whose chosen responses the witness must carry — all
+    replay clean through verify_witness."""
+    import random
+
+    from qsm_tpu.utils.fuzz import RandomTableSpec, random_history
+
+    oracle = WingGongCPU(memo=True)
+    n_lin = n_pend = 0
+    for k in range(6):
+        spec = RandomTableSpec(seed=900 + k)
+        rng = random.Random(f"w{k}")
+        for _ in range(24):
+            h = random_history(spec, rng, 4, 10, p_pending=0.15)
+            v, w = oracle.check_witness(spec, h)
+            if v == Verdict.LINEARIZABLE:
+                assert verify_witness(spec, h, w), (k, w)
+                n_lin += 1
+                n_pend += h.n_pending > 0
+    assert n_lin > 10 and n_pend > 0, "witness fuzz sample vacuous"
+
+
 def test_replay_witness_cli(capsys):
     from qsm_tpu.utils.cli import main
 
