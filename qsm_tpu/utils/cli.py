@@ -393,6 +393,63 @@ def cmd_coverage(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """Decide an EXTERNAL trace: the checker as a standalone tool.
+
+    The trace file is JSON with a ``history`` array of
+    ``[pid, cmd, arg, resp, invoke_time, response_time]`` rows (the
+    regression-file encoding, so saved regressions check directly) and
+    optionally ``model``/``spec_kwargs``.  Any outside system that can
+    dump its operations in this shape gets the full backend stack —
+    linearizability checking of unmodified concurrent systems, the
+    trace-validation use the OmniLink paper frames (PAPERS.md), without
+    running the scheduler plane at all.
+    """
+    from ..ops.backend import Verdict, verify_witness
+    from .report import history_from_rows
+
+    with open(args.trace) as f:
+        doc = json.load(f)
+    model = args.model or doc.get("model")
+    if not model:
+        raise SystemExit("trace has no 'model'; pass --model")
+    if model not in MODELS:
+        raise SystemExit(
+            f"unknown model {model!r}; one of {sorted(MODELS)}")
+    spec, _ = make(model, "atomic", doc.get("spec_kwargs") or None)
+    # row order is PRESERVED: witness op indices refer to the caller's
+    # own rows (history_from_rows is the one shared decoder)
+    h = history_from_rows(doc["history"])
+    w = None
+    if args.witness:
+        # ONE search serves both verdict and witness (a second
+        # check_histories would double the dominant cost — same rule as
+        # replay --witness); the host oracle is the witness engine
+        v, w = WingGongCPU(memo=True).check_witness(spec, h)
+        v = int(v)
+    else:
+        backend = _make_backend(args.backend, spec)
+        v = int(backend.check_histories(spec, [h])[0])
+        if (v == int(Verdict.BUDGET_EXCEEDED)
+                and args.backend not in ("cpu", "cpp", "auto")):
+            # resolve a budget-bounded DEVICE deferral via the host
+            # oracle, like the property layer; a host backend that
+            # already exhausted the same oracle budget is left honest
+            v = int(WingGongCPU(memo=True).check_histories(spec, [h])[0])
+    # human rendering to stderr: stdout stays one machine-readable JSON
+    # line for the scripted/external callers this command exists for
+    print(format_history(spec, h), file=sys.stderr)
+    out = {"model": model, "ops": len(h),
+           "pending": h.n_pending,
+           "verdict": ["VIOLATION", "LINEARIZABLE",
+                       "BUDGET_EXCEEDED"][v]}
+    if w is not None:
+        out["witness"] = w
+        out["witness_verifies"] = verify_witness(spec, h, w)
+    print(json.dumps(out))
+    return 0 if v == int(Verdict.LINEARIZABLE) else 1
+
+
 def cmd_list(args) -> int:
     """Discoverability: every registry model (with sizes + impls) and
     every backend choice, as one JSON object.  Uses the compile-free
@@ -561,6 +618,20 @@ def main(argv=None) -> int:
     p.add_argument("--ops", type=int, default=None)
     p.add_argument("--corpus", type=int, default=256)
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "check", help="decide an external trace file (no scheduler)")
+    p.add_argument("--trace", required=True,
+                   help="JSON with a 'history' array of [pid, cmd, arg, "
+                        "resp, invoke_time, response_time] rows")
+    p.add_argument("--model", default=None, choices=sorted(MODELS),
+                   help="overrides the trace's own 'model' field")
+    p.add_argument("--backend", default="auto", choices=_BACKENDS)
+    p.add_argument("--witness", action="store_true",
+                   help="include the verified linearization order "
+                        "(one host-oracle search serves verdict AND "
+                        "witness; --backend is ignored)")
+    p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser("list", help="models, impls, and backend choices")
     p.set_defaults(fn=cmd_list)

@@ -138,6 +138,25 @@ def save_regression(path: str, model: str, impl: str, spec: Spec,
         json.dump(doc, f, indent=1)
 
 
+def history_from_rows(rows) -> History:
+    """The ONE decoder for the ``[pid, cmd, arg, resp, invoke_time,
+    response_time]`` history encoding (regression files, external trace
+    files — the `check` CLI).  Normalizes pending markers: a null/
+    negative resp or a response_time at/past the sentinel both mean
+    pending, canonicalized to ``resp=-1, response_time=PENDING_T``.
+    Row order is preserved (witness op indices refer to it)."""
+    from ..sched.runner import PENDING_T
+
+    ops = []
+    for pid, cmd, arg, resp, inv, ret in rows:
+        pending = resp is None or resp < 0 or ret >= PENDING_T
+        ops.append(Op(pid=pid, cmd=cmd, arg=arg,
+                      resp=-1 if pending else resp,
+                      invoke_time=inv,
+                      response_time=PENDING_T if pending else ret))
+    return History(ops)
+
+
 def load_regression(path: str):
     """(model, impl, trial_seed, program, history, faults, spec_kwargs)
     from a regression file; ``faults`` is the FaultPlan the failure was
@@ -149,9 +168,7 @@ def load_regression(path: str):
         doc = json.load(f)
     prog = Program(tuple(ProgOp(p, c, a) for p, c, a in doc["program"]["ops"]),
                    n_pids=doc["program"]["n_pids"])
-    hist = History([Op(pid=p, cmd=c, arg=a, resp=r, invoke_time=i,
-                       response_time=t)
-                    for p, c, a, r, i, t in doc["history"]])
+    hist = history_from_rows(doc["history"])
     faults = faults_from_doc(doc["config"].get("faults"))
     return (doc["model"], doc["impl"], doc["trial_seed"], prog, hist, faults,
             doc.get("spec_kwargs", {}))

@@ -1,0 +1,66 @@
+"""`qsm-tpu check`: the checker as a standalone tool over EXTERNAL
+traces (no scheduler involved) — the trace-validation use the OmniLink
+paper frames (PAPERS.md).  Saved regression files are valid traces by
+construction (same history encoding)."""
+
+import json
+
+from qsm_tpu.utils.cli import main
+
+
+def _write(tmp_path, doc):
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_check_linearizable_trace_with_witness(tmp_path, capsys):
+    # register: write(3) completes, then a read sees 3
+    path = _write(tmp_path, {
+        "model": "register",
+        "history": [[0, 1, 3, 0, 0, 1], [1, 0, 0, 3, 2, 3]]})
+    rc = main(["check", "--trace", path, "--witness"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["verdict"] == "LINEARIZABLE"
+    assert out["witness_verifies"] is True
+
+
+def test_check_violating_trace(tmp_path, capsys):
+    # stale read strictly after the write completed
+    path = _write(tmp_path, {
+        "model": "register",
+        "history": [[0, 1, 3, 0, 0, 1], [1, 0, 0, 0, 2, 3]]})
+    rc = main(["check", "--trace", path])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and out["verdict"] == "VIOLATION"
+
+
+def test_check_pending_ops_and_model_override(tmp_path, capsys):
+    # resp -1 == pending write; the read observing 1 forces completion
+    path = _write(tmp_path, {
+        "history": [[0, 1, 1, -1, 0, 1 << 30], [1, 0, 0, 1, 2, 3]]})
+    rc = main(["check", "--trace", path, "--model", "register"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["verdict"] == "LINEARIZABLE"
+    assert out["pending"] == 1
+
+
+def test_check_accepts_saved_regression_files(tmp_path, capsys):
+    # a regression file IS a trace: same history encoding + model field
+    rc = main(["run", "--model", "cas", "--impl", "racy", "--trials",
+               "80", "--seed", "5", "--save-regression",
+               str(tmp_path / "cx.json")])
+    assert rc == 1
+    capsys.readouterr()
+    rc = main(["check", "--trace", str(tmp_path / "cx.json")])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and out["verdict"] == "VIOLATION"
+    assert out["model"] == "cas"
+
+
+def test_check_requires_model(tmp_path):
+    import pytest
+
+    path = _write(tmp_path, {"history": [[0, 0, 0, 0, 0, 1]]})
+    with pytest.raises(SystemExit, match="no 'model'"):
+        main(["check", "--trace", path])
