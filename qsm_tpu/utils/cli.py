@@ -33,6 +33,9 @@ _BACKENDS = ("auto", "cpu", "cpp", "tpu", "pcomp", "pcomp-cpp",
              "pcomp-tpu", "segdc", "segdc-cpp", "segdc-tpu", "rootsplit",
              "rootsplit-tpu")
 
+# index == Verdict value (ops/backend.py); ONE site for the rendering
+_VERDICT_NAMES = ("VIOLATION", "LINEARIZABLE", "BUDGET_EXCEEDED")
+
 
 def _ensure_device_reachable(timeout_s: float = 45.0) -> None:
     """Fail fast (never hang) before initializing a device backend.
@@ -322,7 +325,7 @@ def cmd_replay(args) -> int:
     else:
         v = WingGongCPU().check_histories(spec, [h])[0]
     print(format_history(spec, h))
-    print(f"verdict: {['VIOLATION', 'LINEARIZABLE', 'BUDGET_EXCEEDED'][v]}")
+    print(f"verdict: {_VERDICT_NAMES[v]}")
     if args.witness and w is not None:
         # the verdict's own proof: the linearization order, replayed
         # search-free by verify_witness (ops/backend.py)
@@ -417,6 +420,36 @@ def cmd_check(args) -> int:
         raise SystemExit(
             f"unknown model {model!r}; one of {sorted(MODELS)}")
     spec, _ = make(model, "atomic", doc.get("spec_kwargs") or None)
+    if "histories" in doc:
+        # batch form: decide MANY external traces in ONE backend call —
+        # the vmap-shaped workload (BASELINE.json:9) offered to outside
+        # systems; one JSON line with per-trace verdicts.  Exit codes:
+        # 0 all linearizable, 1 some violation, 2 undecided only.
+        if args.witness:
+            raise SystemExit(
+                "--witness applies to a single 'history' trace; the "
+                "batch 'histories' form reports verdicts only")
+        hs = [history_from_rows(rows) for rows in doc["histories"]]
+        backend = _make_backend(args.backend, spec)
+        vs = [int(x) for x in backend.check_histories(spec, hs)]
+        und = [i for i, x in enumerate(vs)
+               if x == int(Verdict.BUDGET_EXCEEDED)]
+        if und and args.backend not in ("cpu", "cpp", "auto"):
+            oracle = WingGongCPU(memo=True)
+            res = oracle.check_histories(spec, [hs[i] for i in und])
+            for i, x in zip(und, res):
+                vs[i] = int(x)
+        n_vio = sum(x == int(Verdict.VIOLATION) for x in vs)
+        n_und = sum(x == int(Verdict.BUDGET_EXCEEDED) for x in vs)
+        print(json.dumps({
+            "model": model, "histories": len(hs),
+            "verdicts": [_VERDICT_NAMES[x] for x in vs],
+            "violations": n_vio, "undecided": n_und}))
+        return 1 if n_vio else (2 if n_und else 0)
+    if "history" not in doc:
+        raise SystemExit(
+            "trace needs a 'history' (or 'histories') array of "
+            "[pid, cmd, arg, resp, invoke_time, response_time] rows")
     # row order is PRESERVED: witness op indices refer to the caller's
     # own rows (history_from_rows is the one shared decoder)
     h = history_from_rows(doc["history"])
@@ -441,13 +474,14 @@ def cmd_check(args) -> int:
     print(format_history(spec, h), file=sys.stderr)
     out = {"model": model, "ops": len(h),
            "pending": h.n_pending,
-           "verdict": ["VIOLATION", "LINEARIZABLE",
-                       "BUDGET_EXCEEDED"][v]}
+           "verdict": _VERDICT_NAMES[v]}
     if w is not None:
         out["witness"] = w
         out["witness_verifies"] = verify_witness(spec, h, w)
     print(json.dumps(out))
-    return 0 if v == int(Verdict.LINEARIZABLE) else 1
+    if v == int(Verdict.LINEARIZABLE):
+        return 0
+    return 2 if v == int(Verdict.BUDGET_EXCEEDED) else 1
 
 
 def cmd_list(args) -> int:

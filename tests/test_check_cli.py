@@ -58,6 +58,24 @@ def test_check_accepts_saved_regression_files(tmp_path, capsys):
     assert out["model"] == "cas"
 
 
+def test_check_batch_of_traces(tmp_path, capsys):
+    """The plural 'histories' form: many external traces, one backend
+    batch, per-trace verdicts."""
+    path = _write(tmp_path, {
+        "model": "register",
+        "histories": [
+            [[0, 1, 3, 0, 0, 1], [1, 0, 0, 3, 2, 3]],   # ok
+            [[0, 1, 3, 0, 0, 1], [1, 0, 0, 0, 2, 3]],   # stale read
+            [[0, 0, 0, 0, 0, 1]],                       # lone read ok
+        ]})
+    rc = main(["check", "--trace", path])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1
+    assert out["verdicts"] == ["LINEARIZABLE", "VIOLATION",
+                               "LINEARIZABLE"]
+    assert out["violations"] == 1 and out["undecided"] == 0
+
+
 def test_check_requires_model(tmp_path):
     import pytest
 
