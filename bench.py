@@ -11,7 +11,13 @@ Measures the batched ``JaxTPU`` Wing–Gong kernel against two host checkers:
 
 Prints ONE JSON line:
     {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...,
-     "vs_best_cpu": ..., "extras": {...}}
+     "vs_best_cpu": ..., "vs_best_host": ..., "extras": {...}}
+
+The line is kept SMALL (≤ ~1.5 kB): the driver that records it tails only
+~2 kB of stdout, and round 3's sweep-bloated line lost its ``value`` field
+to that window (VERDICT.md round 3, "What's weak" #1).  Bulky data (the
+max-ops sweep) goes to a separate committed artifact whose filename is
+referenced from ``extras.sweep_file``.
 
 Robustness contract (VERDICT.md round 1, "Next round" #1): this script must
 never hang and never die with a raw traceback.  The real chip is probed from
@@ -70,7 +76,7 @@ def _probe_attempts_summary() -> dict | None:
         "device_ok": sum(1 for r in recs if r.get("is_device")),
         "first_iso": recs[0].get("iso"),
         "last_iso": recs[-1].get("iso"),
-        "last_detail": recs[-1].get("detail"),
+        "last_detail": (recs[-1].get("detail") or "")[:120],
     }
 
 
@@ -133,6 +139,7 @@ def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
     from qsm_tpu.models import AtomicCasSUT, CasSpec, QueueSpec, RacyCasSUT
     from qsm_tpu.models.queue import AtomicQueueSUT, RacyTwoPhaseQueueSUT
     from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.ops.router import AutoDevice
     from qsm_tpu.ops.segdc import SegDC
     from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
     from qsm_tpu.utils.corpus import build_corpus as shared
@@ -173,10 +180,11 @@ def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
         b = make_backend(spec)
         # one big chunk: sweep cells sit in the smallest batch bucket, so
         # the escalating schedule would only multiply compiles (a real
-        # concern inside a short TPU healing window); for combinators
-        # (SegDC) the JaxTPU lives at .inner — patching the wrapper would
-        # be a silent no-op
-        getattr(b, "inner", b).CHUNK_SCHEDULE = (65536,)
+        # concern inside a short TPU healing window); for combinators the
+        # JaxTPU lives at .inner (SegDC) or .plain (AutoDevice) —
+        # patching the wrapper would be a silent no-op
+        kern = getattr(b, "plain", None) or getattr(b, "inner", b)
+        kern.CHUNK_SCHEDULE = (65536,)
         t0 = time.perf_counter()
         b.check_histories(spec, corpus)
         first = time.perf_counter() - t0
@@ -206,6 +214,7 @@ def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
             "memo": lambda s: WingGongCPU(memo=True),
             "cpp": lambda s: CppOracle(s),
             "device": lambda s: JaxTPU(s),
+            "auto_device": lambda s: AutoDevice(s),
         }),
         "queue": (QueueSpec, (AtomicQueueSUT, RacyTwoPhaseQueueSUT), {
             "oracle": lambda s: WingGongCPU(node_budget=5_000_000),
@@ -214,6 +223,7 @@ def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
             "device": lambda s: JaxTPU(s, **q_kw),
             "segdc_device": lambda s: SegDC(
                 s, make_inner=lambda x: JaxTPU(x, **q_kw)),
+            "auto_device": lambda s: AutoDevice(s, **q_kw),
         }),
     }
     if not native_available():
@@ -244,7 +254,8 @@ def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
                                           max_ops=ops, seed_base=1000,
                                           seed_prefix="sweep")
                 corpus = corpora[ops]
-                is_device = bname in ("device", "segdc_device")
+                is_device = bname in ("device", "segdc_device",
+                                      "auto_device")
                 cell = (device_cell if is_device else host_cell)(
                     mk if is_device else mk(spec), spec, corpus)
                 cells[cname][bname][str(ops)] = cell
@@ -266,8 +277,11 @@ def build_corpus(spec, n_unique: int):
                   seed_prefix="bench")
 
 
+SWEEP_FILE = "BENCH_SWEEP_r04.json"
+
+
 def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
-              sweep: bool = True):
+              sweep: bool = True, sweep_file: str | None = None):
     from qsm_tpu.models import CasSpec
     from qsm_tpu.ops.jax_kernel import JaxTPU
     from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
@@ -374,22 +388,56 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
     mismatches = len(wrong(cpu_verdicts, dev_verdicts)
                      | wrong(memo_verdicts, dev_verdicts))
 
+    import jax
+
+    # The full sweep is bulky; it lives in its own committed artifact so
+    # the headline line stays under the driver's stdout-tail window.  Only
+    # the small solved-summary and the artifact's filename ride the line.
     sweep_extras = {}
     if sweep:
         try:
             sw = run_sweep(on_tpu)
-            sweep_extras = {"max_ops_solved_60s": sw["solved"],
-                            "max_ops_sweep": sw}
+            sweep_extras = {"max_ops_solved_60s": sw["solved"]}
+            path = sweep_file or os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), SWEEP_FILE)
+            sw["device"] = str(jax.devices()[0])
+            sw["device_fallback"] = None if on_tpu else "cpu"
+            sw["captured_iso"] = datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds")
+            # a real-device sweep banked earlier in the round must never
+            # be clobbered by a later CPU-fallback run
+            keep_existing = False
+            if not on_tpu:
+                try:
+                    with open(path) as f:
+                        keep_existing = (json.load(f).get("device_fallback")
+                                         is None)
+                except (OSError, ValueError):
+                    pass
+            if not keep_existing:
+                with open(path, "w") as f:
+                    json.dump(sw, f, indent=1)
+            sweep_extras["sweep_file"] = os.path.basename(path)
+            if keep_existing:
+                # the referenced artifact is an EARLIER real-device run;
+                # this line's solved summary is from the current
+                # CPU-fallback sweep — mark the provenance split
+                sweep_extras["sweep_file_is_earlier_device_run"] = True
         except Exception as e:  # noqa: BLE001 — the headline must survive
             sweep_extras = {"sweep_error": f"{type(e).__name__}: {e}"}
 
-    import jax
     return {
         "metric": f"histories_per_sec_linearized_{N_OPS}ops_x_{N_PIDS}pids",
         "value": round(dev_rate, 1),
         "unit": "histories/sec",
         "vs_baseline": round(dev_rate / cpu_rate, 2),
         "vs_best_cpu": round(dev_rate / memo_rate, 2),
+        # the honest bar: the device against the builder's BEST host
+        # checker, which since round 3 is the native C++ oracle when it is
+        # available (VERDICT.md round 3, "Next round" #2).  vs_best_cpu
+        # stays pinned to the memoised Python oracle for cross-round
+        # comparability.
+        "vs_best_host": round(dev_rate / max(memo_rate, cpp_rate or 0.0), 2),
         "extras": {
             "cpu_oracle_rate": round(cpu_rate, 3),
             "cpu_oracle_median_s": round(float(np.median(cpu_times)), 4),
@@ -400,7 +448,7 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
             "corpus_unique": len(corpus),
             "device": str(jax.devices()[0]),
             "device_fallback": None if on_tpu else "cpu",
-            "tpu_probe": probe_detail,
+            "tpu_probe": probe_detail[:160],
             "device_batch": sc["device_batch"],
             "device_budget": sc["budget"],
             # the measured configuration, for cross-round comparability
@@ -438,6 +486,9 @@ def main(argv=None) -> int:
                     help="seconds between probe retries")
     ap.add_argument("--no-sweep", action="store_true",
                     help="skip the max-ops-solved-60s sweep")
+    ap.add_argument("--sweep-file", default=None, metavar="PATH",
+                    help=f"where the sweep artifact is written "
+                         f"(default: {SWEEP_FILE} next to this script)")
     args = ap.parse_args(argv)
 
     from qsm_tpu.utils.device import force_cpu_platform, probe_default_backend
@@ -473,13 +524,14 @@ def main(argv=None) -> int:
             ex["window_captured_iso"] = window.pop("captured_iso", None)
             ex["tpu_probe_at_bench_time"] = probe_detail
             ex["probe_attempts"] = _probe_attempts_summary()
-            print(json.dumps(window))
+            print(_slim_line(window))
             return 0
         force_cpu_platform()
 
     try:
         result = run_bench(on_tpu, probe_detail, args.profile,
-                           sweep=not args.no_sweep)
+                           sweep=not args.no_sweep,
+                           sweep_file=args.sweep_file)
     except Exception as e:  # noqa: BLE001 — diagnostic JSON, never a bare crash
         print(json.dumps({
             "metric": f"histories_per_sec_linearized_{N_OPS}ops_x_{N_PIDS}"
@@ -492,8 +544,32 @@ def main(argv=None) -> int:
         }))
         return 1
     result["extras"]["probe_attempts"] = _probe_attempts_summary()
-    print(json.dumps(result))
+    print(_slim_line(result))
     return 0
+
+
+# ~2 kB is the driver's observed stdout-tail window; stay clearly inside
+# it so `value`/`vs_best_cpu`/`vs_best_host` always survive capture.
+MAX_LINE = 1800
+
+
+def _slim_line(result: dict) -> str:
+    """One JSON line ≤ MAX_LINE chars.  Drops droppable extras in fixed
+    priority order until it fits — the metric fields themselves are never
+    touched; anything dropped is still in the committed sweep artifact or
+    the probe log."""
+    line = json.dumps(result)
+    droppable = ("max_ops_solved_60s", "probe_attempts", "tpu_probe",
+                 "chunk_schedule", "lockstep_iters_r2_ladder")
+    ex = result.get("extras", {})
+    for key in droppable:
+        if len(line) <= MAX_LINE:
+            break
+        if key in ex:
+            del ex[key]
+            ex["dropped_for_size"] = ex.get("dropped_for_size", []) + [key]
+            line = json.dumps(result)
+    return line
 
 
 if __name__ == "__main__":

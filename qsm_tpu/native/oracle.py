@@ -46,6 +46,10 @@ def _blockers2(prec: np.ndarray) -> np.ndarray:
     iff op ``64*w + b`` strictly precedes op j.  Fully vectorized — the
     per-edge Python loop was 80% of the whole native check wall-clock."""
     n = prec.shape[0]
+    # 2 words cover exactly NATIVE_MAX_OPS predecessors; a cap/routing
+    # drift past that would silently drop precedence bits (admitting
+    # illegal linearizations) — fail loudly instead.
+    assert n <= _MAX_OPS, f"_blockers2: n={n} exceeds {_MAX_OPS}-op mask"
     out = np.zeros((n, 2), np.uint64)
     idx = np.arange(n)
     word = idx >> 6                              # word of predecessor i

@@ -1,0 +1,136 @@
+"""Per-history strategy routing — the ``auto-tpu`` backend.
+
+Round 3 measured that no fixed device strategy is right for every history
+(VERDICT.md round 3, "What's weak" #3): quiescent-cut segmentation
+(``SegDC``) is the best path for histories that shatter into many small
+segments (the device then works in a small op bucket and the host
+enumeration per middle segment is trivial), but it is up to 14× WORSE than
+the plain kernel on concurrency-dense histories whose largest segment is
+nearly the whole history — the host middle-segment enumeration explodes
+while the plain kernel would have decided the history in one batched
+dispatch.
+
+The router reads each history's cheap structural features from
+``split_at_quiescent_cuts`` (O(n log n), the same split SegDC itself
+performs) and partitions the batch.  The cost driver for SegDC's host
+middle-segment enumeration is segment **width** (maximum number of
+mutually-overlapping ops — the branching factor of the end-state walk),
+not segment length: round-4 measurement showed 2-pid corpora with 80-op
+middle segments decide 2-4.6× FASTER via segdc (narrow segments walk in
+near-linear time and the device does almost nothing), while 8-pid
+corpora with equally long but WIDE middles decide up to 14× slower
+(round-3 sweep).  So:
+
+* **plain** (``JaxTPU``): histories with no cuts, or any middle segment
+  wider than ``WIDTH_CAP`` concurrent ops (host enumeration risk).
+  Scalarization remains the kernel's own auto decision
+  (ops/scalarize.py).
+* **segdc** (``SegDC`` over the SAME inner kernel instance — one compile
+  cache): cut histories whose middle segments are all narrow; the host
+  walks them near-linearly and the device decides only the (short)
+  final segments from the threaded frontier.
+
+Specs that declare a per-key projection (``projected_spec`` +
+``partition_key``) are decomposed FIRST via ``PComp``, with a nested
+router on the projected spec — per-key sub-histories are sparser, so they
+cut more often and the segdc path gets more use exactly where it helps.
+
+Verdict parity: both strategies are exact (BUDGET_EXCEEDED, never a
+guess), so routing changes cost only — pinned by tests/test_router.py
+against the oracle on mixed corpora.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.history import History
+from ..core.spec import Spec
+from .backend import LineariseBackend
+from .segdc import SegDC, split_at_quiescent_cuts
+
+
+def _width(ops) -> int:
+    """Maximum number of mutually-overlapping ops in a segment (sweep
+    line over invoke/response endpoints)."""
+    events = []
+    for o in ops:
+        events.append((o.invoke_time, 1))
+        events.append((o.response_time, -1))
+    # responses sort before same-time invokes: a response at t does not
+    # overlap an invoke at t (matches precedes_matrix's strict <)
+    events.sort(key=lambda e: (e[0], e[1]))
+    width = peak = 0
+    for _, d in events:
+        width += d
+        peak = max(peak, width)
+    return peak
+
+
+class AutoDevice:
+    """Backend combinator: route each history to the cheapest device
+    strategy by segment structure (module docstring has the rule)."""
+
+    # Widest middle segment (max mutually-overlapping ops) the host is
+    # willing to enumerate: the end-state walk's branching is exponential
+    # in width, near-linear in length (module docstring has the round-3/4
+    # measurements behind the cap; re-tune on-chip when a window opens).
+    WIDTH_CAP = 4
+
+    def __init__(self, spec: Spec,
+                 make_inner: Optional[Callable] = None,
+                 **inner_kw):
+        from .jax_kernel import JaxTPU
+
+        self.spec = spec
+        make = make_inner or (lambda s: JaxTPU(s, **inner_kw))
+        self.pcomp = None
+        if hasattr(spec, "projected_spec"):
+            # per-key decomposition first; each projected sub-history is
+            # routed by a nested AutoDevice bound to the projected spec
+            from .pcomp import PComp
+
+            self.pcomp = PComp(
+                spec, make_inner=lambda s: AutoDevice(s, make_inner=make))
+            self.name = f"auto({self.pcomp.name})"
+            return
+        self.plain: LineariseBackend = make(spec)
+        # the SAME kernel instance serves as SegDC's inner backend: one
+        # compile/bucket cache across both routes
+        self.segdc = SegDC(spec, make_inner=lambda s: self.plain)
+        self.name = f"auto({self.plain.name})"
+        self.routed_plain = 0
+        self.routed_segdc = 0
+
+    def _route_segdc(self, h: History) -> bool:
+        segs = split_at_quiescent_cuts(h)
+        if len(segs) < 2:
+            return False
+        # host middle-segment enumeration risk is exponential in WIDTH
+        return all(_width(seg) <= self.WIDTH_CAP for seg in segs[:-1])
+
+    def check_histories(self, spec: Spec, histories: Sequence[History]
+                        ) -> np.ndarray:
+        assert spec is self.spec, "AutoDevice is bound to one spec"
+        if self.pcomp is not None:
+            return self.pcomp.check_histories(spec, histories)
+        out = np.empty(len(histories), np.int8)
+        seg_idx: List[int] = []
+        plain_idx: List[int] = []
+        for i, h in enumerate(histories):
+            (seg_idx if self._route_segdc(h) else plain_idx).append(i)
+        self.routed_plain += len(plain_idx)
+        self.routed_segdc += len(seg_idx)
+        if plain_idx:
+            sub = self.plain.check_histories(
+                spec, [histories[i] for i in plain_idx])
+            for i, v in zip(plain_idx, sub):
+                out[i] = v
+        if seg_idx:
+            sub = self.segdc.check_histories(
+                spec, [histories[i] for i in seg_idx])
+            for i, v in zip(seg_idx, sub):
+                out[i] = v
+        return out

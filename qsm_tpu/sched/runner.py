@@ -123,6 +123,7 @@ def run_concurrent(
     max_steps: int = 100_000,
     transport=None,
     choices=None,  # scripted delivery choices (sched/systematic.py)
+    sched_info: Optional[dict] = None,  # out-param: run diagnostics
 ) -> History:
     """Execute ``program`` concurrently; return its history.
 
@@ -139,4 +140,8 @@ def run_concurrent(
     finally:
         if sched.transport is not None and sched.owns_transport:
             sched.transport.close()
+        if sched_info is not None:
+            # choice_clamped: a scripted choice exceeded the live branching
+            # factor — the replayed script no longer matches the tree
+            sched_info["choice_clamped"] = sched.choice_clamped
     return rec.history(seed=seed)

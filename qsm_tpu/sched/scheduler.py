@@ -136,6 +136,12 @@ class DeadlockError(RuntimeError):
     pass
 
 
+class PruneRun(Exception):
+    """Raised out of :meth:`Scheduler.run` when a ``prune_hook`` recognizes
+    the current quiescent state as already explored (sched/systematic.py
+    state-fingerprint pruning).  Control flow, not an error."""
+
+
 @dataclasses.dataclass
 class _InFlight:
     """A pooled message plus its fault bookkeeping.
@@ -182,6 +188,16 @@ class Scheduler:
         self.choices = choices
         self.choice_log: List[int] = []
         self._choice_pos = 0
+        # A scripted choice >= the live branching factor means the script
+        # was recorded against a different tree (model/faults drifted
+        # since capture); the pick is clamped so the run still completes,
+        # but the drift must be reportable (utils/cli.py cmd_replay).
+        self.choice_clamped = False
+        # Called at every quiescent delivery point (before the branching
+        # factor is logged); returning True aborts the run via PruneRun.
+        # Systematic exploration uses it to skip subtrees whose scheduler
+        # state was already explored under an earlier schedule.
+        self.prune_hook: Optional[Callable[["Scheduler"], bool]] = None
         # transport carries the bytes; the scheduler keeps every ordering
         # decision (sched/transport.py — None = in-memory, zero overhead).
         # owns_transport: set by prepare_run when the transport was created
@@ -287,6 +303,8 @@ class Scheduler:
 
     def _deliver_one(self) -> None:
         """Quiescence point: seeded choice of the next in-flight message."""
+        if self.prune_hook is not None and self.prune_hook(self):
+            raise PruneRun
         # Deliveries count against max_steps too: duplication faults can
         # otherwise spin the pool forever with no process ever runnable.
         self._bump_steps()
@@ -302,6 +320,8 @@ class Scheduler:
             k = (self.choices[self._choice_pos]
                  if self._choice_pos < len(self.choices) else 0)
             self._choice_pos += 1
+            if k >= len(eligible):
+                self.choice_clamped = True
             pick = eligible[min(k, len(eligible) - 1)]
         else:
             pick = eligible[self.rng.randrange(len(eligible))]
@@ -355,6 +375,7 @@ class Scheduler:
         self.monitors.clear()
         self.choice_log.clear()
         self._choice_pos = 0
+        self.choice_clamped = False
         while True:
             runnable = self._runnable()
             if runnable:

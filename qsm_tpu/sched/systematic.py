@@ -33,10 +33,12 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import types
+
 from ..core.history import History
 from ..ops.backend import LineariseBackend, Verdict
-from .runner import prepare_run
-from .scheduler import FaultPlan
+from .runner import HistoryRecorder, prepare_run
+from .scheduler import FaultPlan, Message, PruneRun, Scheduler
 
 
 @dataclasses.dataclass
@@ -105,11 +107,115 @@ def _summarize(hists: List[History], verdicts
     return violations, undecided, violating
 
 
-def _enumerate(sut_factory, program, max_schedules: int, max_steps: int
-               ) -> Tuple[List[History], int, bool]:
+# ---------------------------------------------------------------------------
+# State-fingerprint pruning (VERDICT.md round 3, "Next round" #7)
+#
+# The scheduler is deterministic, so identical quiescent state ⇒ identical
+# subtree of reachable histories.  Different delivery orders of independent
+# messages routinely CONVERGE to the same state (round 3 measured ~300×
+# redundancy: 10,000 schedules → 35 distinct histories on set/racy 3×5), so
+# the enumeration fingerprints the full scheduler state at every delivery
+# point and prunes any node whose state was first reached under an earlier
+# (lexicographically smaller) schedule.  DFS lex order guarantees the first
+# encounter's subtree is fully enumerated before any second encounter, so
+# pruning drops only duplicate work — it cannot lose a history (the
+# cross-checked guarantee: tests/test_systematic.py compares pruned vs
+# unpruned history sets on every model family).
+#
+# "Full state" means everything behavior- or history-relevant: events
+# recorded so far, the in-flight pool (as a multiset — children are
+# explored exhaustively either way, so pool ORDER does not matter), and
+# per-process mailboxes, liveness flags, and generator continuations
+# (bytecode position + locals, recursing through yield-from and object
+# state by VALUE so two runs' distinct-but-equal SUT instances match).
+# Anything unfingerprintable makes the hook answer "don't prune" — an
+# exotic SUT degrades to the exact unpruned enumeration, never to an
+# unsound skip.  The one documented soundness boundary: SUT state must be
+# reachable from the SUT object or its process generators (true for
+# in-tree models; module-level globals would be invisible).
+# ---------------------------------------------------------------------------
+
+class _Unfingerprintable(Exception):
+    pass
+
+
+_FP_MAX_DEPTH = 24
+
+
+def _fp_val(v, depth: int = 0):
+    """Hashable VALUE fingerprint of state reachable from process frames.
+    Raises _Unfingerprintable rather than guessing: a truncated or lossy
+    fingerprint could equate distinct states, which would be an unsound
+    prune."""
+    if depth > _FP_MAX_DEPTH:
+        raise _Unfingerprintable("nesting too deep")
+    if v is None or isinstance(v, (int, str, bool, float, bytes)):
+        return v
+    if isinstance(v, Message):
+        # uid is a global send counter: behaviorally inert (trace/debug
+        # only), and including it would defeat every match
+        return ("M", v.src, v.dst, _fp_val(v.payload, depth + 1))
+    if isinstance(v, dict):
+        return ("D",) + tuple(sorted(
+            ((_fp_val(k, depth + 1), _fp_val(x, depth + 1))
+             for k, x in v.items()), key=repr))
+    if isinstance(v, (list, tuple)) or type(v).__name__ == "deque":
+        return ("L",) + tuple(_fp_val(x, depth + 1) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return ("S",) + tuple(sorted((_fp_val(x, depth + 1) for x in v),
+                                     key=repr))
+    if isinstance(v, (HistoryRecorder, Scheduler)):
+        # the recorder's events and the scheduler's pool/procs are
+        # fingerprinted at the top level; recursing here would cycle
+        return type(v).__name__
+    if isinstance(v, types.GeneratorType):
+        return _fp_gen(v, depth + 1)
+    d = getattr(v, "__dict__", None)
+    if d is not None:  # SUT instances: compare by value, not identity
+        return ("O", type(v).__name__, _fp_val(d, depth + 1))
+    raise _Unfingerprintable(f"opaque value {type(v).__name__}")
+
+
+def _fp_gen(g, depth: int = 0):
+    """Continuation fingerprint: code identity + bytecode position +
+    locals, following the yield-from chain (clients delegate to
+    ``sut.perform``)."""
+    fr = g.gi_frame
+    if fr is None:
+        return ("G", g.gi_code.co_name, "done")
+    sub = g.gi_yieldfrom
+    return ("G", g.gi_code.co_name, fr.f_lasti,
+            _fp_val(fr.f_locals, depth + 1),
+            _fp_gen(sub, depth + 1)
+            if isinstance(sub, types.GeneratorType) else None)
+
+
+def _state_fingerprint(sched: Scheduler, rec: HistoryRecorder):
+    """Full quiescent-state identity (see block comment above)."""
+    events = tuple((r.pid, r.cmd, r.arg, r.resp, r.invoke_time,
+                    r.response_time) for r in rec.recs)
+    pool = tuple(sorted(
+        (_fp_val((f.msg.src, f.msg.dst, f.msg.payload)) for f in sched.pool),
+        key=repr))
+    procs = tuple(
+        (name, p.done, p.blocked, p.crashed,
+         tuple(_fp_val((m.src, m.payload)) for m in p.mailbox),
+         _fp_val(p.send_value),
+         "done" if p.done else _fp_gen(p.gen))
+        for name, p in sorted(sched.procs.items()))
+    monitors = tuple(sorted((t, tuple(ws))
+                            for t, ws in sched.monitors.items()))
+    return (events, pool, procs, monitors)
+
+
+def _enumerate(sut_factory, program, max_schedules: int, max_steps: int,
+               prune: bool = True) -> Tuple[List[History], int, bool]:
     """Walk one program's delivery-choice tree depth-first: (distinct
-    histories, schedules run, whole tree fit under max_schedules)."""
+    histories, schedules run, whole tree fit under max_schedules).
+    ``prune`` enables state-fingerprint subtree skipping (see above);
+    pruned partial runs still count as schedules run."""
     histories: Dict[Tuple, History] = {}
+    seen: Dict[tuple, tuple] = {}  # state fp -> first-visit choice path
     prefix: Optional[List[int]] = []
     schedules = 0
     exhausted = True
@@ -119,10 +225,32 @@ def _enumerate(sut_factory, program, max_schedules: int, max_steps: int
             break
         sched, rec = prepare_run(sut_factory(), program, seed=0,
                                  max_steps=max_steps, choices=prefix)
-        sched.run()
+        if prune:
+            script = prefix
+
+            def hook(s, _script=script, _rec=rec):
+                log = s.choice_log
+                try:
+                    fp = _state_fingerprint(s, _rec)
+                except _Unfingerprintable:
+                    return False  # can't identify ⇒ never skip
+                # the EFFECTIVE path taken so far (scripted choices are
+                # clamped to the live branching factor, 0 past the script)
+                path = tuple(
+                    min(_script[j] if j < len(_script) else 0, log[j] - 1)
+                    for j in range(len(log)))
+                return seen.setdefault(fp, path) != path
+
+            sched.prune_hook = hook
+        try:
+            sched.run()
+            pruned = False
+        except PruneRun:
+            pruned = True
         schedules += 1
-        h = rec.history(seed=schedule_key(prefix))
-        histories.setdefault(h.fingerprint(), h)
+        if not pruned:
+            h = rec.history(seed=schedule_key(prefix))
+            histories.setdefault(h.fingerprint(), h)
         prefix = _next_prefix(prefix, sched.choice_log)
     return list(histories.values()), schedules, exhausted
 
@@ -136,6 +264,7 @@ def explore_program(
     max_steps: int = 100_000,
     faults: Optional[FaultPlan] = None,
     check: bool = True,
+    prune: bool = True,
 ) -> ExploreResult:
     """Enumerate every delivery schedule of ``program`` (up to
     ``max_schedules``), then decide all distinct histories in one batched
@@ -157,7 +286,8 @@ def explore_program(
             "bypasses); use prop_concurrent sampling for faulty runs")
     t0 = time.perf_counter()
     hists, schedules, exhausted = _enumerate(sut_factory, program,
-                                             max_schedules, max_steps)
+                                             max_schedules, max_steps,
+                                             prune=prune)
     if not check:
         return ExploreResult(
             schedules_run=schedules, distinct_histories=len(hists),
@@ -183,6 +313,7 @@ def explore_many(
     backend: Optional[LineariseBackend] = None,
     max_schedules: int = 10_000,
     max_steps: int = 100_000,
+    prune: bool = True,
 ) -> List[ExploreResult]:
     """Explore MANY programs, deciding the union of all their distinct
     histories in ONE batched checker call — the vmap-shaped workload the
@@ -209,7 +340,8 @@ def explore_many(
     for prog in programs:
         t0 = time.perf_counter()
         hists, schedules, exhausted = _enumerate(sut_factory, prog,
-                                                 max_schedules, max_steps)
+                                                 max_schedules, max_steps,
+                                                 prune=prune)
         per_prog.append((slice(len(flat), len(flat) + len(hists)),
                          schedules, exhausted,
                          time.perf_counter() - t0))

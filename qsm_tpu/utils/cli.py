@@ -29,7 +29,7 @@ from .stats import schedule_coverage
 # else the memoised oracle) — the default for `run`, where a user just
 # wants verdicts (kv-64 under the raw memo oracle costs ~17s per 60
 # trials; the native path ~1s, identical verdicts)
-_BACKENDS = ("auto", "cpu", "cpp", "tpu", "pcomp", "pcomp-cpp",
+_BACKENDS = ("auto", "auto-tpu", "cpu", "cpp", "tpu", "pcomp", "pcomp-cpp",
              "pcomp-tpu", "segdc", "segdc-cpp", "segdc-tpu", "rootsplit",
              "rootsplit-tpu")
 
@@ -132,6 +132,14 @@ def _make_backend_inner(name: str, spec):
         from ..ops.jax_kernel import JaxTPU
 
         return JaxTPU(spec)
+    if name == "auto-tpu":
+        # per-history routing across the device strategies: pcomp for
+        # partitionable specs, segdc for shattered histories, the plain
+        # kernel otherwise (ops/router.py)
+        _ensure_device_reachable()
+        from ..ops.router import AutoDevice
+
+        return AutoDevice(spec)
     if name == "pcomp":
         from ..ops.pcomp import PComp
 
@@ -300,10 +308,17 @@ def cmd_replay(args) -> int:
         # not by seeded randomness (schedule_key stamps it into the seed)
         from ..sched.systematic import parse_schedule_key
 
+        info: dict = {}
         h = run_concurrent(sut, prog, seed=seed_key, faults=faults,
-                           choices=parse_schedule_key(seed_key))
+                           choices=parse_schedule_key(seed_key),
+                           sched_info=info)
         same = h.fingerprint() == hist.fingerprint()
         print(f"history reproduced bit-identically: {same}")
+        if info.get("choice_clamped"):
+            print("WARNING: schedule script no longer matches the "
+                  "interleaving tree (a scripted choice exceeded the live "
+                  "branching factor and was clamped) — the model or fault "
+                  "plan has drifted since this regression was captured")
     else:
         if not (args.model and args.trial_seed):
             raise SystemExit(
@@ -529,7 +544,8 @@ def cmd_explore(args) -> int:
                  for i in range(args.programs)]
         results = explore_many(
             lambda: make(args.model, args.impl)[1], progs, spec,
-            backend=backend, max_schedules=args.max_schedules)
+            backend=backend, max_schedules=args.max_schedules,
+            prune=not args.no_prune)
         total_vio = sum(r.violations for r in results)
         for i, r in enumerate(results):
             line = {
@@ -556,7 +572,8 @@ def cmd_explore(args) -> int:
                             max_ops=args.ops)
     res = explore_program(
         lambda: make(args.model, args.impl)[1], prog, spec,
-        backend=backend, max_schedules=args.max_schedules)
+        backend=backend, max_schedules=args.max_schedules,
+        prune=not args.no_prune)
     shrink_steps = 0
     if res.violations and args.shrink:
         prog, res, shrink_steps = shrink_explored(
@@ -690,6 +707,11 @@ def main(argv=None) -> int:
     p.add_argument("--save-regression", default=None,
                    help="persist the violating (program, schedule) as a "
                         "replayable regression file")
+    p.add_argument("--no-prune", action="store_true",
+                   help="disable state-fingerprint subtree pruning (the "
+                        "pruned walk visits the same distinct histories "
+                        "in far fewer schedules; this flag forces the "
+                        "raw lexicographic enumeration)")
     p.set_defaults(fn=cmd_explore)
 
     p = sub.add_parser(

@@ -149,7 +149,7 @@ def history_from_rows(rows) -> History:
 
     ops = []
     for pid, cmd, arg, resp, inv, ret in rows:
-        pending = resp is None or resp < 0 or ret >= PENDING_T
+        pending = resp is None or resp < 0 or ret is None or ret >= PENDING_T
         ops.append(Op(pid=pid, cmd=cmd, arg=arg,
                       resp=-1 if pending else resp,
                       invoke_time=inv,

@@ -1,0 +1,61 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md).
+
+One test per finding:
+
+* ``history_from_rows`` — a row with a non-negative resp but a null
+  response_time (plausible crashed-op dump) is a *pending* op, not a
+  TypeError surfacing as a raw traceback from the check CLI;
+* ``_blockers2`` — the 2-word mask builder fails loudly past the native
+  128-op cap instead of silently dropping precedence bits;
+* scripted-choice clamping — replaying a stale exploration script whose
+  choices exceed the live branching factor flags the drift instead of
+  silently running a different schedule.
+"""
+
+import numpy as np
+import pytest
+
+from qsm_tpu import Program, run_concurrent
+from qsm_tpu.core.generator import ProgOp
+from qsm_tpu.models.register import WRITE, AtomicRegisterSUT
+from qsm_tpu.sched.runner import PENDING_T
+from qsm_tpu.utils.report import history_from_rows
+
+
+def test_history_from_rows_null_response_time_is_pending():
+    # resp recorded (>=0) but response_time null: crashed mid-response.
+    h = history_from_rows([
+        [0, 0, 0, 2, 0, 3],
+        [1, 1, 4, 3, 1, None],
+    ])
+    assert h.ops[0].resp == 2 and h.ops[0].response_time == 3
+    assert h.ops[1].resp == -1
+    assert h.ops[1].response_time == PENDING_T
+
+
+def test_blockers2_rejects_over_cap():
+    from qsm_tpu.native.oracle import NATIVE_MAX_OPS, _blockers2
+
+    ok = np.zeros((NATIVE_MAX_OPS, NATIVE_MAX_OPS), bool)
+    _blockers2(ok)  # at the cap: fine
+    too_big = np.zeros((NATIVE_MAX_OPS + 1, NATIVE_MAX_OPS + 1), bool)
+    with pytest.raises(AssertionError, match="exceeds"):
+        _blockers2(too_big)
+
+
+def test_stale_schedule_script_reports_clamp():
+    prog = Program((ProgOp(0, WRITE, 1), ProgOp(1, WRITE, 2)), n_pids=2)
+    # A branching factor this small never reaches 99: every scripted
+    # choice is clamped — exactly what a drifted regression script does.
+    info: dict = {}
+    run_concurrent(AtomicRegisterSUT(), prog, seed="s",
+                   choices=[99, 99, 99], sched_info=info)
+    assert info["choice_clamped"] is True
+
+
+def test_in_range_schedule_script_not_flagged():
+    prog = Program((ProgOp(0, WRITE, 1), ProgOp(1, WRITE, 2)), n_pids=2)
+    info: dict = {}
+    run_concurrent(AtomicRegisterSUT(), prog, seed="s",
+                   choices=[0] * 64, sched_info=info)
+    assert info["choice_clamped"] is False

@@ -191,3 +191,77 @@ def test_explore_cli(capsys):
     assert out["schedules_run"] >= 1
     assert out["exhausted"] in (True, False)
     assert rc in (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# State-fingerprint pruning (VERDICT.md round 3, "Next round" #7): pruning
+# must change COST only, never the answer — identical distinct-history
+# sets and verdict counts wherever the unpruned walk also finishes.
+# ---------------------------------------------------------------------------
+
+def _history_set(sut_factory, prog, spec, prune, max_schedules=20_000):
+    from qsm_tpu.sched.systematic import _enumerate
+
+    hists, schedules, exhausted = _enumerate(
+        sut_factory, prog, max_schedules, 100_000, prune=prune)
+    assert exhausted, "parity check needs both walks to finish"
+    return {h.fingerprint() for h in hists}, schedules
+
+
+def test_prune_preserves_history_sets_across_families():
+    """Pruned and unpruned enumeration produce the SAME distinct-history
+    set on every model family probed (the soundness contract: identical
+    scheduler state ⇒ identical subtree, so skips drop only duplicates).
+    """
+    from qsm_tpu.core.generator import generate_program
+    from qsm_tpu.models.cas import AtomicCasSUT, CasSpec
+    from qsm_tpu.models.counter import RacyTicketSUT, TicketSpec
+    from qsm_tpu.models.register import ReplicatedRegisterSUT
+
+    cases = []
+    reg_spec = RegisterSpec(n_values=3)
+    cases.append((lambda: ReplicatedRegisterSUT(), reg_spec,
+                  generate_program(reg_spec, seed=3, n_pids=2, max_ops=4)))
+    cases.append((lambda: RacyCachedRegisterSUT(), reg_spec,
+                  generate_program(reg_spec, seed=1, n_pids=3, max_ops=4)))
+    cases.append((lambda: RacyCheckThenActSetSUT(SET_SPEC), SET_SPEC,
+                  generate_program(SET_SPEC, seed=10, n_pids=3, max_ops=5)))
+    cas_spec = CasSpec(n_values=3)
+    cases.append((lambda: AtomicCasSUT(cas_spec), cas_spec,
+                  generate_program(cas_spec, seed=2, n_pids=2, max_ops=4)))
+    ctr_spec = TicketSpec()
+    cases.append((lambda: RacyTicketSUT(), ctr_spec,
+                  generate_program(ctr_spec, seed=4, n_pids=2, max_ops=4)))
+    for factory, spec, prog in cases:
+        plain, n_plain = _history_set(factory, prog, spec, prune=False)
+        pruned, n_pruned = _history_set(factory, prog, spec, prune=True)
+        assert pruned == plain, spec.name
+        assert n_pruned <= n_plain, spec.name
+
+
+def test_prune_still_finds_the_violation():
+    racy = explore_program(lambda: RacyCheckThenActSetSUT(SET_SPEC),
+                           SET_PROG, SET_SPEC, prune=True)
+    assert racy.exhausted and racy.violations > 0
+    plain = explore_program(lambda: RacyCheckThenActSetSUT(SET_SPEC),
+                            SET_PROG, SET_SPEC, prune=False)
+    assert plain.violations == racy.violations
+    assert plain.distinct_histories == racy.distinct_histories
+
+
+def test_prune_exhausts_the_round3_truncation_case():
+    """The round-3 EXPERIMENTS shape (set/racy, 3 pids × 5 ops, seed 5):
+    unpruned truncates at 10k schedules with the tree unfinished; pruned
+    must EXHAUST it in under 1k — and surface the histories the
+    truncation was hiding."""
+    from qsm_tpu.core.generator import generate_program
+
+    spec = SetSpec()
+    prog = generate_program(spec, seed=5, n_pids=3, max_ops=5)
+    res = explore_program(lambda: RacyCheckThenActSetSUT(spec), prog, spec,
+                          prune=True, max_schedules=1_000)
+    assert res.exhausted, "pruned walk must finish the round-3 case"
+    assert res.schedules_run < 1_000
+    # the unpruned walk truncated at 10k with only 35 distinct histories;
+    # the exhausted pruned walk finds the full set (more than 35)
+    assert res.distinct_histories > 35
