@@ -72,6 +72,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -500,6 +501,22 @@ class JaxTPU:
         # what it needed) — the round-3 iteration-efficiency metric.
         self.lockstep_cost = 0
         self.effective_rescue_slots: Optional[int] = None  # largest cache
+        # Double-buffered tail dispatch: None = auto (on when the default
+        # backend is a real device, where async dispatch makes the overlap
+        # real; off on the CPU platform, where a wasted speculative chunk
+        # costs the same cores the real one needs).  Set True/False to
+        # force either way (tests force True on CPU for semantics).
+        self.DOUBLE_BUFFER: Optional[bool] = None
+        self.speculated_chunks = 0
+        self.wasted_chunks = 0
+        self.host_sync_s = 0.0  # time blocked fetching chunk status
+
+    def _double_buffer_on(self) -> bool:
+        if self.DOUBLE_BUFFER is not None:
+            return self.DOUBLE_BUFFER
+        import jax
+
+        return jax.default_backend() != "cpu"
 
     # -- compilation cache -------------------------------------------------
     def _slots_for(self, batch: int) -> int:
@@ -531,10 +548,11 @@ class JaxTPU:
             self._compiled[key] = fn
         return fn
 
-    def _chunk_fn(self, n_ops: int, batch: int, slots: int, chunk: int):
+    def _chunk_fn(self, n_ops: int, batch: int, slots: int, chunk: int,
+                  donate: bool = True):
         import jax
 
-        key = ("chunk", n_ops, batch, slots, chunk)
+        key = ("chunk", n_ops, batch, slots, chunk, donate)
         fn = self._compiled.get(key)
         if fn is None:
             _, run_one = self._stepper(n_ops, slots)
@@ -550,10 +568,71 @@ class JaxTPU:
             # instead of double-buffering it in HBM every chunk.  The CPU
             # backend can't donate and warns per call site, so only donate
             # where it works (the carry is small enough either way there).
-            donate = (0,) if jax.default_backend() != "cpu" else ()
+            # ``donate=False`` is the double-buffered tail's variant: the
+            # speculative next chunk reads a carry whose status the host
+            # has not fetched yet, so that carry must stay alive.
+            dn = (0,) if donate and jax.default_backend() != "cpu" else ()
             fn = jax.jit(jax.vmap(run_chunk, in_axes=(0, 0, 0, 0, 0, 0)),
-                         donate_argnums=donate)
+                         donate_argnums=dn)
             self._compiled[key] = fn
+        return fn
+
+    def _compact_fn(self, new_bucket: int, slots: int, old_slots: int):
+        """Jitted lane compaction: gather surviving lanes of every carry
+        leaf into the smaller padded batch ON DEVICE, re-hashing occupied
+        cache entries into the new table size when it changes — no host
+        round-trip of the dominant state (VERDICT.md round 3, "Next
+        round" #6; the old path materialized the full carry on host per
+        compaction, defeating donation and sharding)."""
+        import jax
+        import jax.numpy as jnp
+
+        key = ("compact", new_bucket, slots, old_slots)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+
+        def compact(carry, idx, live):
+            # idx: int32[new_bucket] source rows (0 for padding rows);
+            # live: bool[new_bucket] marks real rows
+            new = {}
+            for k, v in carry.items():
+                if k in ("keys", "occ"):
+                    continue
+                g = jnp.take(v, idx, axis=0)
+                if k == "status":
+                    # padding rows freeze immediately (cond sees SUCCESS)
+                    g = jnp.where(live, g, SUCCESS)
+                new[k] = g
+            if slots > 0:
+                keys = jnp.take(carry["keys"], idx, axis=0)
+                occ = jnp.take(carry["occ"], idx, axis=0)
+                occ = jnp.where(live[:, None], occ, 0)
+                if old_slots == slots:
+                    new["keys"] = keys
+                    new["occ"] = occ
+                else:
+                    # re-hash occupied entries into the new table; slot
+                    # collisions drop an entry (either one — pruning
+                    # opportunity lost, soundness untouched, same
+                    # contract as the host re-hash this replaces)
+                    kw = keys.shape[2]
+                    hash_one = make_hash_slot(kw, slots)
+                    dest = jax.vmap(jax.vmap(hash_one))(keys)
+                    dest = jnp.where(occ == 1, dest, slots)  # drop empties
+                    bidx = jnp.broadcast_to(
+                        jnp.arange(new_bucket)[:, None], dest.shape)
+                    new["keys"] = (
+                        jnp.zeros((new_bucket, slots, kw), jnp.uint32)
+                        .at[bidx, dest].set(keys, mode="drop"))
+                    new["occ"] = (
+                        jnp.zeros((new_bucket, slots), jnp.int32)
+                        .at[bidx, dest].set(occ, mode="drop"))
+            return new
+
+        dn = (0,) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(compact, donate_argnums=dn)
+        self._compiled[key] = fn
         return fn
 
     def _args_in_domain(self, h: History) -> bool:
@@ -741,11 +820,15 @@ class JaxTPU:
         prev_iters = np.zeros(b, np.int64)
         round_i = 0
 
+        speculate = self._double_buffer_on()
+        last_sched = len(self.CHUNK_SCHEDULE) - 1
+        pending = None  # speculatively-dispatched NEXT chunk's carry
+
         while active.size:
             bucket = _batch_bucket(active.size)
             slots = self._slots_for(bucket)
-            chunk = self.CHUNK_SCHEDULE[
-                min(round_i, len(self.CHUNK_SCHEDULE) - 1)]
+            sched_i = min(round_i, last_sched)
+            chunk = self.CHUNK_SCHEDULE[sched_i]
 
             if carry is None:
                 carry = self._fresh_carry(active, bucket, slots, n_ops,
@@ -755,6 +838,9 @@ class JaxTPU:
                 lanes = np.arange(active.size)
                 cur_bucket, cur_slots = bucket, slots
             elif bucket != cur_bucket or slots != cur_slots:
+                if pending is not None:
+                    pending = None  # compaction invalidates the gamble
+                    self.wasted_chunks += 1
                 carry = self._compact_carry(carry, lanes, bucket,
                                             slots, cur_slots)
                 args = self._pad_args(active, bucket,
@@ -763,10 +849,31 @@ class JaxTPU:
                 cur_bucket, cur_slots = bucket, slots
                 self.compactions += 1
 
-            fn = self._chunk_fn(n_ops, bucket, slots, chunk)
-            carry = fn(carry, *args)
+            # Double-buffered tail (VERDICT.md round 3, "Next round" #2):
+            # once the chunk schedule settles, dispatch chunk k+1 BEFORE
+            # fetching chunk k's status, so the host sync overlaps device
+            # compute instead of idling the device between rounds.
+            # Finished lanes are frozen in-kernel (their while-cond is
+            # false), so re-running them is a no-op; the gamble only loses
+            # when the next round would have compacted or the batch
+            # finishes.  The tail fn must not donate its input (the
+            # not-yet-fetched carry stays alive) — a deliberate memory/
+            # latency trade confined to the settled tail.
+            tail = speculate and sched_i == last_sched
+            fn = self._chunk_fn(n_ops, bucket, slots, chunk,
+                                donate=not tail)
+            if pending is not None:
+                carry = pending
+                pending = None
+            else:
+                carry = fn(carry, *args)
+            if tail:
+                pending = fn(carry, *args)
+                self.speculated_chunks += 1
+            t_sync = time.perf_counter()
             status = np.asarray(carry["status"])
             iters = np.asarray(carry["iters"]).astype(np.int64)
+            self.host_sync_s += time.perf_counter() - t_sync
             self.batches_run += 1
             self.rounds_run += 1
             # lockstep cost: trips this chunk × padded width (what every
@@ -790,6 +897,8 @@ class JaxTPU:
             lanes = lanes[still]
             round_i += 1
 
+        if pending is not None:
+            self.wasted_chunks += 1  # batch finished under the gamble
         self.device_histories += b
         if collect_chosen:
             return out_status, out_chosen
@@ -808,12 +917,16 @@ class JaxTPU:
             jnp.asarray(pv), jnp.asarray(pi))
         return self._shard_carry(carry)
 
-    def _compact_carry(self, carry, lanes, bucket, slots, old_slots):
-        """Gather surviving lanes' DFS state into a smaller padded batch
-        (host-side), growing the memo cache by re-hashing occupied entries
-        into the larger table.  The carry is exact: resuming it continues
-        the identical search; dropped-on-collision cache entries only lose
-        pruning opportunities, never soundness."""
+    def _compact_carry_host(self, carry, lanes, bucket, slots, old_slots):
+        """Host-side reference compaction (the round-3 implementation):
+        gather surviving lanes' DFS state into a smaller padded batch,
+        growing the memo cache by re-hashing occupied entries into the
+        larger table.  Kept as the behavioral reference for
+        :meth:`_compact_carry` (tests/test_kernel_driver.py compares resumed
+        searches across both paths); the driver uses the device path.
+        The carry is exact: resuming it continues the identical search;
+        dropped-on-collision cache entries only lose pruning
+        opportunities, never soundness."""
         import jax.numpy as jnp
 
         host = {k: np.asarray(v) for k, v in carry.items()}
@@ -850,6 +963,24 @@ class JaxTPU:
             new["occ"] = occ
         return self._shard_carry({k: jnp.asarray(v)
                                   for k, v in new.items()})
+
+    def _compact_carry(self, carry, lanes, bucket, slots, old_slots):
+        """Lane compaction, on device: one jitted gather, no host
+        materialization of the carry (see :meth:`_compact_fn`);
+        :meth:`_compact_carry_host` is the behavioral reference."""
+        import jax.numpy as jnp
+
+        if slots > 0 and "keys" not in carry:
+            raise AssertionError(
+                "cache slots grew from 0 mid-run; _slots_for is monotone "
+                "per bucket so this cannot happen")
+        idx = np.zeros(bucket, np.int32)
+        idx[:lanes.size] = lanes
+        live = np.zeros(bucket, bool)
+        live[:lanes.size] = True
+        new = self._compact_fn(bucket, slots, old_slots or 0)(
+            carry, jnp.asarray(idx), jnp.asarray(live))
+        return self._shard_carry(new)
 
     def _shard_carry(self, carry):
         """Every carry leaf is batch-leading; on a mesh, place it with the

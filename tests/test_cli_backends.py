@@ -59,6 +59,7 @@ def test_every_backend_choice_constructs(healthy_probe):
     from qsm_tpu.native import CppOracle
     from qsm_tpu.ops.jax_kernel import JaxTPU
     from qsm_tpu.ops.pcomp import PComp
+    from qsm_tpu.ops.router import AutoDevice
     from qsm_tpu.ops.rootsplit import RootSplit
     from qsm_tpu.ops.segdc import SegDC
     from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
@@ -80,6 +81,7 @@ def test_every_backend_choice_constructs(healthy_probe):
         "rootsplit-tpu": (RootSplit, QueueSpec),
         # auto = fastest exact host checker (native here: toolchain baked)
         "auto": (CppOracle, QueueSpec),
+        "auto-tpu": (AutoDevice, QueueSpec),
     }
     assert set(want) == set(_BACKENDS)
     for name, (ty, mk_spec) in want.items():
@@ -97,6 +99,10 @@ def test_every_backend_choice_constructs(healthy_probe):
     b = _make_backend("rootsplit-tpu", CasSpec())
     assert isinstance(b.inner, JaxTPU)
     assert not b.eager  # the shipped default is hard-tail escalation
+    b = _make_backend("auto-tpu", CasSpec())
+    assert isinstance(b.plain, JaxTPU)  # router over the device kernel
+    b = _make_backend("auto-tpu", KvSpec())
+    assert b.pcomp is not None  # partitionable specs decompose per key
 
 
 def test_unknown_backend_refused():

@@ -1,0 +1,92 @@
+"""Chunked-driver round-4 mechanics (ops/jax_kernel.py): device-side lane
+compaction must behave exactly like the host reference path, and the
+double-buffered tail (speculative next-chunk dispatch) must change cost
+only, never verdicts."""
+
+import numpy as np
+
+from qsm_tpu.models.cas import AtomicCasSUT, CasSpec, RacyCasSUT
+from qsm_tpu.ops.jax_kernel import JaxTPU
+from qsm_tpu.utils.corpus import build_corpus
+
+SPEC = CasSpec()
+
+
+def _corpus(n=48, ops=32):
+    return build_corpus(SPEC, (AtomicCasSUT, RacyCasSUT), n=n, n_pids=8,
+                        max_ops=ops, seed_base=1000, seed_prefix="drv")
+
+
+def test_device_compaction_matches_host_reference():
+    """Both compaction paths must yield identical verdicts and identical
+    compaction/round counts on a corpus that forces bucket shrinks and
+    cache growth (lanes retire across rounds)."""
+    corpus = _corpus()
+
+    dev = JaxTPU(SPEC)
+    v_dev = np.asarray(dev.check_histories(SPEC, corpus))
+    assert dev.compactions > 0, "corpus must exercise compaction"
+
+    host = JaxTPU(SPEC)
+    host._compact_carry = host._compact_carry_host  # reference path
+    v_host = np.asarray(host.check_histories(SPEC, corpus))
+
+    assert (v_dev == v_host).all()
+    assert dev.compactions == host.compactions
+    assert dev.rounds_run == host.rounds_run
+
+
+def test_device_compaction_rehash_grows_cache_correctly():
+    """Force a slot-size change (bucket shrink grows the per-lane cache)
+    and pin that post-compaction searches still decide every lane — a
+    corrupted re-hash would surface as wrong verdicts or blown budgets."""
+    from qsm_tpu import WingGongCPU
+
+    corpus = _corpus(n=80)
+    dev = JaxTPU(SPEC)
+    v = np.asarray(dev.check_histories(SPEC, corpus))
+    want = np.asarray(WingGongCPU(memo=True).check_histories(SPEC, corpus))
+    both = (v != 2) & (want != 2)
+    assert both.any()
+    assert ((v == want) | ~both).all()
+
+
+def test_double_buffer_parity_and_accounting():
+    """DOUBLE_BUFFER=True must produce identical verdicts and identical
+    round structure (the speculative chunk IS the next round's work);
+    its cost shows up only in the speculated/wasted counters."""
+    corpus = _corpus()
+    # a short schedule reaches the settled tail (where speculation is
+    # allowed) within the corpus's round count
+    sched = (64, 256)
+
+    plain = JaxTPU(SPEC)
+    plain.CHUNK_SCHEDULE = sched
+    plain.DOUBLE_BUFFER = False
+    v0 = np.asarray(plain.check_histories(SPEC, corpus))
+    assert plain.speculated_chunks == 0 and plain.wasted_chunks == 0
+
+    spec_on = JaxTPU(SPEC)
+    spec_on.CHUNK_SCHEDULE = sched
+    spec_on.DOUBLE_BUFFER = True  # forced on (auto is off on CPU)
+    v1 = np.asarray(spec_on.check_histories(SPEC, corpus))
+
+    assert (v0 == v1).all()
+    assert spec_on.rounds_run == plain.rounds_run
+    assert spec_on.speculated_chunks > 0
+    # every speculative chunk is either consumed as the next round or
+    # wasted at a compaction/termination boundary
+    consumed = spec_on.speculated_chunks - spec_on.wasted_chunks
+    assert 0 <= consumed <= spec_on.rounds_run
+
+
+def test_double_buffer_auto_off_on_cpu():
+    b = JaxTPU(SPEC)
+    assert b._double_buffer_on() is False  # conftest pins the CPU platform
+
+
+def test_host_sync_accounting_accumulates():
+    b = JaxTPU(SPEC)
+    b.check_histories(SPEC, _corpus(n=16))
+    assert b.host_sync_s > 0.0
+    assert b.rounds_run > 0

@@ -55,6 +55,11 @@ def _backends_for(model: str, spec, on_tpu: bool):
     if model == "queue":
         out["device"] = SegDC(spec,
                               make_inner=lambda s: JaxTPU(s, **vec_kw))
+        # the router (ops/router.py) picks segdc/plain per history; its
+        # row shows what `--backend auto-tpu` actually delivers
+        from qsm_tpu.ops.router import AutoDevice
+
+        out["auto_device"] = AutoDevice(spec, **vec_kw)
     else:
         # stack included: its state scalarizes (ops/scalarize.py), so it
         # rides the table-gather path at the same default budgets as the
@@ -111,7 +116,7 @@ def bench_config(model: str, on_tpu: bool, n_corpus: int) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="/root/repo/BENCH_CONFIGS_r03.json")
+    ap.add_argument("--out", default="/root/repo/BENCH_CONFIGS_r04.json")
     ap.add_argument("--force-cpu", action="store_true")
     ap.add_argument("--probe-timeout", type=float, default=45.0)
     ap.add_argument("--corpus", type=int, default=None,

@@ -54,7 +54,7 @@ def run_one(label: str, backend_name: str, make_backend, sut_name: str,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="/root/repo/BENCH_E2E_r03.json")
+    ap.add_argument("--out", default="/root/repo/BENCH_E2E_r04.json")
     ap.add_argument("--force-cpu", action="store_true")
     ap.add_argument("--probe-timeout", type=float, default=45.0)
     ap.add_argument("--trials", type=int, default=150)
