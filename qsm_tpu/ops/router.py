@@ -97,8 +97,10 @@ class AutoDevice:
             self.name = f"auto({self.pcomp.name})"
             return
         self.plain: LineariseBackend = make(spec)
-        # the SAME kernel instance serves as SegDC's inner backend: one
-        # compile/bucket cache across both routes
+        # the SAME kernel instance serves as SegDC's inner backend (one
+        # compile/bucket cache across both routes); SegDC's default
+        # middle-segment enumerator already prefers the native checker
+        # (segdc.default_middle_oracle)
         self.segdc = SegDC(spec, make_inner=lambda s: self.plain)
         self.name = f"auto({self.plain.name})"
         self.routed_plain = 0

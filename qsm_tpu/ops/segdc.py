@@ -125,6 +125,22 @@ def _end_states(spec: Spec, ops: List[Op], starts: Set[Tuple[int, ...]],
     return out
 
 
+def default_middle_oracle(spec: Spec):
+    """SegDC's default middle-segment enumerator: the native checker when
+    the toolchain is present (its ``end_states`` walks middles 3-10×
+    faster than the Python DFS — docs/EXPERIMENTS.md round 4), else the
+    memoised Python oracle.  Callers that specifically want the pure-
+    Python reference pass ``oracle=WingGongCPU(memo=True)`` explicitly."""
+    try:
+        from ..native import CppOracle, native_available
+
+        if native_available():
+            return CppOracle(spec)
+    except Exception:  # noqa: BLE001 — optional fast path only
+        pass
+    return WingGongCPU(memo=True)
+
+
 class SegDC:
     """Backend combinator: split each history at quiescent cuts, thread the
     frontier of reachable model states through the segments; histories with
@@ -146,7 +162,7 @@ class SegDC:
         # one batched device call across all (segment × frontier state)
         # pairs.  Auto-detected from the signature; override explicitly
         # with ``device_final``.
-        self.oracle = oracle or WingGongCPU(memo=True)
+        self.oracle = oracle or default_middle_oracle(spec)
         if device_final is None:
             try:
                 device_final = "init_states" in inspect.signature(

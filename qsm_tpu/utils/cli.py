@@ -159,6 +159,8 @@ def _make_backend_inner(name: str, spec):
         from ..ops.jax_kernel import JaxTPU
         from ..ops.segdc import SegDC
 
+        # middles ride SegDC's default enumerator (native when the
+        # toolchain is there); finals batch on device (JaxTPU init_states)
         return SegDC(spec, lambda s: JaxTPU(s))
     if name == "rootsplit":
         from ..ops.rootsplit import RootSplit

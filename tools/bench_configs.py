@@ -53,12 +53,12 @@ def _backends_for(model: str, spec, on_tpu: bool):
         return out
     out = {"memo": WingGongCPU(memo=True)}
     if model == "queue":
+        from qsm_tpu.ops.router import AutoDevice
+
         out["device"] = SegDC(spec,
                               make_inner=lambda s: JaxTPU(s, **vec_kw))
         # the router (ops/router.py) picks segdc/plain per history; its
         # row shows what `--backend auto-tpu` actually delivers
-        from qsm_tpu.ops.router import AutoDevice
-
         out["auto_device"] = AutoDevice(spec, **vec_kw)
     else:
         # stack included: its state scalarizes (ops/scalarize.py), so it

@@ -1,0 +1,90 @@
+"""Pruning-soundness soak: pruned vs unpruned exploration on many random
+programs across every model family (the round-3 burn-in lesson: 400+
+trials catch what 120 don't — docs/EXPERIMENTS.md).
+
+For each (family, seed): enumerate the delivery tree twice, pruned and
+unpruned, bounded by --max-schedules.  Whenever BOTH walks exhaust, the
+distinct-history fingerprint sets must be IDENTICAL; when only the pruned
+walk exhausts, its history set must be a superset of the truncated
+unpruned walk's.  Any divergence prints the reproducer (family, impl,
+seed, pids, ops) and exits 1.
+
+    python tools/soak_prune.py --per-family 60 [--pids 3] [--ops 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+from qsm_tpu.utils.device import force_cpu_platform  # noqa: E402
+
+force_cpu_platform()
+
+from qsm_tpu.core.generator import generate_program  # noqa: E402
+from qsm_tpu.models.registry import MODELS, SutFactory, make  # noqa: E402
+from qsm_tpu.sched.systematic import _enumerate  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--per-family", type=int, default=60)
+    ap.add_argument("--pids", type=int, default=3)
+    ap.add_argument("--ops", type=int, default=5)
+    ap.add_argument("--max-schedules", type=int, default=4_000)
+    ap.add_argument("--impl", default="racy",
+                    help="racy impls have the richer interleaving trees")
+    args = ap.parse_args(argv)
+
+    t0 = time.time()
+    total = both_exh = pruned_only = mismatches = 0
+    saved = 0
+    for family in sorted(MODELS):
+        spec, _ = make(family, args.impl)
+        for seed in range(args.per_family):
+            prog = generate_program(spec, seed=seed, n_pids=args.pids,
+                                    max_ops=args.ops)
+            factory = SutFactory(family, args.impl)
+            up_h, up_n, up_exh = _enumerate(
+                factory, prog, args.max_schedules, 100_000, prune=False)
+            pr_h, pr_n, pr_exh = _enumerate(
+                factory, prog, args.max_schedules, 100_000, prune=True)
+            total += 1
+            saved += max(0, up_n - pr_n)
+            up_set = {h.fingerprint() for h in up_h}
+            pr_set = {h.fingerprint() for h in pr_h}
+            if up_exh and pr_exh:
+                both_exh += 1
+                ok = up_set == pr_set
+            elif pr_exh:
+                pruned_only += 1
+                ok = up_set <= pr_set
+            else:
+                ok = True  # both truncated: no completeness claim to check
+            if not pr_exh and up_exh:
+                ok = False  # pruning must never LOSE exhaustion
+            if not ok:
+                mismatches += 1
+                print(json.dumps({
+                    "MISMATCH": {"family": family, "impl": args.impl,
+                                 "seed": seed, "pids": args.pids,
+                                 "ops": args.ops,
+                                 "unpruned": [len(up_set), up_n, up_exh],
+                                 "pruned": [len(pr_set), pr_n, pr_exh]}}),
+                    flush=True)
+    print(json.dumps({
+        "programs": total, "both_exhausted": both_exh,
+        "pruned_only_exhausted": pruned_only,
+        "schedules_saved": saved, "mismatches": mismatches,
+        "families": len(MODELS), "per_family": args.per_family,
+        "pids": args.pids, "ops": args.ops,
+        "seconds": round(time.time() - t0, 1)}))
+    return 1 if mismatches else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
