@@ -127,6 +127,15 @@ class CppOracle:
                     None, None, 0, 0, 0, 0)
         return None
 
+    def can_enumerate(self) -> bool:
+        """True when this spec has a native route at all (step table or
+        vector kernel, library loaded) — i.e., :meth:`end_states` can
+        ever answer non-None.  Callers choosing a middle-segment
+        enumerator (ops/segdc.py::default_middle_oracle) must probe this:
+        a CppOracle that always falls back is strictly worse than the
+        memoised Python oracle it would displace."""
+        return self._lib is not None and self._dispatch(1) is not None
+
     def _native_ok(self, h: History) -> bool:
         if self._lib is None or len(h) > _MAX_OPS:
             return False

@@ -76,15 +76,27 @@ class AutoDevice:
     # Widest middle segment (max mutually-overlapping ops) the host is
     # willing to enumerate: the end-state walk's branching is exponential
     # in width, near-linear in length (module docstring has the round-3/4
-    # measurements behind the cap; re-tune on-chip when a window opens).
+    # measurements behind the caps; re-tune on-chip when a window opens).
+    # With the NATIVE enumerator (segdc.default_middle_oracle found the
+    # toolchain) the cap is far higher: width-8 middles that cost the
+    # Python walk 3.6× plain measured 40× FASTER than plain natively
+    # (cas 8-pid 128-op sweep corpus: segdc 0.15 s vs plain 6.4 s).
+    # Width 12 still bounds the 2^width state×mask blowup the native
+    # memo can hit on untested 16-pid-wide segments.
     WIDTH_CAP = 4
+    NATIVE_WIDTH_CAP = 12
 
     def __init__(self, spec: Spec,
                  make_inner: Optional[Callable] = None,
+                 width_cap: Optional[int] = None,
                  **inner_kw):
         from .jax_kernel import JaxTPU
 
         self.spec = spec
+        # explicit cap overrides BOTH class defaults — the on-chip
+        # retune knob (CPU-fallback and real-TPU economics differ: a
+        # fast chip moves the plain/segdc crossover toward plain)
+        self._width_cap_override = width_cap
         make = make_inner or (lambda s: JaxTPU(s, **inner_kw))
         self.pcomp = None
         if hasattr(spec, "projected_spec"):
@@ -93,7 +105,8 @@ class AutoDevice:
             from .pcomp import PComp
 
             self.pcomp = PComp(
-                spec, make_inner=lambda s: AutoDevice(s, make_inner=make))
+                spec, make_inner=lambda s: AutoDevice(
+                    s, make_inner=make, width_cap=width_cap))
             self.name = f"auto({self.pcomp.name})"
             return
         self.plain: LineariseBackend = make(spec)
@@ -102,6 +115,8 @@ class AutoDevice:
         # middle-segment enumerator already prefers the native checker
         # (segdc.default_middle_oracle)
         self.segdc = SegDC(spec, make_inner=lambda s: self.plain)
+        # native middle enumerator present? (drives the width cap below)
+        self._native_mid = hasattr(self.segdc.oracle, "end_states")
         self.name = f"auto({self.plain.name})"
         self.routed_plain = 0
         self.routed_segdc = 0
@@ -110,8 +125,14 @@ class AutoDevice:
         segs = split_at_quiescent_cuts(h)
         if len(segs) < 2:
             return False
-        # host middle-segment enumeration risk is exponential in WIDTH
-        return all(_width(seg) <= self.WIDTH_CAP for seg in segs[:-1])
+        # host middle-segment enumeration risk is exponential in WIDTH;
+        # the native enumerator pushes the affordable width well past
+        # the Python walk's
+        cap = self._width_cap_override
+        if cap is None:
+            cap = (self.NATIVE_WIDTH_CAP if self._native_mid
+                   else self.WIDTH_CAP)
+        return all(_width(seg) <= cap for seg in segs[:-1])
 
     def check_histories(self, spec: Spec, histories: Sequence[History]
                         ) -> np.ndarray:

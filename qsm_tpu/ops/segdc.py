@@ -135,7 +135,14 @@ def default_middle_oracle(spec: Spec):
         from ..native import CppOracle, native_available
 
         if native_available():
-            return CppOracle(spec)
+            cpp = CppOracle(spec)
+            # toolchain present is not enough: a spec with no native
+            # route (no scalar table, no vector kernel, or past the C++
+            # state cap) would make end_states always answer None and
+            # every middle segment fall through to the Python walk —
+            # while callers (ops/router.py) tune for native costs
+            if cpp.can_enumerate():
+                return cpp
     except Exception:  # noqa: BLE001 — optional fast path only
         pass
     return WingGongCPU(memo=True)

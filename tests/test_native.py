@@ -191,7 +191,9 @@ def test_end_states_matches_python_enumeration():
             frontier = want
     assert checked > 0, "corpus produced no middle segments"
 
-    host = SegDC(spec)
+    # the Python-walk reference must stay Python: SegDC's DEFAULT
+    # middle oracle is now the native checker, so pin it explicitly
+    host = SegDC(spec, oracle=WingGongCPU(memo=True))
     nat = SegDC(spec, make_inner=lambda s: cpp, oracle=cpp)
     got = nat.check_histories(spec, corpus)
     want = host.check_histories(spec, corpus)
@@ -218,7 +220,7 @@ def test_frontier_start_past_segment_bound_is_exact():
     assert len(split_at_quiescent_cuts(good)) == 11
 
     cpp = CppOracle(spec)
-    host = SegDC(spec)
+    host = SegDC(spec, oracle=WingGongCPU(memo=True))
     nat = SegDC(spec, make_inner=lambda s: cpp, oracle=cpp)
     for h in (good, bad):
         want = host.check_histories(spec, [h])
