@@ -111,6 +111,16 @@ class FaultPlan:
         # processes whose messages are never dropped (e.g. history plumbing)
         self.protected = protected or set()
 
+    def is_deterministic(self) -> bool:
+        """True when this plan never consults the RNG's OUTCOME: crash
+        schedules (delivery-count triggers) and partitions (a pure
+        (src, dst) predicate) only.  Systematic exploration
+        (sched/systematic.py) relies on this to enumerate faulty
+        executions exactly — when adding a new seeded fault knob, it
+        must make this answer False."""
+        return (self.p_drop == 0 and self.p_duplicate == 0
+                and self.p_delay == 0)
+
     def decide(self, msg: Message, rng: random.Random) -> str:
         if msg.src in self.protected or msg.dst in self.protected:
             return self.DELIVER

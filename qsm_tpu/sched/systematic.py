@@ -16,9 +16,16 @@ makes tree search stateless: running prefix ``p`` reveals the branching
 factors along ``p``'s leftmost completion, and lexicographic backtracking
 over the logged factors enumerates the full tree without ever storing it.
 
-Fault injection is refused here: fault decisions draw from the seeded RNG,
-which scripted replay deliberately bypasses — sampling (prop_concurrent
-with a FaultPlan) remains the way to explore faulty executions.
+Fault injection composes when — and only when — the plan is
+DETERMINISTIC: crash schedules (``crash_at`` fires on delivery counts)
+and partitions (a pure (src, dst) predicate) never consult the seeded
+RNG's outcome, so scripted replay explores them exactly — enumerating
+every delivery order UNDER a crash plan turns "the failover survived k
+random crash trials" into "the failover survives EVERY interleaving of
+this crash schedule" (the verified claim, extended to fault tolerance).
+Probabilistic faults (drop/duplicate/delay rates) draw from the RNG that
+scripted replay bypasses and are refused, as before; sampling
+(prop_concurrent with a FaultPlan) remains the way to explore those.
 
 The batching story is the TPU story: enumeration yields hundreds-to-
 thousands of small histories per program, exactly the shape the device
@@ -208,12 +215,22 @@ def _state_fingerprint(sched: Scheduler, rec: HistoryRecorder):
     return (events, pool, procs, monitors)
 
 
+def deterministic_faults(faults: Optional[FaultPlan]) -> bool:
+    """True when ``faults`` never consults the RNG's outcome — the class
+    of plans systematic exploration can enumerate exactly.  The answer
+    lives on FaultPlan itself so a future seeded knob is decided next to
+    the fields it is defined by."""
+    return faults is None or faults.is_deterministic()
+
+
 def _enumerate(sut_factory, program, max_schedules: int, max_steps: int,
-               prune: bool = True) -> Tuple[List[History], int, bool]:
+               prune: bool = True, faults: Optional[FaultPlan] = None
+               ) -> Tuple[List[History], int, bool]:
     """Walk one program's delivery-choice tree depth-first: (distinct
     histories, schedules run, whole tree fit under max_schedules).
     ``prune`` enables state-fingerprint subtree skipping (see above);
-    pruned partial runs still count as schedules run."""
+    pruned partial runs still count as schedules run.  ``faults`` must
+    be a deterministic plan (callers validate)."""
     histories: Dict[Tuple, History] = {}
     seen: Dict[tuple, tuple] = {}  # state fp -> first-visit choice path
     prefix: Optional[List[int]] = []
@@ -224,16 +241,27 @@ def _enumerate(sut_factory, program, max_schedules: int, max_steps: int,
             exhausted = False
             break
         sched, rec = prepare_run(sut_factory(), program, seed=0,
-                                 max_steps=max_steps, choices=prefix)
+                                 max_steps=max_steps, choices=prefix,
+                                 faults=faults)
         if prune:
             script = prefix
 
-            def hook(s, _script=script, _rec=rec):
+            # only CRASH plans depend on the delivery count; a
+            # partitions-only plan is depth-independent and keeps the
+            # full pruning power (incl. loop cutting)
+            def hook(s, _script=script, _rec=rec,
+                     _faulty=bool(faults and faults.crash_at)):
                 log = s.choice_log
                 try:
                     fp = _state_fingerprint(s, _rec)
                 except _Unfingerprintable:
                     return False  # can't identify ⇒ never skip
+                if _faulty:
+                    # pending crash points fire on the DELIVERY COUNT, so
+                    # under a fault plan two same-state nodes at different
+                    # depths have different futures — the count joins the
+                    # identity (costs loop-cutting, keeps soundness)
+                    fp = (fp, s.n_delivered)
                 # the EFFECTIVE path taken so far (scripted choices are
                 # clamped to the live branching factor, 0 past the script)
                 path = tuple(
@@ -279,15 +307,17 @@ def explore_program(
     history reports as undecided, so ``verified`` can never be claimed
     from an unchecked run.
     """
-    if faults is not None:
+    if not deterministic_faults(faults):
         raise ValueError(
-            "systematic exploration is incompatible with fault injection "
-            "(fault decisions are seeded draws, which scripted replay "
-            "bypasses); use prop_concurrent sampling for faulty runs")
+            "systematic exploration is incompatible with PROBABILISTIC "
+            "fault injection (drop/duplicate/delay rates are seeded "
+            "draws, which scripted replay bypasses); crash schedules and "
+            "partitions are deterministic and explore fine — use "
+            "prop_concurrent sampling for the probabilistic plans")
     t0 = time.perf_counter()
     hists, schedules, exhausted = _enumerate(sut_factory, program,
                                              max_schedules, max_steps,
-                                             prune=prune)
+                                             prune=prune, faults=faults)
     if not check:
         return ExploreResult(
             schedules_run=schedules, distinct_histories=len(hists),
@@ -314,6 +344,7 @@ def explore_many(
     max_schedules: int = 10_000,
     max_steps: int = 100_000,
     prune: bool = True,
+    faults: Optional[FaultPlan] = None,
 ) -> List[ExploreResult]:
     """Explore MANY programs, deciding the union of all their distinct
     histories in ONE batched checker call — the vmap-shaped workload the
@@ -331,6 +362,10 @@ def explore_many(
     union batch (never the reverse direction of a wrong verdict; the
     per-program ``undecided`` count reports it).
     """
+    if not deterministic_faults(faults):
+        raise ValueError(
+            "systematic exploration is incompatible with PROBABILISTIC "
+            "fault injection; see explore_program")
     if backend is None:
         from ..core.property import _default_oracle
 
@@ -341,7 +376,7 @@ def explore_many(
         t0 = time.perf_counter()
         hists, schedules, exhausted = _enumerate(sut_factory, prog,
                                                  max_schedules, max_steps,
-                                                 prune=prune)
+                                                 prune=prune, faults=faults)
         per_prog.append((slice(len(flat), len(flat) + len(hists)),
                          schedules, exhausted,
                          time.perf_counter() - t0))
@@ -374,6 +409,7 @@ def shrink_explored(
     max_schedules: int = 2_000,
     max_rounds: int = 50,
     initial: Optional[ExploreResult] = None,
+    faults: Optional[FaultPlan] = None,
 ):
     """Minimize a program whose exploration found a violation.
 
@@ -397,7 +433,8 @@ def shrink_explored(
     best_res = (initial if initial is not None
                 else explore_program(sut_factory, program, spec,
                                      backend=backend,
-                                     max_schedules=max_schedules))
+                                     max_schedules=max_schedules,
+                                     faults=faults))
     if best_res.violations == 0:
         return best_prog, best_res, 0
     steps = 0
@@ -407,7 +444,8 @@ def shrink_explored(
             if len(cand) >= len(best_prog):
                 continue
             res = explore_program(sut_factory, cand, spec, backend=backend,
-                                  max_schedules=max_schedules)
+                                  max_schedules=max_schedules,
+                                  faults=faults)
             if res.violations > 0:
                 best_prog, best_res = cand, res
                 steps += 1

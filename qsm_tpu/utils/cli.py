@@ -535,6 +535,15 @@ def cmd_explore(args) -> int:
         raise SystemExit(
             "--programs is a sweep; combine --shrink/--save-regression "
             "with a single program (drop --programs)")
+    from ..sched.systematic import deterministic_faults
+
+    faults = _faults_from_args(args)
+    if not deterministic_faults(faults):
+        raise SystemExit(
+            "explore enumerates schedules exactly, which only composes "
+            "with DETERMINISTIC fault plans (--crash-at); probabilistic "
+            "faults (--p-drop/--p-duplicate/--p-delay) are seeded draws "
+            "— use `run` sampling for those")
     spec, _ = make(args.model, args.impl)
     backend = (_make_backend(args.backend, spec)
                if args.backend else None)
@@ -547,7 +556,7 @@ def cmd_explore(args) -> int:
         results = explore_many(
             lambda: make(args.model, args.impl)[1], progs, spec,
             backend=backend, max_schedules=args.max_schedules,
-            prune=not args.no_prune)
+            prune=not args.no_prune, faults=faults)
         total_vio = sum(r.violations for r in results)
         for i, r in enumerate(results):
             line = {
@@ -575,13 +584,14 @@ def cmd_explore(args) -> int:
     res = explore_program(
         lambda: make(args.model, args.impl)[1], prog, spec,
         backend=backend, max_schedules=args.max_schedules,
-        prune=not args.no_prune)
+        prune=not args.no_prune, faults=faults)
     shrink_steps = 0
     if res.violations and args.shrink:
         prog, res, shrink_steps = shrink_explored(
             lambda: make(args.model, args.impl)[1], prog, spec,
             backend=backend, max_schedules=args.max_schedules,
-            initial=res)  # exploration is deterministic: reuse, don't redo
+            initial=res,  # exploration is deterministic: reuse, don't redo
+            faults=faults)
     out = {"model": args.model, "impl": args.impl, "ops": len(prog),
            "schedules_run": res.schedules_run,
            "distinct_histories": res.distinct_histories,
@@ -602,7 +612,10 @@ def cmd_explore(args) -> int:
             cx = Counterexample(program=prog, history=res.violating,
                                 trial=0, trial_seed=res.violating.seed,
                                 shrink_steps=shrink_steps)
-            cfg = PropertyConfig(n_pids=args.pids, max_ops=args.ops)
+            # the fault plan is part of the finding: replay without it
+            # runs a different (crash-free) execution
+            cfg = PropertyConfig(n_pids=args.pids, max_ops=args.ops,
+                                 faults=faults)
             save_regression(args.save_regression, args.model, args.impl,
                             spec, cfg, cx)
             print(f"regression saved to {args.save_regression}",
@@ -714,6 +727,8 @@ def main(argv=None) -> int:
                         "pruned walk visits the same distinct histories "
                         "in far fewer schedules; this flag forces the "
                         "raw lexicographic enumeration)")
+    _add_fault_args(p)  # deterministic plans only (--crash-at);
+    # probabilistic rates are refused with a clean message in cmd_explore
     p.set_defaults(fn=cmd_explore)
 
     p = sub.add_parser(

@@ -83,10 +83,111 @@ def test_explore_truncation_reported():
     assert not res.exhausted and not res.verified
 
 
-def test_explore_refuses_faults():
-    with pytest.raises(ValueError, match="fault"):
+def test_explore_refuses_probabilistic_faults():
+    with pytest.raises(ValueError, match="PROBABILISTIC"):
         explore_program(lambda: AtomicSetSUT(SET_SPEC), SET_PROG,
                         SET_SPEC, faults=FaultPlan(p_drop=0.5))
+
+
+# ---------------------------------------------------------------------------
+# Deterministic fault plans compose with exhaustive exploration: crash
+# schedules fire on delivery counts, so scripted replay explores them
+# exactly — the `verified` claim extends to fault tolerance.
+# ---------------------------------------------------------------------------
+
+def _failover_prog():
+    from qsm_tpu.core.generator import ProgOp, Program
+    from qsm_tpu.models.failover import READ, WRITE
+
+    # the bug shape: a write acked on the doomed primary, observations
+    # before and after the failover
+    return Program((ProgOp(0, WRITE, 1), ProgOp(0, READ, 0),
+                    ProgOp(1, READ, 0), ProgOp(1, READ, 0)), n_pids=2)
+
+
+def test_explore_verifies_sync_failover_under_crash():
+    """The sync-replication failover earns `verified` under EVERY
+    interleaving of the crash schedule — certainty where round 3 had
+    6,000 random trials."""
+    from qsm_tpu.models.registry import make
+
+    spec, _ = make("failover", "atomic")
+    res = explore_program(lambda: make("failover", "atomic")[1],
+                          _failover_prog(), spec,
+                          faults=FaultPlan(crash_at={"primary": 2}),
+                          max_schedules=30_000)
+    assert res.exhausted and res.verified
+
+
+def test_explore_convicts_async_failover_under_crash():
+    """The async impl's lost-acked-write bug is FOUND exhaustively, and
+    the violating schedule replays bit-identically under the same crash
+    plan (the finding is a proof, not a sample)."""
+    from qsm_tpu.models.registry import make
+    from qsm_tpu.sched.systematic import parse_schedule_key
+
+    spec, _ = make("failover", "racy")
+    plan = FaultPlan(crash_at={"primary": 2})
+    res = explore_program(lambda: make("failover", "racy")[1],
+                          _failover_prog(), spec, faults=plan,
+                          max_schedules=30_000)
+    assert res.exhausted and res.violations > 0
+    assert res.violating is not None
+    h = run_concurrent(make("failover", "racy")[1], _failover_prog(),
+                       seed=res.violating.seed, faults=plan,
+                       choices=parse_schedule_key(res.violating.seed))
+    assert h.fingerprint() == res.violating.fingerprint()
+
+
+def test_explore_crash_cli_roundtrip(tmp_path, capsys):
+    """explore --crash-at finds the async failover bug exhaustively, the
+    regression file carries the crash plan, and replay reproduces the
+    history bit for bit under it."""
+    from qsm_tpu.utils.cli import main
+
+    path = str(tmp_path / "crash_cx.json")
+    # seed 9's generated program is the known write-then-read bug shape
+    rc = main(["explore", "--model", "failover", "--impl", "racy",
+               "--pids", "2", "--ops", "4", "--seed", "9",
+               "--crash-at", "primary:2", "--max-schedules", "30000",
+               "--save-regression", path])
+    out = capsys.readouterr().out.strip().splitlines()[0]
+    res = json.loads(out)
+    assert res["exhausted"] and res["violations"] > 0
+    assert rc == 1
+    rc = main(["replay", "--regression", path])
+    printed = capsys.readouterr().out
+    assert rc == 1
+    assert "history reproduced bit-identically: True" in printed
+
+
+def test_explore_cli_refuses_probabilistic_faults():
+    from qsm_tpu.utils.cli import main
+
+    with pytest.raises(SystemExit, match="DETERMINISTIC"):
+        main(["explore", "--model", "set", "--impl", "racy",
+              "--pids", "2", "--ops", "4", "--p-drop", "0.2"])
+
+
+def test_prune_preserves_history_sets_under_crash_plan():
+    """Pruning soundness extends to fault plans: the delivery count joins
+    the state identity (pending crash points fire on it), and pruned vs
+    unpruned walks must still agree."""
+    from qsm_tpu.core.generator import ProgOp, Program
+    from qsm_tpu.models.failover import READ, WRITE
+    from qsm_tpu.models.registry import make
+    from qsm_tpu.sched.systematic import _enumerate
+
+    prog = Program((ProgOp(0, WRITE, 1), ProgOp(1, READ, 0)), n_pids=2)
+    plan = FaultPlan(crash_at={"primary": 2})
+    factory = lambda: make("failover", "racy")[1]  # noqa: E731
+    up_h, _, up_exh = _enumerate(factory, prog, 50_000, 100_000,
+                                 prune=False, faults=plan)
+    pr_h, pr_n, pr_exh = _enumerate(factory, prog, 50_000, 100_000,
+                                    prune=True, faults=plan)
+    assert up_exh and pr_exh
+    assert ({h.fingerprint() for h in up_h}
+            == {h.fingerprint() for h in pr_h})
 
 
 def test_shrink_explored_minimizes_to_the_double_add():
