@@ -185,6 +185,12 @@ def _add_fault_args(p: argparse.ArgumentParser) -> None:
                    metavar="NAME:N",
                    help="crash process NAME after N delivery choices "
                         "(repeatable; e.g. --crash-at primary:6)")
+    p.add_argument("--partition", action="append", default=[],
+                   metavar="A,B,...",
+                   help="network partition: messages crossing the named "
+                        "group's boundary are dropped (repeatable, one "
+                        "group per flag; deterministic, so it composes "
+                        "with explore)")
 
 
 def _faults_from_args(args):
@@ -194,11 +200,18 @@ def _faults_from_args(args):
         if not name or not n.isdigit():
             raise SystemExit(f"--crash-at wants NAME:N, got {spec!r}")
         crash_at[name] = int(n)
-    if not (args.p_drop or args.p_duplicate or args.p_delay or crash_at):
+    partitions = []
+    for group in getattr(args, "partition", []):
+        names = {x.strip() for x in group.split(",") if x.strip()}
+        if not names:
+            raise SystemExit(f"--partition wants A,B,..., got {group!r}")
+        partitions.append(names)
+    if not (args.p_drop or args.p_duplicate or args.p_delay or crash_at
+            or partitions):
         return None
     return FaultPlan(p_drop=args.p_drop, p_duplicate=args.p_duplicate,
                      p_delay=args.p_delay, delay_steps=args.delay_steps,
-                     crash_at=crash_at)
+                     crash_at=crash_at, partitions=partitions)
 
 
 def _add_run_args(p: argparse.ArgumentParser) -> None:
@@ -289,6 +302,8 @@ def cmd_run(args) -> int:
         # every fault knob must round-trip through the hint, or the
         # pasted command replays a DIFFERENT fault plan and diverges
         fault_flags += "".join(f" --crash-at {c}" for c in args.crash_at)
+        fault_flags += "".join(f" --partition {g}"
+                               for g in getattr(args, "partition", []))
     print(f"replay: python -m qsm_tpu replay --model {args.model} "
           f"--impl {args.impl} --trial-seed '{cx.trial_seed}' "
           f"--pids {cfg.n_pids} --ops {cfg.max_ops} "
@@ -541,9 +556,9 @@ def cmd_explore(args) -> int:
     if not deterministic_faults(faults):
         raise SystemExit(
             "explore enumerates schedules exactly, which only composes "
-            "with DETERMINISTIC fault plans (--crash-at); probabilistic "
-            "faults (--p-drop/--p-duplicate/--p-delay) are seeded draws "
-            "— use `run` sampling for those")
+            "with DETERMINISTIC fault plans (--crash-at/--partition); "
+            "probabilistic faults (--p-drop/--p-duplicate/--p-delay) are "
+            "seeded draws — use `run` sampling for those")
     spec, _ = make(args.model, args.impl)
     backend = (_make_backend(args.backend, spec)
                if args.backend else None)
@@ -626,10 +641,11 @@ def cmd_explore(args) -> int:
 def cmd_fuzz(args) -> int:
     from .fuzz import fuzz_parity
 
-    if "device" in args.backends.split(","):
-        # same guard as --backend tpu: constructing JaxTPU on a wedged
-        # chip tunnel hangs the first in-process jax.devices() forever,
-        # and a cpu-pinned process would run the lockstep kernel on host
+    if {"device", "segdc", "auto"} & set(args.backends.split(",")):
+        # same guard as --backend tpu: constructing JaxTPU (also the
+        # inner of segdc/auto) on a wedged chip tunnel hangs the first
+        # in-process jax.devices() forever, and a cpu-pinned process
+        # would run the lockstep kernel on host
         _ensure_device_reachable()
     rep = fuzz_parity(n_specs=args.specs, hists_per_spec=args.histories,
                       seed=args.seed, n_pids=args.pids, n_ops=args.ops,
@@ -727,8 +743,9 @@ def main(argv=None) -> int:
                         "pruned walk visits the same distinct histories "
                         "in far fewer schedules; this flag forces the "
                         "raw lexicographic enumeration)")
-    _add_fault_args(p)  # deterministic plans only (--crash-at);
-    # probabilistic rates are refused with a clean message in cmd_explore
+    _add_fault_args(p)  # deterministic plans only (--crash-at and
+    # --partition); probabilistic rates are refused with a clean message
+    # in cmd_explore
     p.set_defaults(fn=cmd_explore)
 
     p = sub.add_parser(
@@ -740,7 +757,7 @@ def main(argv=None) -> int:
     p.add_argument("--ops", type=int, default=10)
     p.add_argument("--p-pending", type=float, default=0.1)
     p.add_argument("--backends", default="memo,cpp,device",
-                   help="comma list from {memo, cpp, device}")
+                   help="comma list from {memo, cpp, device, segdc, auto}")
     p.set_defaults(fn=cmd_fuzz)
 
     p = sub.add_parser("coverage", help="schedule-coverage stats")

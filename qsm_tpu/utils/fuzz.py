@@ -276,6 +276,18 @@ def fuzz_parity(n_specs: int = 10, hists_per_spec: int = 32,
                 backend = CppOracle(spec)
             elif name == "device":
                 backend = JaxTPU(spec)
+            elif name == "segdc":
+                from ..ops.segdc import SegDC
+
+                backend = SegDC(spec, make_inner=lambda s: JaxTPU(s))
+            elif name == "auto":
+                # the strategy router over random specs: exercises the
+                # per-history segdc/plain decision AND the native
+                # middle-segment enumerator on spec shapes no in-tree
+                # model has
+                from ..ops.router import AutoDevice
+
+                backend = AutoDevice(spec)
             else:
                 raise ValueError(f"unknown fuzz backend {name!r}")
             got = backend.check_histories(spec, hists)

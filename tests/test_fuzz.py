@@ -132,3 +132,17 @@ def test_fuzz_cli(capsys):
                "--backends", "memo,cpp"])
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0 and out["ok"] and out["mismatches"] == []
+
+
+def test_fuzz_router_backend():
+    """The auto-tpu router as a fuzz target: per-history segdc/plain
+    routing (incl. native middle enumeration) must stay oracle-exact on
+    random specs no in-tree model resembles."""
+    from qsm_tpu.utils.fuzz import fuzz_parity
+
+    rep = fuzz_parity(n_specs=3, hists_per_spec=12, seed=21,
+                      backends=("auto",))
+    assert rep.mismatches == []
+    rep = fuzz_parity(n_specs=2, hists_per_spec=10, seed=22,
+                      backends=("segdc",), vector_bounds=(3, 2, 2))
+    assert rep.mismatches == []
