@@ -536,6 +536,20 @@ def cmd_list(args) -> int:
     return 0
 
 
+def _result_line(r) -> dict:
+    """The ONE ExploreResult → JSON mapping; every explore output path
+    (single program, --programs sweep, --crash-sweep) merges its own
+    context keys around this so the fields can't drift apart."""
+    line = {"schedules_run": r.schedules_run,
+            "distinct_histories": r.distinct_histories,
+            "exhausted": r.exhausted, "violations": r.violations,
+            "undecided": r.undecided, "verified": r.verified}
+    if r.violating is not None:
+        # the replayable delivery-choice script that produced it
+        line["violating_schedule"] = r.violating.seed
+    return line
+
+
 def cmd_explore(args) -> int:
     """Bounded-exhaustive schedule exploration of one generated program
     (sched/systematic.py): every interleaving, one batched verdict."""
@@ -550,6 +564,12 @@ def cmd_explore(args) -> int:
         raise SystemExit(
             "--programs is a sweep; combine --shrink/--save-regression "
             "with a single program (drop --programs)")
+    if args.crash_sweep and (args.programs > 1 or args.shrink
+                             or args.save_regression or args.crash_at):
+        raise SystemExit(
+            "--crash-sweep explores ONE program under a range of crash "
+            "points; combine it only with --seed/--pids/--ops "
+            "(drop --programs/--shrink/--save-regression/--crash-at)")
     from ..sched.systematic import deterministic_faults
 
     faults = _faults_from_args(args)
@@ -574,18 +594,8 @@ def cmd_explore(args) -> int:
             prune=not args.no_prune, faults=faults)
         total_vio = sum(r.violations for r in results)
         for i, r in enumerate(results):
-            line = {
-                "seed": args.seed + i, "ops": len(progs[i]),
-                "schedules_run": r.schedules_run,
-                "distinct_histories": r.distinct_histories,
-                "exhausted": r.exhausted, "violations": r.violations,
-                "undecided": r.undecided, "verified": r.verified}
-            if r.violating is not None:
-                # the replayable schedule script, same as the
-                # single-program path (a sweep finding must not force a
-                # re-run to recover it)
-                line["violating_schedule"] = r.violating.seed
-            print(json.dumps(line))
+            print(json.dumps({"seed": args.seed + i, "ops": len(progs[i]),
+                              **_result_line(r)}))
         print(json.dumps({
             "programs": len(results), "total_violations": total_vio,
             "total_undecided": sum(r.undecided for r in results),
@@ -596,6 +606,46 @@ def cmd_explore(args) -> int:
     # in deliveries, so registry-default sizes are never implied here
     prog = generate_program(spec, seed=args.seed, n_pids=args.pids,
                             max_ops=args.ops)
+    if args.crash_sweep:
+        # fault-tolerance certification: ONE command exhaustively explores
+        # the program under EVERY crash point in the range — `verified` on
+        # every line is a proof over the whole crash×schedule space
+        name, _, rng = args.crash_sweep.partition(":")
+        lo, _, hi = rng.partition("-")
+        if not (name and lo.isdigit() and hi.isdigit()):
+            raise SystemExit("--crash-sweep wants NAME:LO-HI "
+                             "(e.g. primary:1-12)")
+        lo, hi = int(lo), int(hi)
+        if lo > hi:
+            # an empty range would print a VACUOUS all_verified summary
+            raise SystemExit(f"--crash-sweep range is empty "
+                             f"({lo}-{hi}); want LO <= HI")
+        total_vio = 0
+        all_verified = True
+        for k in range(lo, hi + 1):
+            # extend any co-passed deterministic plan (--partition)
+            # rather than silently discarding it
+            plan = FaultPlan(crash_at={name: k},
+                             partitions=faults.partitions if faults
+                             else [])
+            r = explore_program(
+                lambda: make(args.model, args.impl)[1], prog, spec,
+                backend=backend, max_schedules=args.max_schedules,
+                prune=not args.no_prune, faults=plan)
+            print(json.dumps({"crash_at": f"{name}:{k}",
+                              **_result_line(r)}))
+            total_vio += r.violations
+            all_verified = all_verified and r.verified
+        print(json.dumps({"crash_sweep": f"{name}:{lo}-{hi}",
+                          "ops": len(prog),
+                          "total_violations": total_vio,
+                          "all_verified": all_verified}))
+        # exit mirrors `run`: 1 = violations found, 2 = inconclusive
+        # (no violation but the certification claim was NOT earned —
+        # truncated trees or undecided verdicts), 0 = fully verified
+        if total_vio:
+            return 1
+        return 0 if all_verified else 2
     res = explore_program(
         lambda: make(args.model, args.impl)[1], prog, spec,
         backend=backend, max_schedules=args.max_schedules,
@@ -608,16 +658,8 @@ def cmd_explore(args) -> int:
             initial=res,  # exploration is deterministic: reuse, don't redo
             faults=faults)
     out = {"model": args.model, "impl": args.impl, "ops": len(prog),
-           "schedules_run": res.schedules_run,
-           "distinct_histories": res.distinct_histories,
-           "exhausted": res.exhausted, "violations": res.violations,
-           "undecided": res.undecided, "verified": res.verified,
+           **_result_line(res),
            "shrink_steps": shrink_steps, "seconds": res.seconds}
-    if res.violating is not None:
-        # "explore:<comma-joined delivery choices>" — the exact schedule
-        # script that produced this history (replayable via
-        # run_concurrent(..., choices=[...]))
-        out["violating_schedule"] = res.violating.seed
     print(json.dumps(out))
     if res.violating is not None:
         print(format_history(spec, res.violating), file=sys.stderr)
@@ -746,6 +788,12 @@ def main(argv=None) -> int:
     _add_fault_args(p)  # deterministic plans only (--crash-at and
     # --partition); probabilistic rates are refused with a clean message
     # in cmd_explore
+    p.add_argument("--crash-sweep", default=None, metavar="NAME:LO-HI",
+                   help="explore the program under crash_at={NAME: k} "
+                        "for every k in [LO, HI] — one JSON line per "
+                        "crash point plus a summary; `verified` on every "
+                        "line proves the impl over the whole "
+                        "crash-point × schedule space at this size")
     p.set_defaults(fn=cmd_explore)
 
     p = sub.add_parser(
