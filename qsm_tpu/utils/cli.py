@@ -564,12 +564,25 @@ def cmd_explore(args) -> int:
         raise SystemExit(
             "--programs is a sweep; combine --shrink/--save-regression "
             "with a single program (drop --programs)")
-    if args.crash_sweep and (args.programs > 1 or args.shrink
-                             or args.save_regression or args.crash_at):
-        raise SystemExit(
-            "--crash-sweep explores ONE program under a range of crash "
-            "points; combine it only with --seed/--pids/--ops "
-            "(drop --programs/--shrink/--save-regression/--crash-at)")
+    cs = None  # parsed --crash-sweep: (name, lo, hi)
+    if args.crash_sweep:
+        if (args.programs > 1 or args.shrink or args.save_regression
+                or args.crash_at):
+            raise SystemExit(
+                "--crash-sweep explores ONE program under a range of "
+                "crash points; combine it only with --seed/--pids/--ops/"
+                "--partition (drop --programs/--shrink/"
+                "--save-regression/--crash-at)")
+        name, _, rng = args.crash_sweep.partition(":")
+        lo, _, hi = rng.partition("-")
+        if not (name and lo.isdigit() and hi.isdigit()):
+            raise SystemExit("--crash-sweep wants NAME:LO-HI "
+                             "(e.g. primary:1-12)")
+        if int(lo) > int(hi):
+            # an empty range would print a VACUOUS all_verified summary
+            raise SystemExit(f"--crash-sweep range is empty "
+                             f"({lo}-{hi}); want LO <= HI")
+        cs = (name, int(lo), int(hi))
     from ..sched.systematic import deterministic_faults
 
     faults = _faults_from_args(args)
@@ -580,6 +593,21 @@ def cmd_explore(args) -> int:
             "probabilistic faults (--p-drop/--p-duplicate/--p-delay) are "
             "seeded draws — use `run` sampling for those")
     spec, _ = make(args.model, args.impl)
+    if cs is not None:
+        # a typo'd process name would silently no-op every crash
+        # (Scheduler.crash ignores unknown names) and "certify" the
+        # fault-FREE system — validate against what a run really spawns
+        from ..sched.runner import prepare_run
+
+        probe_prog = generate_program(spec, seed=args.seed,
+                                      n_pids=args.pids, max_ops=args.ops)
+        probe_sched, _pr = prepare_run(make(args.model, args.impl)[1],
+                                       probe_prog, seed=0)
+        if cs[0] not in probe_sched.procs:
+            raise SystemExit(
+                f"--crash-sweep: no process named {cs[0]!r} is spawned "
+                f"by {args.model}/{args.impl}; processes: "
+                f"{sorted(probe_sched.procs)}")
     backend = (_make_backend(args.backend, spec)
                if args.backend else None)
     if args.programs > 1:
@@ -606,22 +634,14 @@ def cmd_explore(args) -> int:
     # in deliveries, so registry-default sizes are never implied here
     prog = generate_program(spec, seed=args.seed, n_pids=args.pids,
                             max_ops=args.ops)
-    if args.crash_sweep:
+    if cs is not None:
         # fault-tolerance certification: ONE command exhaustively explores
         # the program under EVERY crash point in the range — `verified` on
         # every line is a proof over the whole crash×schedule space
-        name, _, rng = args.crash_sweep.partition(":")
-        lo, _, hi = rng.partition("-")
-        if not (name and lo.isdigit() and hi.isdigit()):
-            raise SystemExit("--crash-sweep wants NAME:LO-HI "
-                             "(e.g. primary:1-12)")
-        lo, hi = int(lo), int(hi)
-        if lo > hi:
-            # an empty range would print a VACUOUS all_verified summary
-            raise SystemExit(f"--crash-sweep range is empty "
-                             f"({lo}-{hi}); want LO <= HI")
-        total_vio = 0
+        name, lo, hi = cs
+        total_vio = total_und = 0
         all_verified = True
+        seconds = 0.0
         for k in range(lo, hi + 1):
             # extend any co-passed deterministic plan (--partition)
             # rather than silently discarding it
@@ -635,11 +655,15 @@ def cmd_explore(args) -> int:
             print(json.dumps({"crash_at": f"{name}:{k}",
                               **_result_line(r)}))
             total_vio += r.violations
+            total_und += r.undecided
             all_verified = all_verified and r.verified
+            seconds += r.seconds
         print(json.dumps({"crash_sweep": f"{name}:{lo}-{hi}",
                           "ops": len(prog),
                           "total_violations": total_vio,
-                          "all_verified": all_verified}))
+                          "total_undecided": total_und,
+                          "all_verified": all_verified,
+                          "seconds": round(seconds, 3)}))
         # exit mirrors `run`: 1 = violations found, 2 = inconclusive
         # (no violation but the certification claim was NOT earned —
         # truncated trees or undecided verdicts), 0 = fully verified

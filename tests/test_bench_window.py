@@ -115,3 +115,26 @@ def test_run_sweep_structure_fast():
     cas_memo = sw["cells"]["cas"]["memo"]
     assert "12" in cas_memo and cas_memo["12"]["undecided"] == 0
     assert cas_memo["12"]["solved"] is True
+
+
+def test_watcher_banks_round_stamped_committed_copy(tmp_path, monkeypatch):
+    """A caught window must leave COMMITTED evidence: the watcher writes a
+    round-stamped twin next to the gitignored runtime artifact (VERDICT.md
+    round 3, "Next round" #1 — the driver's end-of-round commit then picks
+    it up even unattended)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "watcher_under_test", os.path.join(REPO, "tools",
+                                           "probe_watcher.py"))
+    w = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(w)
+
+    src = tmp_path / "BENCH_TPU_WINDOW.json"
+    dst = tmp_path / "BENCH_TPU_r04.json"
+    monkeypatch.setitem(w.COMMITTED_COPIES, str(src), str(dst))
+    src.write_text(json.dumps(_tpu_line()))
+    w._bank_committed_copy(str(src))
+    assert json.loads(dst.read_text())["value"] == 12345.6
+    # unknown runtime paths are a no-op, not an error
+    w._bank_committed_copy(str(tmp_path / "unknown.json"))

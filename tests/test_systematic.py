@@ -199,6 +199,11 @@ def test_crash_sweep_cli_rejects_bad_combos():
     with pytest.raises(SystemExit, match="empty"):
         main(["explore", "--model", "failover", "--crash-sweep",
               "primary:5-3"])
+    # a typo'd process name would certify the fault-FREE system
+    # (Scheduler.crash silently ignores unknown names)
+    with pytest.raises(SystemExit, match="no process named"):
+        main(["explore", "--model", "failover", "--crash-sweep",
+              "pirmary:1-3"])
 
 
 def test_crash_sweep_inconclusive_exits_2(capsys):
