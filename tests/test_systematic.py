@@ -256,6 +256,29 @@ def test_explore_cli_refuses_probabilistic_faults():
               "--pids", "2", "--ops", "4", "--p-drop", "0.2"])
 
 
+def test_every_family_has_a_convicted_certified_pair():
+    """Sampled evidence (docs/evidence/explore_families_r04.jsonl): for
+    EVERY model family there is a program whose racy impl is convicted
+    by exhaustive exploration while the atomic impl is verified on the
+    SAME program.  Pin two sub-second pairs; the committed evidence file
+    carries the rest, and failover's pair lives in the crash-sweep tests
+    above.  max_ops=5 here matches the evidence GENERATION config (the
+    file's "ops" field records the resulting program LENGTH, 4)."""
+    from qsm_tpu.core.generator import generate_program
+    from qsm_tpu.models.registry import make
+
+    for family, seed, pids, ops in (("register", 11, 2, 5),
+                                    ("queue", 0, 2, 5)):
+        spec, _ = make(family, "racy")
+        prog = generate_program(spec, seed=seed, n_pids=pids, max_ops=ops)
+        racy = explore_program(lambda: make(family, "racy")[1], prog,
+                               spec, max_schedules=60_000)
+        assert racy.exhausted and racy.violations > 0, family
+        atomic = explore_program(lambda: make(family, "atomic")[1], prog,
+                                 spec, max_schedules=60_000)
+        assert atomic.verified, family
+
+
 def test_prune_preserves_history_sets_under_crash_plan():
     """Pruning soundness extends to fault plans: the delivery count joins
     the state identity (pending crash points fire on it), and pruned vs
