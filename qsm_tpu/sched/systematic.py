@@ -44,7 +44,7 @@ import types
 
 from ..core.history import History
 from ..ops.backend import LineariseBackend, Verdict
-from .runner import HistoryRecorder, prepare_run
+from .runner import HistoryRecorder, _client, prepare_run
 from .scheduler import FaultPlan, Message, PruneRun, Scheduler
 
 
@@ -183,6 +183,18 @@ def _fp_val(v, depth: int = 0):
     raise _Unfingerprintable(f"opaque value {type(v).__name__}")
 
 
+# Locals of EXACTLY the runner's client frame that are constant across
+# every run of one enumeration (`ops` is the whole per-pid program,
+# sched/runner.py::_client) — fingerprinting them at every delivery
+# point is pure cost.  Sound because `seen` never outlives one
+# program's enumeration AND the skip is scoped by code object: a SUT
+# process generator with a *mutating* local that happens to share the
+# name must still be fingerprinted (a name-based skip there would
+# conflate distinct states — an unsound prune).
+_CLIENT_CODE = _client.__code__
+_CLIENT_SKIP_LOCALS = frozenset({"ops"})
+
+
 def _fp_gen(g, depth: int = 0):
     """Continuation fingerprint: code identity + bytecode position +
     locals, following the yield-from chain (clients delegate to
@@ -191,8 +203,12 @@ def _fp_gen(g, depth: int = 0):
     if fr is None:
         return ("G", g.gi_code.co_name, "done")
     sub = g.gi_yieldfrom
+    loc = fr.f_locals
+    if fr.f_code is _CLIENT_CODE:
+        loc = {k: v for k, v in loc.items()
+               if k not in _CLIENT_SKIP_LOCALS}
     return ("G", g.gi_code.co_name, fr.f_lasti,
-            _fp_val(fr.f_locals, depth + 1),
+            _fp_val(loc, depth + 1),
             _fp_gen(sub, depth + 1)
             if isinstance(sub, types.GeneratorType) else None)
 
