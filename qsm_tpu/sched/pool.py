@@ -61,42 +61,120 @@ def _run_one(job) -> History:
                           transport=_STATE["transport"])
 
 
-class PoolExecutor:
-    """Executes (program, seed) jobs over a persistent process pool,
-    preserving input order (and therefore every downstream decision)."""
+class _SpawnPool:
+    """Shared lifecycle for the two worker pools: spawn context (JAX-
+    initialized parents must not fork), a bounded initialization probe —
+    multiprocessing.Pool silently respawns crashing workers forever, so
+    a sut_factory that fails in the fresh interpreter (unpicklable
+    closure, missing import) would otherwise wedge the first map with no
+    diagnostic — and terminate-on-close."""
 
-    # generous ceiling for ONE job: spawn warmup is ~4 s/worker on this
-    # image; an in-tree job is sub-millisecond.  Exists to turn a
-    # worker-init crash into an error — multiprocessing.Pool silently
-    # respawns crashing workers forever, so a sut_factory that fails in
-    # the fresh interpreter (unpicklable closure, missing import) would
-    # otherwise wedge run_many with no diagnostic at all.
     PROBE_TIMEOUT_S = 60.0
 
-    def __init__(self, sut_factory, n_workers: Optional[int] = None,
-                 transport: str = "memory"):
+    def __init__(self, initializer, initargs,
+                 n_workers: Optional[int] = None):
         self.n_workers = n_workers or min(8, os.cpu_count() or 2)
         ctx = multiprocessing.get_context("spawn")
-        self._pool = ctx.Pool(self.n_workers, initializer=_init_worker,
-                              initargs=(sut_factory, transport))
-        self.jobs_run = 0
+        self._pool = ctx.Pool(self.n_workers, initializer=initializer,
+                              initargs=initargs)
         self._probed = False
 
+    def _probe_fn(self):  # -> picklable callable returning True when init ran
+        raise NotImplementedError
+
     def _probe(self) -> None:
-        """Fail fast if workers cannot initialize (see PROBE_TIMEOUT_S)."""
         if self._probed:
             return
         try:
-            self._pool.apply_async(_probe_ok).get(self.PROBE_TIMEOUT_S)
+            self._pool.apply_async(self._probe_fn()).get(
+                self.PROBE_TIMEOUT_S)
         except multiprocessing.TimeoutError:
             self.close()
             raise RuntimeError(
                 "worker pool failed to initialize within "
                 f"{self.PROBE_TIMEOUT_S:.0f}s — the sut_factory probably "
-                "crashes in a fresh interpreter (it must be picklable and "
-                "importable under the spawn start method; use "
+                "crashes in a fresh interpreter (it must be picklable "
+                "and importable under the spawn start method; use "
                 "models.registry.SutFactory)") from None
         self._probed = True
+
+    def close(self) -> None:
+        self._pool.terminate()
+        self._pool.join()
+
+
+def _init_explore_worker(sut_factory) -> None:
+    _STATE["sut_factory"] = sut_factory
+
+
+def _explore_probe_ok() -> bool:
+    return "sut_factory" in _STATE
+
+
+def _explore_one(job):
+    """Enumerate ONE program's delivery tree in this worker (the checker
+    batch stays in the parent).  Returns (histories, schedules,
+    exhausted, seconds)."""
+    import time
+
+    from .systematic import _enumerate
+
+    prog, max_schedules, max_steps, prune, faults = job
+    t0 = time.perf_counter()
+    hists, schedules, exhausted = _enumerate(
+        _STATE["sut_factory"], prog, max_schedules, max_steps,
+        prune=prune, faults=faults)
+    return hists, schedules, exhausted, time.perf_counter() - t0
+
+
+class ExplorePool(_SpawnPool):
+    """Fans whole-tree enumerations over a persistent spawn pool.  ONE
+    tree is milliseconds-to-seconds of pure-Python replay walking —
+    coarse enough that per-job dispatch (~0.7 ms) is noise, unlike the
+    execution pool's sub-millisecond jobs.  Enumeration is deterministic
+    per program, so results are bit-identical to the serial walk
+    (tests/test_pool.py); the device-shaped union batch is still decided
+    by the CALLER in one backend call — workers never touch JAX.
+
+    MEASURED ON THIS IMAGE (honest caveat): the build host has ONE CPU
+    core (`os.cpu_count() == 1`), so fan-out cannot beat serial here —
+    8 workers on 8 big trees (77 s serial) measured 0.75× from pure
+    contention + spawn warmup.  The default stays 0 (serial); the
+    feature exists for multi-core hosts, where wall-clock ≈ warmup +
+    the largest tree instead of the sum."""
+
+    def __init__(self, sut_factory, n_workers: Optional[int] = None):
+        super().__init__(_init_explore_worker, (sut_factory,),
+                         n_workers=n_workers)
+
+    def _probe_fn(self):
+        return _explore_probe_ok
+
+    def explore_many(self, programs: Sequence, max_schedules: int,
+                     max_steps: int, prune: bool, faults) -> List[Tuple]:
+        """[(histories, schedules, exhausted, seconds)] in program
+        order."""
+        self._probe()
+        payload = [(p, max_schedules, max_steps, prune, faults)
+                   for p in programs]
+        # chunksize 1: trees are coarse and wildly uneven (12 to 100k+
+        # schedules); per-worker pre-chunking would serialize the big
+        # tree behind small ones
+        return self._pool.map(_explore_one, payload, chunksize=1)
+
+
+class PoolExecutor(_SpawnPool):
+    """Executes (program, seed) jobs over a persistent process pool,
+    preserving input order (and therefore every downstream decision)."""
+
+    def __init__(self, sut_factory, n_workers: Optional[int] = None,
+                 transport: str = "memory"):
+        super().__init__(_init_worker, (sut_factory, transport),
+                         n_workers=n_workers)
+        self.jobs_run = 0
+
+    def _probe_fn(self):
+        return _probe_ok
 
     def run_many(self, jobs: Sequence[Tuple], faults, max_steps: int
                  ) -> List[History]:

@@ -361,6 +361,7 @@ def explore_many(
     max_steps: int = 100_000,
     prune: bool = True,
     faults: Optional[FaultPlan] = None,
+    workers: int = 0,
 ) -> List[ExploreResult]:
     """Explore MANY programs, deciding the union of all their distinct
     histories in ONE batched checker call — the vmap-shaped workload the
@@ -388,15 +389,35 @@ def explore_many(
         backend = _default_oracle(spec)
     per_prog = []
     flat: List[History] = []
-    for prog in programs:
-        t0 = time.perf_counter()
-        hists, schedules, exhausted = _enumerate(sut_factory, prog,
-                                                 max_schedules, max_steps,
-                                                 prune=prune, faults=faults)
-        per_prog.append((slice(len(flat), len(flat) + len(hists)),
-                         schedules, exhausted,
-                         time.perf_counter() - t0))
-        flat.extend(hists)
+    if workers > 0:
+        # fan whole-tree enumerations over worker processes (each tree
+        # is milliseconds-to-seconds of pure-Python replay walking — the
+        # regime the pool exists for); sut_factory must be picklable
+        # (models.registry.SutFactory).  Deterministic ⇒ bit-identical
+        # to the serial walk; the union batch below still decides in
+        # ONE caller-side backend call.
+        from .pool import ExplorePool
+
+        pool = ExplorePool(sut_factory, n_workers=workers)
+        try:
+            walked = pool.explore_many(programs, max_schedules,
+                                       max_steps, prune, faults)
+        finally:
+            pool.close()
+        for hists, schedules, exhausted, enum_dt in walked:
+            per_prog.append((slice(len(flat), len(flat) + len(hists)),
+                             schedules, exhausted, enum_dt))
+            flat.extend(hists)
+    else:
+        for prog in programs:
+            t0 = time.perf_counter()
+            hists, schedules, exhausted = _enumerate(
+                sut_factory, prog, max_schedules, max_steps,
+                prune=prune, faults=faults)
+            per_prog.append((slice(len(flat), len(flat) + len(hists)),
+                             schedules, exhausted,
+                             time.perf_counter() - t0))
+            flat.extend(hists)
     t0 = time.perf_counter()
     verdicts = (backend.check_histories(spec, flat) if flat
                 else np.empty(0, np.int8))

@@ -564,6 +564,10 @@ def cmd_explore(args) -> int:
         raise SystemExit(
             "--programs is a sweep; combine --shrink/--save-regression "
             "with a single program (drop --programs)")
+    if args.workers and args.programs <= 1:
+        raise SystemExit(
+            "--workers fans a --programs sweep over processes; a single "
+            "program's tree enumerates serially (add --programs N)")
     cs = None  # parsed --crash-sweep: (name, lo, hi)
     if args.crash_sweep:
         if (args.programs > 1 or args.shrink or args.save_regression
@@ -616,10 +620,13 @@ def cmd_explore(args) -> int:
         progs = [generate_program(spec, seed=args.seed + i,
                                   n_pids=args.pids, max_ops=args.ops)
                  for i in range(args.programs)]
+        from ..models.registry import SutFactory
+
         results = explore_many(
-            lambda: make(args.model, args.impl)[1], progs, spec,
+            SutFactory(args.model, args.impl), progs, spec,
             backend=backend, max_schedules=args.max_schedules,
-            prune=not args.no_prune, faults=faults)
+            prune=not args.no_prune, faults=faults,
+            workers=args.workers)
         total_vio = sum(r.violations for r in results)
         for i, r in enumerate(results):
             print(json.dumps({"seed": args.seed + i, "ops": len(progs[i]),
@@ -796,6 +803,10 @@ def main(argv=None) -> int:
     p.add_argument("--programs", type=int, default=1,
                    help="sweep N generated programs (seeds seed..seed+N-1)"
                         "; all trees' histories decide in ONE batch")
+    p.add_argument("--workers", type=int, default=0,
+                   help="fan the --programs tree enumerations over N "
+                        "worker processes (0 = serial; results are "
+                        "bit-identical either way)")
     p.add_argument("--backend", default=None, choices=_BACKENDS)
     p.add_argument("--shrink", action="store_true",
                    help="minimize a violating program by re-exploring "

@@ -106,3 +106,34 @@ def test_pool_with_tcp_transport_matches_serial(serial_racy_result):
             == serial.counterexample.history.fingerprint())
     assert pooled_tcp.counterexample.trial_seed == \
         serial.counterexample.trial_seed
+
+
+def test_explore_pool_matches_serial():
+    """Parallel tree enumeration (ExplorePool via explore_many
+    workers>0) is bit-identical to the serial walk — trees are
+    deterministic, fan-out changes wall-clock only (and on this 1-core
+    image not even that; pool.py docstring records the measurement)."""
+    from qsm_tpu.core.generator import generate_program
+    from qsm_tpu.sched.systematic import explore_many
+
+    spec, _ = make("set", "racy")
+    progs = [generate_program(spec, seed=s, n_pids=2, max_ops=4)
+             for s in range(4)]
+    factory = SutFactory("set", "racy")
+    serial = explore_many(factory, progs, spec, max_schedules=5_000)
+    par = explore_many(factory, progs, spec, max_schedules=5_000,
+                       workers=2)
+    for a, b in zip(serial, par):
+        assert (a.schedules_run, a.distinct_histories, a.exhausted,
+                a.violations, a.undecided) == (
+            b.schedules_run, b.distinct_histories, b.exhausted,
+            b.violations, b.undecided)
+
+
+def test_explore_workers_flag_needs_programs():
+    from qsm_tpu.utils.cli import main
+
+    import pytest
+
+    with pytest.raises(SystemExit, match="workers"):
+        main(["explore", "--model", "set", "--workers", "2"])
