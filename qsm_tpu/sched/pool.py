@@ -114,17 +114,17 @@ def _explore_probe_ok() -> bool:
 def _explore_one(job):
     """Enumerate ONE program's delivery tree in this worker (the checker
     batch stays in the parent).  Returns (histories, schedules,
-    exhausted, seconds)."""
+    exhausted, pruned, seconds)."""
     import time
 
     from .systematic import _enumerate
 
     prog, max_schedules, max_steps, prune, faults = job
     t0 = time.perf_counter()
-    hists, schedules, exhausted = _enumerate(
+    hists, schedules, exhausted, pruned_n = _enumerate(
         _STATE["sut_factory"], prog, max_schedules, max_steps,
         prune=prune, faults=faults)
-    return hists, schedules, exhausted, time.perf_counter() - t0
+    return hists, schedules, exhausted, pruned_n, time.perf_counter() - t0
 
 
 class ExplorePool(_SpawnPool):
@@ -152,8 +152,8 @@ class ExplorePool(_SpawnPool):
 
     def explore_many(self, programs: Sequence, max_schedules: int,
                      max_steps: int, prune: bool, faults) -> List[Tuple]:
-        """[(histories, schedules, exhausted, seconds)] in program
-        order."""
+        """[(histories, schedules, exhausted, pruned, seconds)] in
+        program order."""
         self._probe()
         payload = [(p, max_schedules, max_steps, prune, faults)
                    for p in programs]

@@ -59,6 +59,9 @@ class ExploreResult:
     undecided: int
     seconds: float
     violating: Optional[History] = None  # first violating history, if any
+    # schedules cut short by the state-fingerprint prune (subset of
+    # schedules_run) — the mechanism's observability counter
+    pruned_schedules: int = 0
 
     @property
     def ok(self) -> bool:
@@ -241,16 +244,18 @@ def deterministic_faults(faults: Optional[FaultPlan]) -> bool:
 
 def _enumerate(sut_factory, program, max_schedules: int, max_steps: int,
                prune: bool = True, faults: Optional[FaultPlan] = None
-               ) -> Tuple[List[History], int, bool]:
+               ) -> Tuple[List[History], int, bool, int]:
     """Walk one program's delivery-choice tree depth-first: (distinct
-    histories, schedules run, whole tree fit under max_schedules).
-    ``prune`` enables state-fingerprint subtree skipping (see above);
-    pruned partial runs still count as schedules run.  ``faults`` must
-    be a deterministic plan (callers validate)."""
+    histories, schedules run, whole tree fit under max_schedules,
+    schedules cut short by the prune).  ``prune`` enables
+    state-fingerprint subtree skipping (see above); pruned partial runs
+    still count as schedules run.  ``faults`` must be a deterministic
+    plan (callers validate)."""
     histories: Dict[Tuple, History] = {}
     seen: Dict[tuple, tuple] = {}  # state fp -> first-visit choice path
     prefix: Optional[List[int]] = []
     schedules = 0
+    pruned_n = 0
     exhausted = True
     while prefix is not None:
         if schedules >= max_schedules:
@@ -291,12 +296,13 @@ def _enumerate(sut_factory, program, max_schedules: int, max_steps: int,
             pruned = False
         except PruneRun:
             pruned = True
+            pruned_n += 1
         schedules += 1
         if not pruned:
             h = rec.history(seed=schedule_key(prefix))
             histories.setdefault(h.fingerprint(), h)
         prefix = _next_prefix(prefix, sched.choice_log)
-    return list(histories.values()), schedules, exhausted
+    return list(histories.values()), schedules, exhausted, pruned_n
 
 
 def explore_program(
@@ -331,14 +337,15 @@ def explore_program(
             "partitions are deterministic and explore fine — use "
             "prop_concurrent sampling for the probabilistic plans")
     t0 = time.perf_counter()
-    hists, schedules, exhausted = _enumerate(sut_factory, program,
-                                             max_schedules, max_steps,
-                                             prune=prune, faults=faults)
+    hists, schedules, exhausted, pruned_n = _enumerate(
+        sut_factory, program, max_schedules, max_steps,
+        prune=prune, faults=faults)
     if not check:
         return ExploreResult(
             schedules_run=schedules, distinct_histories=len(hists),
             exhausted=exhausted, violations=0, undecided=len(hists),
-            seconds=round(time.perf_counter() - t0, 3))
+            seconds=round(time.perf_counter() - t0, 3),
+            pruned_schedules=pruned_n)
     if backend is None:
         from ..core.property import _default_oracle
 
@@ -349,7 +356,8 @@ def explore_program(
     return ExploreResult(
         schedules_run=schedules, distinct_histories=len(hists),
         exhausted=exhausted, violations=violations, undecided=undecided,
-        seconds=round(time.perf_counter() - t0, 3), violating=violating)
+        seconds=round(time.perf_counter() - t0, 3), violating=violating,
+        pruned_schedules=pruned_n)
 
 
 def explore_many(
@@ -404,18 +412,18 @@ def explore_many(
                                        max_steps, prune, faults)
         finally:
             pool.close()
-        for hists, schedules, exhausted, enum_dt in walked:
+        for hists, schedules, exhausted, pruned_n, enum_dt in walked:
             per_prog.append((slice(len(flat), len(flat) + len(hists)),
-                             schedules, exhausted, enum_dt))
+                             schedules, exhausted, pruned_n, enum_dt))
             flat.extend(hists)
     else:
         for prog in programs:
             t0 = time.perf_counter()
-            hists, schedules, exhausted = _enumerate(
+            hists, schedules, exhausted, pruned_n = _enumerate(
                 sut_factory, prog, max_schedules, max_steps,
                 prune=prune, faults=faults)
             per_prog.append((slice(len(flat), len(flat) + len(hists)),
-                             schedules, exhausted,
+                             schedules, exhausted, pruned_n,
                              time.perf_counter() - t0))
             flat.extend(hists)
     t0 = time.perf_counter()
@@ -423,7 +431,7 @@ def explore_many(
                 else np.empty(0, np.int8))
     check_dt = time.perf_counter() - t0
     out = []
-    for sl, schedules, exhausted, enum_dt in per_prog:
+    for sl, schedules, exhausted, pruned_n, enum_dt in per_prog:
         hs = flat[sl]
         violations, undecided, violating = _summarize(hs, verdicts[sl])
         # per-program seconds like explore_program's: own enumeration
@@ -434,7 +442,7 @@ def explore_many(
             schedules_run=schedules, distinct_histories=len(hs),
             exhausted=exhausted, violations=violations,
             undecided=undecided, seconds=round(enum_dt + share, 3),
-            violating=violating))
+            violating=violating, pruned_schedules=pruned_n))
     return out
 
 

@@ -541,6 +541,7 @@ def _result_line(r) -> dict:
     (single program, --programs sweep, --crash-sweep) merges its own
     context keys around this so the fields can't drift apart."""
     line = {"schedules_run": r.schedules_run,
+            "pruned_schedules": r.pruned_schedules,
             "distinct_histories": r.distinct_histories,
             "exhausted": r.exhausted, "violations": r.violations,
             "undecided": r.undecided, "verified": r.verified}

@@ -291,10 +291,10 @@ def test_prune_preserves_history_sets_under_crash_plan():
     prog = Program((ProgOp(0, WRITE, 1), ProgOp(1, READ, 0)), n_pids=2)
     plan = FaultPlan(crash_at={"primary": 2})
     factory = lambda: make("failover", "racy")[1]  # noqa: E731
-    up_h, _, up_exh = _enumerate(factory, prog, 50_000, 100_000,
-                                 prune=False, faults=plan)
-    pr_h, pr_n, pr_exh = _enumerate(factory, prog, 50_000, 100_000,
-                                    prune=True, faults=plan)
+    up_h, _, up_exh, _p0 = _enumerate(factory, prog, 50_000, 100_000,
+                                      prune=False, faults=plan)
+    pr_h, pr_n, pr_exh, _p1 = _enumerate(factory, prog, 50_000, 100_000,
+                                         prune=True, faults=plan)
     assert up_exh and pr_exh
     assert ({h.fingerprint() for h in up_h}
             == {h.fingerprint() for h in pr_h})
@@ -413,7 +413,7 @@ def test_explore_cli(capsys):
 def _history_set(sut_factory, prog, spec, prune, max_schedules=20_000):
     from qsm_tpu.sched.systematic import _enumerate
 
-    hists, schedules, exhausted = _enumerate(
+    hists, schedules, exhausted, _pruned = _enumerate(
         sut_factory, prog, max_schedules, 100_000, prune=prune)
     assert exhausted, "parity check needs both walks to finish"
     return {h.fingerprint() for h in hists}, schedules
@@ -473,6 +473,9 @@ def test_prune_exhausts_the_round3_truncation_case():
                           prune=True, max_schedules=1_000)
     assert res.exhausted, "pruned walk must finish the round-3 case"
     assert res.schedules_run < 1_000
+    # the observability counter must actually count (it is the only
+    # direct witness that the prune fired on this tree)
+    assert 0 < res.pruned_schedules < res.schedules_run
     # the unpruned walk truncated at 10k with only 35 distinct histories;
     # the exhausted pruned walk finds the full set (more than 35)
     assert res.distinct_histories > 35

@@ -49,9 +49,9 @@ def main(argv=None) -> int:
             prog = generate_program(spec, seed=seed, n_pids=args.pids,
                                     max_ops=args.ops)
             factory = SutFactory(family, args.impl)
-            up_h, up_n, up_exh = _enumerate(
+            up_h, up_n, up_exh, _ = _enumerate(
                 factory, prog, args.max_schedules, 100_000, prune=False)
-            pr_h, pr_n, pr_exh = _enumerate(
+            pr_h, pr_n, pr_exh, _ = _enumerate(
                 factory, prog, args.max_schedules, 100_000, prune=True)
             total += 1
             saved += max(0, up_n - pr_n)
