@@ -419,6 +419,7 @@ def cmd_coverage(args) -> int:
                               prog, spec, max_schedules=args.max_schedules,
                               check=False)  # counts only: skip verdicts
         out["exact"] = {"schedules": res.schedules_run,
+                        "pruned_schedules": res.pruned_schedules,
                         "distinct_histories": res.distinct_histories,
                         "exhausted": res.exhausted}
         if res.exhausted and res.distinct_histories:

@@ -124,9 +124,11 @@ def test_explore_pool_matches_serial():
     par = explore_many(factory, progs, spec, max_schedules=5_000,
                        workers=2)
     for a, b in zip(serial, par):
-        assert (a.schedules_run, a.distinct_histories, a.exhausted,
+        assert (a.schedules_run, a.pruned_schedules,
+                a.distinct_histories, a.exhausted,
                 a.violations, a.undecided) == (
-            b.schedules_run, b.distinct_histories, b.exhausted,
+            b.schedules_run, b.pruned_schedules,
+            b.distinct_histories, b.exhausted,
             b.violations, b.undecided)
 
 
