@@ -10,7 +10,7 @@ crosses actor mailboxes).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Protocol
+from typing import List, Optional, Protocol
 
 from ..core.generator import Program
 from ..core.history import NO_RESP, History, Op
