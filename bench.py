@@ -489,6 +489,12 @@ def main(argv=None) -> int:
     ap.add_argument("--sweep-file", default=None, metavar="PATH",
                     help=f"where the sweep artifact is written "
                          f"(default: {SWEEP_FILE} next to this script)")
+    ap.add_argument("--require-device", action="store_true",
+                    help="exit 3 immediately if the probe (after retries) "
+                         "does not find a real device — never run the CPU "
+                         "fallback workload.  For window-seize callers: a "
+                         "fallback run inside an open TPU window wastes the "
+                         "window's wall-clock on the host core.")
     args = ap.parse_args(argv)
 
     from qsm_tpu.utils.device import force_cpu_platform, probe_default_backend
@@ -513,6 +519,15 @@ def main(argv=None) -> int:
                 on_tpu = probe.is_device
                 if on_tpu:
                     break
+    if not on_tpu and args.require_device:
+        print(json.dumps({
+            "metric": "device_required", "value": 0, "unit": "",
+            "vs_baseline": 0,
+            "error": f"no device after {1 + args.retries} probes",
+            "extras": {"tpu_probe": probe_detail, "device_fallback": "cpu",
+                       "probe_attempts": _probe_attempts_summary()},
+        }))
+        return 3
     if not on_tpu:
         # the watcher may have caught a healed-tunnel window earlier in the
         # round and cached a REAL device run; that measured line is the

@@ -85,8 +85,8 @@ def _run_window_bench(bench_timeout: float, extra_args, label: str,
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"),
-             "--probe-timeout", "45", "--retries", "1",
-             "--retry-interval", "15", *extra_args],
+             "--probe-timeout", "60", "--retries", "4",
+             "--retry-interval", "10", "--require-device", *extra_args],
             capture_output=True, text=True, timeout=bench_timeout, cwd=REPO)
     except subprocess.TimeoutExpired:
         _log(event=label, ok=False,
