@@ -69,6 +69,9 @@ def _probe_attempts_summary() -> dict | None:
             recs = [json.loads(ln) for ln in f if ln.strip()]
     except (OSError, ValueError):
         return None
+    # watcher EVENT lines (seize-stage outcomes) share the log but are not
+    # probes; counting them would inflate n / skew last_detail
+    recs = [r for r in recs if "event" not in r]
     if not recs:
         return None
     return {

@@ -98,6 +98,10 @@ def _run_window_bench(bench_timeout: float, extra_args, label: str,
         result = json.loads(line[-1]) if line else {}
     except ValueError:
         result = {}
+    # diagnostic detail for the log: a --require-device abort (rc 3) has
+    # no extras.device, but its "error" field says why the stage failed
+    diag = (result.get("extras", {}).get("device")
+            or result.get("error") or "") if result else (r.stderr or "")[-200:]
     # a cached-window ECHO is not a device run: when the spawned bench's
     # own probe finds the tunnel wedged it reprints the existing artifact
     # (rc 0, device_fallback None) — accepting that would refresh the
@@ -110,8 +114,7 @@ def _run_window_bench(bench_timeout: float, extra_args, label: str,
                  and not result.get("error"))
     _log(event=label, ok=bool(on_device),
          rc=r.returncode, seconds=round(time.time() - t0, 1),
-         detail=(result.get("extras", {}).get("device", "")
-                 if result else (r.stderr or "")[-200:]))
+         detail=diag)
     if on_device and bank:
         result["captured_iso"] = datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds")
@@ -174,31 +177,84 @@ def _seize_window(bench_timeout: float) -> bool:
     closes mid-way the earlier captures survive — a killed subprocess's
     stdout is gone, so never stake the round's only real-chip artifact on
     the longest run."""
-    banked = _run_window_bench(bench_timeout / 2, ["--no-sweep"],
-                               "window_bench_headline")
+    # A ≤3 h-old headline capture is left alone (the repo and this
+    # gitignored artifact persist across rounds, so existence alone must
+    # not suppress a later round's seize) — but a fresh headline must NOT
+    # suppress the still-missing upgrade artifacts: the round-4 window
+    # banked the headline, closed before configs/e2e/profile, and the old
+    # main()-level age gate would have skipped all of them had the tunnel
+    # healed again the same round.
+    try:
+        age = time.time() - os.path.getmtime(WINDOW_ARTIFACT)
+    except OSError:
+        age = float("inf")
+    headline_fresh = age <= 3 * 3600.0
+    configs_done = os.path.exists(
+        os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"))
+    e2e_done = os.path.exists(
+        os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json"))
+    # a profile directory is "captured" only once a completed trace file
+    # exists inside it — jax.profiler creates the directory at trace START,
+    # so a run killed mid-trace (flickering window) leaves a bare/partial
+    # dir that must not suppress retries
+    profile_dir = os.path.join(REPO, "profiles", f"{ROUND_TAG}_tpu")
+    profile_done = False
+    for root, _dirs, files in os.walk(profile_dir):
+        if any(f.endswith(".xplane.pb") for f in files):
+            profile_done = True
+            break
+    # the sweep is banked only when its artifact shows a real-device
+    # capture; the filename tracks ROUND_TAG (a literal went stale on
+    # round bumps) and a missing device_fallback key means NOT banked
+    sweep_done = False
+    try:
+        with open(os.path.join(
+                REPO, f"BENCH_SWEEP_{ROUND_TAG}.json")) as f:
+            sweep_done = json.load(f).get(
+                "device_fallback", "absent") is None
+    except (OSError, ValueError):
+        pass
+    if (headline_fresh and configs_done and e2e_done and profile_done
+            and sweep_done):
+        return True  # everything banked: a healthy tunnel cycle is silent
+    if headline_fresh:
+        _log(event="window_bench_headline", ok=True,
+             detail=f"fresh capture ({age / 60:.0f} min old); kept")
+        banked = True
+    else:
+        banked = _run_window_bench(bench_timeout / 2, ["--no-sweep"],
+                                   "window_bench_headline")
     if banked:
         # chase the upgrades only while the window is demonstrably open;
         # after a failed bank the flicker closed — a full sweep on the
-        # CPU fallback would block probing for up to bench_timeout
-        _run_window_bench(bench_timeout, [], "window_bench_full")
+        # CPU fallback would block probing for up to bench_timeout.
+        # Cheapest-and-most-informative first: the round-4 window spent
+        # 40 min on the sweep and closed before configs/e2e/profile got a
+        # turn, so the sweep now goes LAST.
         _run_tool("bench_configs.py",
                   os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"),
                   bench_timeout, "window_configs")
         _run_tool("bench_e2e.py",
                   os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json"),
                   bench_timeout / 2, "window_e2e")
-        # LAST and once only: a PROFILED run, never banked (tracer
-        # overhead must not deflate the headline artifact) — captures
-        # the first real-TPU jax.profiler trace.  Ordered after the
-        # artifact banks so a short window feeds evidence before
-        # diagnostics.
-        profile_dir = os.path.join(REPO, "profiles", f"{ROUND_TAG}_tpu")
-        if os.path.isdir(profile_dir):
+        # A PROFILED run, never banked (tracer overhead must not deflate
+        # the headline artifact) — captures the first real-TPU
+        # jax.profiler trace.  Ordered after the artifact banks so a
+        # short window feeds evidence before diagnostics.
+        if profile_done:
             _log(event="window_profile", ok=True, detail="already captured")
         else:
             _run_window_bench(bench_timeout / 2,
                               ["--no-sweep", "--profile", profile_dir],
                               "window_profile", bank=False)
+        # The on-device max-ops sweep is the longest artifact by far
+        # (>40 min on the round-4 window — it outlived the window); chase
+        # it only after everything cheaper is banked.
+        if sweep_done:
+            _log(event="window_bench_full", ok=True,
+                 detail="device sweep already banked; kept")
+        else:
+            _run_window_bench(bench_timeout, [], "window_bench_full")
     return banked
 
 
@@ -217,18 +273,10 @@ def main() -> int:
         _log(ok=p.ok, is_device=p.is_device, platform=p.platform,
              detail=p.detail[:300])
         if p.is_device and not args.no_bench:
-            # re-bench when there is no FRESH capture: the repo (and this
-            # gitignored artifact) persists across rounds, so "exists"
-            # alone would let a previous round's file suppress this
-            # round's only seize; a ≤3 h-old capture is left alone (the
-            # first full-scale device artifact is the round's prize,
-            # later windows are logged by the probes either way)
-            try:
-                age = time.time() - os.path.getmtime(WINDOW_ARTIFACT)
-            except OSError:
-                age = float("inf")
-            if age > 3 * 3600.0:
-                _seize_window(args.bench_timeout)
+            # freshness of the headline is judged inside _seize_window so
+            # a banked headline never suppresses the still-missing
+            # configs/e2e/profile/sweep upgrades
+            _seize_window(args.bench_timeout)
         if args.once:
             return 0 if p.is_device else 1
         time.sleep(max(1.0, args.interval - (time.time() - t0)))
