@@ -94,6 +94,10 @@ WINDOW_ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 WINDOW_MAX_AGE_S = 14 * 3600.0  # a round is ~12 h; reject older leftovers
 
+# single source for round-stamped artifact names (tools/probe_watcher.py
+# keeps its own ROUND_TAG for the committed window copies — bump both)
+ROUND_TAG = "r04"
+
 
 def _load_window_artifact() -> dict | None:
     try:
@@ -117,15 +121,77 @@ def _load_window_artifact() -> dict | None:
     return result
 
 
+def best_scale_batch(min_gain: float = 1.2, dirpath: str | None = None):
+    """Best lockstep batch width from a DEVICE-captured bench_scale
+    artifact (tools/bench_scale.py), or None.
+
+    The first real-TPU window showed per-trip latency dominating the
+    chunked driver at 4096 lanes; wider batches amortize it.  Adoption
+    discipline: only a width the scale scan actually measured on the real
+    chip with ZERO wrong verdicts and ≥ ``min_gain`` × the 4096-row rate
+    is adopted (the gain gate also bounds the adopted headline's
+    wall-clock, which matters inside short healing windows).  Returns
+    ``(batch, rate)`` or None."""
+    here = dirpath or os.path.dirname(os.path.abspath(__file__))
+    rows = None
+    for name in ("BENCH_SCALE_TPU_WINDOW.json",
+                 f"BENCH_SCALE_TPU_{ROUND_TAG}.json"):
+        path = os.path.join(here, name)
+        try:
+            with open(path) as f:
+                lines = [json.loads(ln) for ln in f if ln.strip()]
+            age = time.time() - os.path.getmtime(path)
+        except (OSError, ValueError):
+            continue
+        if age > WINDOW_MAX_AGE_S:
+            continue  # a prior round's measurement may not match this
+            # round's kernel; the next window re-scans anyway
+        if not lines or lines[0].get("device_fallback") is not None:
+            continue
+        rows = [r for r in lines[1:]
+                if r.get("wrong") == 0 and "error" not in r
+                and "skipped" not in r and r.get("rate_h_per_s")]
+        if rows:
+            break
+    if not rows:
+        return None
+    base = next((r["rate_h_per_s"] for r in rows if r["batch"] == 4096),
+                None)
+    # a single timed rep at the adopted width must stay window-sized:
+    # reps floors at 1, so batch/rate IS the timed wall-clock (the
+    # round-4 window budget was ~116 s; 300 s still fits bench_timeout/2
+    # with compile + host-oracle phases around it)
+    rows = [r for r in rows
+            if r["batch"] / r["rate_h_per_s"] <= 300.0]
+    if not rows:
+        return None
+    best = max(rows, key=lambda r: r["rate_h_per_s"])
+    if best["batch"] == 4096:
+        return None  # nothing better than the default
+    if base is None or best["rate_h_per_s"] < min_gain * base:
+        return None  # no validated baseline, or win below the gate
+    return int(best["batch"]), float(best["rate_h_per_s"])
+
+
 def _scale(on_tpu: bool) -> dict:
     """Benchmark scale: full on the real chip, reduced on the CPU fallback
     (the lockstep vmapped while-loop is orders of magnitude slower on host —
     an unreduced run would take hours, which is its own kind of hang)."""
     if on_tpu:
-        return dict(n_unique=512, device_batch=4096, cpu_sample=64,
-                    cpu_timebox_s=90.0, reps=3, budget=2_000)
+        sc = dict(n_unique=512, device_batch=4096, cpu_sample=64,
+                  cpu_timebox_s=90.0, reps=3, budget=2_000,
+                  batch_from_scale=None)
+        adopted = best_scale_batch()
+        if adopted is not None:
+            sc["device_batch"] = adopted[0]
+            # keep timed lane-work roughly constant: 3 reps × 4096 lanes
+            # was the round-4 window budget
+            sc["reps"] = max(1, (3 * 4096) // adopted[0])
+            sc["batch_from_scale"] = adopted[0]
+        return sc
     return dict(n_unique=128, device_batch=256, cpu_sample=24,
-                cpu_timebox_s=45.0, reps=1, budget=2_000)
+                cpu_timebox_s=45.0, reps=1, budget=2_000,
+                batch_from_scale=None)
 
 
 def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
@@ -280,7 +346,7 @@ def build_corpus(spec, n_unique: int):
                   seed_prefix="bench")
 
 
-SWEEP_FILE = "BENCH_SWEEP_r04.json"
+SWEEP_FILE = f"BENCH_SWEEP_{ROUND_TAG}.json"
 
 
 def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
@@ -352,6 +418,8 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
     # count (the property layer resolves them via the oracle — SURVEY.md §7
     # hard-parts #5), so the headline rate only counts decided verdicts.
     backend = JaxTPU(spec, budget=sc["budget"])
+    # a scale-artifact-adopted width needs the split threshold raised too
+    backend.MAX_BATCH = max(backend.MAX_BATCH, sc["device_batch"])
     if on_tpu:
         # healing windows are short and first-compiles are the enemy: two
         # chunk stages instead of four halves the executables per bucket
@@ -453,6 +521,7 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
             "device_fallback": None if on_tpu else "cpu",
             "tpu_probe": probe_detail[:160],
             "device_batch": sc["device_batch"],
+            "batch_from_scale": sc.get("batch_from_scale"),
             "device_budget": sc["budget"],
             # the measured configuration, for cross-round comparability
             # (the TPU path coarsens the schedule to halve window compiles)

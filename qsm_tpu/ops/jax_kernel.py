@@ -86,13 +86,16 @@ SUCCESS = 1  # == Verdict.LINEARIZABLE
 FAILURE = 2
 BUDGET = 3
 
-_BATCH_BUCKETS = (8, 64, 256, 1024, 4096)
+_BATCH_BUCKETS = (8, 64, 256, 1024, 4096, 16384, 65536)
 
 
 def _batch_bucket(b: int) -> int:
     """Smallest bucket holding ``b`` rows; callers split batches larger than
-    the top bucket into top-bucket chunks so the compile cache stays bounded
-    at len(_BATCH_BUCKETS) executables per op bucket."""
+    ``JaxTPU.MAX_BATCH`` into chunks that size so the compile cache stays
+    bounded.  The buckets above 4096 exist for the real chip, where the
+    first banked window (BENCH_TPU_r04.json) showed per-trip latency, not
+    lane width, dominating the lockstep loop — wider batches amortize it;
+    they are reachable only through an explicitly raised ``MAX_BATCH``."""
     for s in _BATCH_BUCKETS:
         if b <= s:
             return s
@@ -440,7 +443,16 @@ class JaxTPU:
     # crashes the worker.  Model it as a per-batch-bucket slot cap: the two
     # verified points stand as-is; unverified buckets are capped so that
     # batch*slots <= 1<<17, the largest product seen safe at batch >= 256.
-    MAX_SLOTS_FOR_BATCH = {8: 8192, 64: 4096, 256: 512, 1024: 128, 4096: 32}
+    MAX_SLOTS_FOR_BATCH = {8: 8192, 64: 4096, 256: 512, 1024: 128, 4096: 32,
+                           16384: 8, 65536: 2}
+    # Split threshold for check_histories: batches beyond this run as
+    # separate sequential device calls.  4096 is the round-1..4 behavior;
+    # tools/bench_scale.py raises it per-backend to measure whether wider
+    # lockstep batches amortize the per-trip latency the first real-TPU
+    # window exposed (5 ms/trip at 4096 lanes — BENCH_TPU_r04.json), and
+    # bench.py adopts a raised value only from a device-validated scale
+    # artifact (zero wrong verdicts on the same corpus).
+    MAX_BATCH = 4096
     # Chunk escalation: small first chunks harvest the easy majority with
     # little lockstep waste; later chunks grow so the hard tail is not
     # host-sync bound.  The last entry repeats until budget exhaustion.
@@ -774,7 +786,7 @@ class JaxTPU:
         """Statuses for a flat batch; with ``collect_chosen`` also the
         final ``chosen`` stack per lane (the linearization witness for
         SUCCESS lanes — :meth:`check_witness`)."""
-        top = _BATCH_BUCKETS[-1]
+        top = min(self.MAX_BATCH, _BATCH_BUCKETS[-1])
         if len(flat) > top:
             parts = [
                 self._run_device(

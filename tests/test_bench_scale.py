@@ -1,0 +1,142 @@
+"""Batch-width scaling: the wide batch buckets behind ``JaxTPU.MAX_BATCH``
+and the bench.py adoption rules for a device-captured bench_scale artifact
+(tools/bench_scale.py; motivated by BENCH_TPU_r04.json — per-trip latency
+dominated the first real-TPU window, wider lockstep batches amortize it)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_scale(dirpath, rows, fallback=None):
+    lines = [{"artifact": "bench_scale", "device_fallback": fallback}]
+    lines += rows
+    with open(os.path.join(dirpath, "BENCH_SCALE_TPU_WINDOW.json"),
+              "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+
+
+def test_no_artifact_means_no_adoption(tmp_path):
+    bench = _load_bench()
+    assert bench.best_scale_batch(dirpath=str(tmp_path)) is None
+
+
+def test_cpu_fallback_artifact_never_adopted(tmp_path):
+    bench = _load_bench()
+    _write_scale(tmp_path, [
+        {"batch": 4096, "rate_h_per_s": 100.0, "wrong": 0},
+        {"batch": 65536, "rate_h_per_s": 900.0, "wrong": 0},
+    ], fallback="cpu")
+    assert bench.best_scale_batch(dirpath=str(tmp_path)) is None
+
+
+def test_wrong_verdict_rows_are_disqualified(tmp_path):
+    bench = _load_bench()
+    _write_scale(tmp_path, [
+        {"batch": 4096, "rate_h_per_s": 100.0, "wrong": 0},
+        {"batch": 65536, "rate_h_per_s": 900.0, "wrong": 3},
+    ])
+    assert bench.best_scale_batch(dirpath=str(tmp_path)) is None
+
+
+def test_gain_gate_keeps_default_on_marginal_wins(tmp_path):
+    bench = _load_bench()
+    _write_scale(tmp_path, [
+        {"batch": 4096, "rate_h_per_s": 100.0, "wrong": 0},
+        {"batch": 16384, "rate_h_per_s": 110.0, "wrong": 0},
+    ])
+    assert bench.best_scale_batch(dirpath=str(tmp_path)) is None
+
+
+def test_no_valid_4096_baseline_means_no_adoption(tmp_path):
+    bench = _load_bench()
+    _write_scale(tmp_path, [
+        {"batch": 4096, "rate_h_per_s": 100.0, "wrong": 2},
+        {"batch": 16384, "rate_h_per_s": 400.0, "wrong": 0},
+    ])
+    assert bench.best_scale_batch(dirpath=str(tmp_path)) is None
+
+
+def test_window_sized_wall_clock_gate(tmp_path):
+    """A width whose single timed rep would exceed ~300 s is not adopted
+    even if it is the fastest row — the next healing window must fit the
+    re-bench; a slower-but-window-sized width still wins."""
+    bench = _load_bench()
+    _write_scale(tmp_path, [
+        {"batch": 4096, "rate_h_per_s": 100.0, "wrong": 0},
+        {"batch": 16384, "rate_h_per_s": 300.0, "wrong": 0},
+        {"batch": 65536, "rate_h_per_s": 210.0, "wrong": 0},  # 312 s/rep
+    ])
+    assert bench.best_scale_batch(dirpath=str(tmp_path)) == (16384, 300.0)
+
+
+def test_stale_artifact_rejected(tmp_path):
+    bench = _load_bench()
+    _write_scale(tmp_path, [
+        {"batch": 4096, "rate_h_per_s": 100.0, "wrong": 0},
+        {"batch": 65536, "rate_h_per_s": 900.0, "wrong": 0},
+    ])
+    path = tmp_path / "BENCH_SCALE_TPU_WINDOW.json"
+    old = bench.time.time() - bench.WINDOW_MAX_AGE_S - 60
+    os.utime(path, (old, old))
+    assert bench.best_scale_batch(dirpath=str(tmp_path)) is None
+
+
+def test_validated_wider_batch_is_adopted(tmp_path):
+    bench = _load_bench()
+    _write_scale(tmp_path, [
+        {"batch": 4096, "rate_h_per_s": 100.0, "wrong": 0},
+        {"batch": 16384, "rate_h_per_s": 350.0, "wrong": 0},
+        {"batch": 65536, "rate_h_per_s": 900.0, "wrong": 0,
+         "undecided": 4},
+        {"batch": 262144, "error": "RESOURCE_EXHAUSTED: oom"},
+    ])
+    assert bench.best_scale_batch(dirpath=str(tmp_path)) == (65536, 900.0)
+
+
+def test_raised_max_batch_matches_split_path():
+    """The same flat batch decided through one wide bucket (MAX_BATCH
+    raised) and through the default 4096-split path must agree verdict for
+    verdict — the wide buckets change padding, never semantics."""
+    from qsm_tpu.models.register import RegisterSpec
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    import qsm_tpu as q
+
+    spec = RegisterSpec(n_values=4)
+    base = [
+        q.overlapping_history(rows) for rows in (
+            [(0, 1, 3, 0, 0, 1), (1, 0, 0, 3, 2, 3)],   # seq write, read ok
+            [(0, 1, 2, 0, 0, 3), (1, 0, 0, 1, 1, 2)],   # racy read -> bad
+            [(0, 1, 1, 0, 0, 1), (1, 1, 2, 0, 0, 1),    # overlapping writes
+             (0, 0, 0, 2, 2, 3)],
+        )
+    ]
+    flat = (base * ((4100 + len(base) - 1) // len(base)))[:4100]
+
+    wide = JaxTPU(spec, budget=2_000)
+    wide.MAX_BATCH = 16384
+    wide_verdicts = np.asarray(wide.check_histories(spec, flat))
+    assert wide.batches_run >= 1
+
+    split = JaxTPU(spec, budget=2_000)  # default MAX_BATCH=4096 -> 2 calls
+    split_verdicts = np.asarray(split.check_histories(spec, flat))
+
+    assert (wide_verdicts == split_verdicts).all()
+    # at least one lane of each verdict kind so the parity is non-vacuous
+    assert set(np.unique(split_verdicts)) >= {0, 1}

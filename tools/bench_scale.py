@@ -1,0 +1,138 @@
+"""Batch-width scaling artifact — does widening the lockstep batch
+amortize the per-trip latency the first real-TPU window exposed?
+
+BENCH_TPU_r04.json (the round-4 banked window) showed the chunked device
+driver at 105.6 h/s with batch 4096: ~7.6k while-loop trips per timed
+rep at ~5 ms/trip, i.e. per-trip LATENCY, not lane width, dominates on
+the axon tunnel (a 1-core CPU pays 3.6 ms/trip on a 256-lane batch of
+the same kernel).  If per-trip cost is flat in width, throughput scales
+with batch until HBM bandwidth binds — this tool measures exactly that
+on the real chip: histories/sec at batch 4096 / 16384 / 65536 on the
+bench.py CAS corpus, with full verdict parity against the memoised host
+oracle on every lane.
+
+Each row is measured with a fresh ``JaxTPU`` whose ``MAX_BATCH`` is
+raised to the row's batch (the buckets above 4096 exist only for this —
+ops/jax_kernel.py).  Rows are written incrementally (header first, then
+one JSON line per batch as it lands) so a window that closes mid-scan
+still leaves the smaller batches' measurements in the artifact.
+
+bench.py reads the best zero-wrong-verdict row of a DEVICE-captured copy
+of this artifact and adopts its batch for the headline; the watcher
+(tools/probe_watcher.py) banks it during a window and re-benches the
+headline when the best batch beats the banked headline's.
+
+Probe-guarded exactly like bench.py.  Usage:
+
+    python tools/bench_scale.py [--force-cpu] [--out BENCH_SCALE_rN.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+# CPU-fallback rows use a reduced width ladder: the vmapped while-loop is
+# orders of magnitude slower on host, and the point of a fallback run is
+# pipeline validation, not measurement.
+DEVICE_BATCHES = (4096, 16384, 65536)
+CPU_BATCHES = (256, 1024)
+TIME_BOX_S = 900.0  # stop starting new rows beyond this much measuring
+
+
+def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
+    from bench import build_corpus
+    from qsm_tpu.models import CasSpec
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+
+    spec = CasSpec()
+    n_unique = 512 if on_tpu else 128
+    corpus = build_corpus(spec, n_unique)
+    memo = WingGongCPU(memo=True)
+    memo_verdicts = np.asarray(memo.check_histories(spec, corpus))
+
+    lines = [{"artifact": "bench_scale", "corpus_unique": len(corpus),
+              **header}]
+    with open(out_path, "w") as f:
+        f.write(json.dumps(lines[0]) + "\n")
+        f.flush()
+
+    t_start = time.perf_counter()
+    for batch in (DEVICE_BATCHES if on_tpu else CPU_BATCHES):
+        if time.perf_counter() - t_start > TIME_BOX_S:
+            row = {"batch": batch, "skipped": "time box exhausted"}
+            lines.append(row)
+            f = open(out_path, "a")
+            f.write(json.dumps(row) + "\n")
+            f.close()
+            continue
+        reps = (batch + len(corpus) - 1) // len(corpus)
+        device_corpus = (corpus * reps)[:batch]
+        tiled_memo = np.tile(memo_verdicts, reps)[:batch]
+        row = {"batch": batch}
+        try:
+            backend = JaxTPU(spec, budget=2_000)
+            backend.MAX_BATCH = batch
+            if on_tpu:
+                backend.CHUNK_SCHEDULE = (2048, 65536)
+            t0 = time.perf_counter()
+            backend.check_histories(spec, device_corpus)  # compile + warm
+            row["warm_s"] = round(time.perf_counter() - t0, 2)
+            backend.lockstep_cost = 0
+            backend.rounds_run = 0
+            backend.host_sync_s = 0.0
+            t0 = time.perf_counter()
+            verdicts = np.asarray(
+                backend.check_histories(spec, device_corpus))
+            wall = time.perf_counter() - t0
+            undecided = int(np.sum(verdicts == 2))
+            both = (verdicts != 2) & (tiled_memo != 2)
+            row.update({
+                "wall_s": round(wall, 3),
+                "rate_h_per_s": round((batch - undecided) / wall, 1),
+                "undecided": undecided,
+                "wrong": int(np.sum(both
+                             & (verdicts != tiled_memo))),
+                "lockstep_iters": backend.lockstep_cost,
+                "rounds": backend.rounds_run,
+                "host_sync_s": round(backend.host_sync_s, 3),
+                "compactions": backend.compactions,
+                "rescued": backend.rescued,
+            })
+        except Exception as e:  # noqa: BLE001 — a failed width must not
+            # lose the smaller widths' rows (OOM at 65536 is a real
+            # possible outcome this tool exists to discover)
+            row["error"] = f"{type(e).__name__}: {e}"[:300]
+        lines.append(row)
+        f = open(out_path, "a")
+        f.write(json.dumps(row) + "\n")
+        f.close()
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/root/repo/BENCH_SCALE_r04.json")
+    ap.add_argument("--force-cpu", action="store_true")
+    ap.add_argument("--probe-timeout", type=float, default=45.0)
+    args = ap.parse_args(argv)
+
+    from qsm_tpu.utils.device import probe_or_force_cpu
+
+    on_tpu, _detail, header = probe_or_force_cpu(args.force_cpu,
+                                                 args.probe_timeout)
+    lines = run_scale(on_tpu, args.out, header)
+    for ln in lines:
+        print(json.dumps(ln))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

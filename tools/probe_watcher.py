@@ -51,6 +51,8 @@ COMMITTED_COPIES = {
         os.path.join(REPO, f"BENCH_CONFIGS_TPU_{ROUND_TAG}.json"),
     os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json"):
         os.path.join(REPO, f"BENCH_E2E_TPU_{ROUND_TAG}.json"),
+    os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json"):
+        os.path.join(REPO, f"BENCH_SCALE_TPU_{ROUND_TAG}.json"),
 }
 
 
@@ -124,16 +126,28 @@ def _run_window_bench(bench_timeout: float, extra_args, label: str,
     return bool(on_device)
 
 
-def _run_tool(script: str, out_path: str, timeout: float, label: str
-              ) -> None:
-    """Bank one auxiliary artifact (bench_configs / bench_e2e) from the
-    open window.  Device-capture discipline mirrors _run_window_bench:
-    a previously banked REAL-device artifact is never clobbered by a
-    CPU-fallback run (the tool writes to a temp path, promoted only when
-    its header shows no fallback), ``ok`` in the log means "device
-    capture", and the window is re-probed first so a closed window costs
-    one bounded probe instead of a full CPU-fallback workload."""
-    if os.path.exists(out_path):
+def _tool_rows(path: str) -> int:
+    """Non-header JSONL rows of a banked tool artifact (0 on any trouble)."""
+    try:
+        with open(path) as f:
+            return max(0, sum(1 for ln in f if ln.strip()) - 1)
+    except OSError:
+        return 0
+
+
+def _run_tool(script: str, out_path: str, timeout: float, label: str,
+              min_rows: int = 0) -> None:
+    """Bank one auxiliary artifact (bench_configs / bench_e2e /
+    bench_scale) from the open window.  Device-capture discipline mirrors
+    _run_window_bench: a previously banked REAL-device artifact is never
+    clobbered by a CPU-fallback run (the tool writes to a temp path,
+    promoted only when its header shows no fallback), ``ok`` in the log
+    means "device capture", and the window is re-probed first so a closed
+    window costs one bounded probe instead of a full CPU-fallback
+    workload.  ``min_rows``: a banked artifact with fewer data rows (a
+    promoted partial from a closed window) does NOT suppress a re-run —
+    the next window finishes the scan."""
+    if os.path.exists(out_path) and _tool_rows(out_path) >= min_rows:
         _log(event=label, ok=True, detail="already banked; kept")
         return
     p = probe_default_backend(30)
@@ -148,8 +162,26 @@ def _run_tool(script: str, out_path: str, timeout: float, label: str
              "--probe-timeout", "45", "--out", tmp],
             capture_output=True, text=True, timeout=timeout, cwd=REPO)
     except subprocess.TimeoutExpired:
-        _log(event=label, ok=False,
-             detail=f"exceeded {timeout:.0f}s (window closed mid-run?)")
+        # tools that write incrementally (bench_scale) may have banked
+        # usable rows before the window closed — promote a partial
+        # device-headed artifact rather than discarding measurements
+        partial = False
+        try:
+            with open(tmp) as f:
+                partial = json.loads(
+                    f.readline()).get("device_fallback") is None
+        except (OSError, ValueError):
+            pass
+        # never clobber an earlier bank that holds MORE device rows —
+        # progress must be monotonic across flickering windows
+        if partial and _tool_rows(tmp) <= _tool_rows(out_path):
+            partial = False
+        if partial:
+            os.replace(tmp, out_path)
+            _bank_committed_copy(out_path)
+        _log(event=label, ok=partial,
+             detail=f"exceeded {timeout:.0f}s (window closed mid-run?)"
+                    + ("; partial rows promoted" if partial else ""))
         return
     on_device = False
     try:
@@ -214,8 +246,10 @@ def _seize_window(bench_timeout: float) -> bool:
                 "device_fallback", "absent") is None
     except (OSError, ValueError):
         pass
+    scale_done = _tool_rows(
+        os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json")) >= 3
     if (headline_fresh and configs_done and e2e_done and profile_done
-            and sweep_done):
+            and sweep_done and scale_done):
         return True  # everything banked: a healthy tunnel cycle is silent
     if headline_fresh:
         _log(event="window_bench_headline", ok=True,
@@ -237,6 +271,33 @@ def _seize_window(bench_timeout: float) -> bool:
         _run_tool("bench_e2e.py",
                   os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json"),
                   bench_timeout / 2, "window_e2e")
+        # Batch-width scaling scan (tools/bench_scale.py): measures
+        # whether wider lockstep batches amortize the per-trip latency
+        # the first banked window exposed.  min_rows keeps a promoted
+        # partial (window closed mid-scan) from suppressing completion.
+        _run_tool("bench_scale.py",
+                  os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json"),
+                  bench_timeout, "window_scale", min_rows=3)
+        # If the scan validated a better width than the banked headline
+        # used, the headline is stale regardless of age: re-bench so THIS
+        # window banks the improved configuration (bench.py adopts the
+        # scale-validated batch automatically).
+        try:
+            from bench import best_scale_batch
+            adopted = best_scale_batch()
+        except Exception:  # noqa: BLE001 — advisory only
+            adopted = None
+        cur_batch = None
+        try:
+            with open(WINDOW_ARTIFACT) as f:
+                cur_batch = json.load(f).get("extras", {}).get(
+                    "device_batch")
+        except (OSError, ValueError):
+            pass
+        if adopted is not None and cur_batch is not None \
+                and adopted[0] != cur_batch:
+            _run_window_bench(bench_timeout / 2, ["--no-sweep"],
+                              "window_bench_rescaled")
         # A PROFILED run, never banked (tracer overhead must not deflate
         # the headline artifact) — captures the first real-TPU
         # jax.profiler trace.  Ordered after the artifact banks so a
