@@ -150,7 +150,8 @@ def best_scale_batch(min_gain: float = 1.2, dirpath: str | None = None):
             continue
         rows = [r for r in lines[1:]
                 if r.get("wrong") == 0 and "error" not in r
-                and "skipped" not in r and r.get("rate_h_per_s")]
+                and "skipped" not in r and "variant" not in r
+                and r.get("rate_h_per_s")]
         if rows:
             break
     if not rows:
@@ -194,8 +195,18 @@ def _scale(on_tpu: bool) -> dict:
                 batch_from_scale=None)
 
 
+def _sweep_cells_measured(sw: dict) -> int:
+    """Bucket cells a sweep actually measured (its coverage, for the
+    monotonic keep-the-larger-device-capture rule)."""
+    n = 0
+    for backends in sw.get("cells", {}).values():
+        for cell in backends.values():
+            n += sum(1 for k in cell if k.isdigit())
+    return n
+
+
 def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
-              box_s: float = 60.0) -> dict:
+              box_s: float = 60.0, total_box_s: float = 1500.0) -> dict:
     """Measure "max ops solved < 60 s" (BASELINE.json:2 second metric;
     VERDICT.md round 2, "Next round" #4): for CAS and queue, scan op
     buckets 12→128 (96/128 exceed the reference's largest config) per
@@ -303,6 +314,8 @@ def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
 
     cells: dict = {}
     solved: dict = {}
+    deadline = time.perf_counter() + total_box_s
+    hit_deadline = False
     for cname, (mk_spec, suts, backends) in configs.items():
         spec = mk_spec()
         corpora = {}
@@ -312,6 +325,20 @@ def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
             cells[cname][bname] = {}
             best = 0
             for ops in buckets:
+                # global deadline: the round-4 on-device sweep ran
+                # >40 min — it must never starve the headline line of
+                # the driver's end-of-round run (or outlive a healing
+                # window).  Device cells are unbounded once started
+                # (first-compile + two full batch passes), so they also
+                # need a LOOK-AHEAD margin; host cells self-timebox at
+                # box_s.  Remaining cells are marked, not silently
+                # absent.
+                margin = (240.0 if bname in ("device", "segdc_device",
+                                             "auto_device") else 0.0)
+                if time.perf_counter() > deadline - margin:
+                    cells[cname][bname]["deadline_skipped"] = True
+                    hit_deadline = True
+                    break
                 if ops > caps.get(bname, 1 << 30):
                     # past this backend's native coverage — mark the cap
                     # so "stopped at 64" is distinguishable from "failed
@@ -334,7 +361,8 @@ def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
                     break  # monotone: larger buckets only get harder
             solved[cname][bname] = best
     return {"solved": solved, "cells": cells, "sample": n_sample,
-            "box_s": box_s, "pids": 8}
+            "box_s": box_s, "pids": 8,
+            "total_box_s": total_box_s, "hit_deadline": hit_deadline}
 
 
 def build_corpus(spec, n_unique: int):
@@ -469,6 +497,11 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
         try:
             sw = run_sweep(on_tpu)
             sweep_extras = {"max_ops_solved_60s": sw["solved"]}
+            if sw.get("hit_deadline"):
+                # solved=0 rows past the cut would read as "failed the
+                # 12-ops bucket"; the marker on the headline line keeps
+                # truncation distinguishable from regression
+                sweep_extras["sweep_truncated"] = True
             path = sweep_file or os.path.join(
                 os.path.dirname(os.path.abspath(__file__)), SWEEP_FILE)
             sw["device"] = str(jax.devices()[0])
@@ -476,13 +509,21 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
             sw["captured_iso"] = datetime.datetime.now(
                 datetime.timezone.utc).isoformat(timespec="seconds")
             # a real-device sweep banked earlier in the round must never
-            # be clobbered by a later CPU-fallback run
+            # be clobbered by a later CPU-fallback run; among device
+            # captures, coverage is monotonic — a truncated rerun never
+            # replaces a capture that measured MORE cells
             keep_existing = False
-            if not on_tpu:
+            if not on_tpu or sw.get("hit_deadline"):
                 try:
                     with open(path) as f:
-                        keep_existing = (json.load(f).get("device_fallback")
-                                         is None)
+                        prev = json.load(f)
+                    prev_device = prev.get("device_fallback") is None
+                    if not on_tpu:
+                        keep_existing = prev_device
+                    else:
+                        keep_existing = (prev_device
+                                         and _sweep_cells_measured(prev)
+                                         >= _sweep_cells_measured(sw))
                 except (OSError, ValueError):
                     pass
             if not keep_existing:
@@ -490,9 +531,9 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
                     json.dump(sw, f, indent=1)
             sweep_extras["sweep_file"] = os.path.basename(path)
             if keep_existing:
-                # the referenced artifact is an EARLIER real-device run;
-                # this line's solved summary is from the current
-                # CPU-fallback sweep — mark the provenance split
+                # the referenced artifact is an EARLIER (more complete
+                # and/or real-device) run; this line's solved summary is
+                # from the CURRENT sweep — mark the provenance split
                 sweep_extras["sweep_file_is_earlier_device_run"] = True
         except Exception as e:  # noqa: BLE001 — the headline must survive
             sweep_extras = {"sweep_error": f"{type(e).__name__}: {e}"}

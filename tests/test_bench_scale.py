@@ -106,6 +106,10 @@ def test_validated_wider_batch_is_adopted(tmp_path):
         {"batch": 65536, "rate_h_per_s": 900.0, "wrong": 0,
          "undecided": 4},
         {"batch": 262144, "error": "RESOURCE_EXHAUSTED: oom"},
+        # diagnostic variant rows never drive adoption, even when their
+        # decided-lane rate is the fastest number in the artifact
+        {"batch": 65536, "variant": "budget2k", "rate_h_per_s": 5000.0,
+         "wrong": 0, "undecided": 30000},
     ])
     assert bench.best_scale_batch(dirpath=str(tmp_path)) == (65536, 900.0)
 

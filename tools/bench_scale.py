@@ -58,36 +58,53 @@ def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
     memo = WingGongCPU(memo=True)
     memo_verdicts = np.asarray(memo.check_histories(spec, corpus))
 
+    # native host rate on this corpus — the denominator for the derived
+    # hybrid number (device majority + cpp tail) the budget2k variant
+    # enables
+    cpp_rate = None
+    try:
+        from qsm_tpu.native import CppOracle, native_available
+        if native_available():
+            cpp = CppOracle(spec)
+            cpp.check_histories(spec, corpus)  # build + table compile
+            t0 = time.perf_counter()
+            cpp.check_histories(spec, corpus)
+            if cpp.native_histories > 0:
+                cpp_rate = round(
+                    len(corpus) / (time.perf_counter() - t0), 1)
+    except Exception:  # noqa: BLE001 — optional fast path
+        pass
+
     lines = [{"artifact": "bench_scale", "corpus_unique": len(corpus),
-              **header}]
+              "cpp_rate_h_per_s": cpp_rate, **header}]
     with open(out_path, "w") as f:
         f.write(json.dumps(lines[0]) + "\n")
         f.flush()
 
-    t_start = time.perf_counter()
-    for batch in (DEVICE_BATCHES if on_tpu else CPU_BATCHES):
-        if time.perf_counter() - t_start > TIME_BOX_S:
-            row = {"batch": batch, "skipped": "time box exhausted"}
-            lines.append(row)
-            f = open(out_path, "a")
-            f.write(json.dumps(row) + "\n")
-            f.close()
-            continue
+    def measure(batch, variant=None, schedule=None, backend_kw=None):
         reps = (batch + len(corpus) - 1) // len(corpus)
         device_corpus = (corpus * reps)[:batch]
         tiled_memo = np.tile(memo_verdicts, reps)[:batch]
         row = {"batch": batch}
+        if variant:
+            row["variant"] = variant
         try:
-            backend = JaxTPU(spec, budget=2_000)
+            backend = JaxTPU(spec, budget=2_000, **(backend_kw or {}))
             backend.MAX_BATCH = batch
-            if on_tpu:
+            if schedule is not None:
+                backend.CHUNK_SCHEDULE = schedule
+            elif on_tpu:
                 backend.CHUNK_SCHEDULE = (2048, 65536)
             t0 = time.perf_counter()
             backend.check_histories(spec, device_corpus)  # compile + warm
             row["warm_s"] = round(time.perf_counter() - t0, 2)
+            # zero EVERY per-run counter the row reports, or the stats
+            # mix the warm pass with the timed pass
             backend.lockstep_cost = 0
             backend.rounds_run = 0
             backend.host_sync_s = 0.0
+            backend.compactions = 0
+            backend.rescued = 0
             t0 = time.perf_counter()
             verdicts = np.asarray(
                 backend.check_histories(spec, device_corpus))
@@ -110,10 +127,60 @@ def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
             # lose the smaller widths' rows (OOM at 65536 is a real
             # possible outcome this tool exists to discover)
             row["error"] = f"{type(e).__name__}: {e}"[:300]
+        return row
+
+    def emit(row):
         lines.append(row)
         f = open(out_path, "a")
         f.write(json.dumps(row) + "\n")
         f.close()
+
+    t_start = time.perf_counter()
+    widths = DEVICE_BATCHES if on_tpu else CPU_BATCHES
+    for batch in widths:
+        if time.perf_counter() - t_start > TIME_BOX_S:
+            emit({"batch": batch, "skipped": "time box exhausted"})
+            continue
+        emit(measure(batch))
+
+    # Diagnostic variants at the widest healthy width — they separate the
+    # two cost hypotheses the banked window can't distinguish (per-TRIP
+    # latency vs per-chunk-CALL dispatch) and locate the budget knee:
+    #   oneshot: a single 65536-iteration chunk = fewest device calls,
+    #            most lockstep waste; wins iff call dispatch dominates.
+    #   budget2k: no mid/rescue budget = straggler lanes report
+    #            BUDGET_EXCEEDED instead of burning tail trips; the
+    #            decided-lane rate shows what the tail costs the batch.
+    # best_scale_batch ignores variant rows by construction.
+    good = [r for r in lines[1:]
+            if r.get("wrong") == 0 and "error" not in r
+            and "skipped" not in r and r.get("rate_h_per_s")]
+    if good and time.perf_counter() - t_start > TIME_BOX_S:
+        # marked, not silently absent — and the watcher's min_rows gate
+        # counts rows, so the marker alone does not fake completeness;
+        # a future window re-runs the scan and gets the diagnostics
+        emit({"variant": "diagnostics", "skipped": "time box exhausted"})
+    if good and time.perf_counter() - t_start <= TIME_BOX_S:
+        bstar = max(good, key=lambda r: r["rate_h_per_s"])["batch"]
+        emit(measure(bstar, variant="oneshot", schedule=(65536,)))
+        if time.perf_counter() - t_start <= TIME_BOX_S:
+            b2k = measure(bstar, variant="budget2k",
+                          backend_kw=dict(mid_budget=0, rescue_budget=0))
+            emit(b2k)
+            # Derived, not separately measured: the hybrid execution plan
+            # (device decides the easy majority under the 2k budget, the
+            # BUDGET_EXCEEDED tail goes to the native host checker — the
+            # property layer's oracle-resolution contract, priced).
+            if (cpp_rate and "error" not in b2k
+                    and b2k.get("wrong") == 0):
+                wall = b2k["wall_s"] + b2k["undecided"] / cpp_rate
+                emit({"batch": bstar, "variant": "hybrid_derived",
+                      "wall_s": round(wall, 3),
+                      "rate_h_per_s": round(bstar / wall, 1),
+                      "from": "budget2k.wall_s + undecided/cpp_rate",
+                      "undecided": 0, "wrong": 0})
+        else:
+            emit({"variant": "budget2k", "skipped": "time box exhausted"})
     return lines
 
 

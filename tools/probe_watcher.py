@@ -127,12 +127,26 @@ def _run_window_bench(bench_timeout: float, extra_args, label: str,
 
 
 def _tool_rows(path: str) -> int:
-    """Non-header JSONL rows of a banked tool artifact (0 on any trouble)."""
+    """MEASURED non-header JSONL rows of a banked tool artifact (0 on any
+    trouble).  Rows the tool marked ``skipped`` (time box cut) are not
+    measurements — counting them would let a cut scan satisfy min_rows
+    and suppress the re-run that finishes it."""
+    n = 0
     try:
         with open(path) as f:
-            return max(0, sum(1 for ln in f if ln.strip()) - 1)
+            for i, ln in enumerate(f):
+                if not ln.strip():
+                    continue
+                if i == 0:
+                    continue  # header
+                try:
+                    if "skipped" not in json.loads(ln):
+                        n += 1
+                except ValueError:
+                    pass
     except OSError:
         return 0
+    return n
 
 
 def _run_tool(script: str, out_path: str, timeout: float, label: str,
@@ -247,7 +261,7 @@ def _seize_window(bench_timeout: float) -> bool:
     except (OSError, ValueError):
         pass
     scale_done = _tool_rows(
-        os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json")) >= 3
+        os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json")) >= 5
     if (headline_fresh and configs_done and e2e_done and profile_done
             and sweep_done and scale_done):
         return True  # everything banked: a healthy tunnel cycle is silent
@@ -277,7 +291,7 @@ def _seize_window(bench_timeout: float) -> bool:
         # partial (window closed mid-scan) from suppressing completion.
         _run_tool("bench_scale.py",
                   os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json"),
-                  bench_timeout, "window_scale", min_rows=3)
+                  bench_timeout, "window_scale", min_rows=5)
         # If the scan validated a better width than the banked headline
         # used, the headline is stale regardless of age: re-bench so THIS
         # window banks the improved configuration (bench.py adopts the
