@@ -1,0 +1,92 @@
+"""Hybrid device+host backend — the priced version of the property
+layer's oracle-resolution contract.
+
+The first real-TPU capture (BENCH_TPU_r04.json) and its scale-scan
+diagnostics showed the chunked device driver's cost concentrating in the
+straggler tail: with the full rescue ladder the CAS bench corpus runs at
+a fraction of the rate the same batch reaches when stragglers are allowed
+to report BUDGET_EXCEEDED after the base 2k budget (CPU-fallback
+measurement: 228 h/s full-rescue vs 1318 h/s decided-rate with 5.5%
+undecided — tools/bench_scale.py ``budget2k`` variant).  The fastest
+EXACT plan is therefore: device decides the easy majority under a tight
+budget, the tail goes to the best host checker (native C++ oracle when
+the toolchain is present, the memoised Wing–Gong oracle otherwise).
+
+That is exactly what the property layer already does between ``backend``
+and ``oracle`` (core/property.py oracle resolution; SURVEY.md §7
+hard-parts #5) — this module packages it as a plain
+:class:`~qsm_tpu.ops.backend.LineariseBackend` so the CLI, fuzzer, and
+bench tools can run the plan as ONE backend with honest counters.
+
+Verdict contract: bit-identical to running the tail checker alone
+(the device's decided verdicts are parity-pinned against the oracle by
+the kernel test suite; the tail only ever sees lanes the device did not
+decide).  BUDGET_EXCEEDED survives only if the tail itself gives up
+(node-budget cap), which the property layer resolves as before.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.history import History
+from ..core.spec import Spec
+from .backend import Verdict
+
+
+class HybridDevice:
+    """Device majority under a tight budget; host tail for the stragglers.
+
+    ``budget``: per-lane device iteration cap before a lane defers to the
+    tail (the round-4 capture's knee sits near the default 2k).
+    ``tail``: any LineariseBackend; default = native C++ oracle when
+    available, else the memoised Wing–Gong oracle.
+    """
+
+    name = "hybrid_device"
+
+    def __init__(self, spec: Spec, budget: int = 2_000,
+                 tail=None, **device_kw):
+        from .jax_kernel import JaxTPU
+
+        self.spec = spec
+        # no mid/rescue ladder: stragglers are the tail's job
+        self.device = JaxTPU(spec, budget=budget,
+                             mid_budget=0, rescue_budget=0, **device_kw)
+        if tail is None:
+            tail = _default_tail(spec)
+        self.tail = tail
+        self.tail_histories = 0   # lanes the host tail decided for us
+        self.device_decided = 0
+
+    def check_histories(self, spec: Spec,
+                        histories: Sequence[History]) -> np.ndarray:
+        out = np.asarray(self.device.check_histories(spec, histories),
+                         dtype=np.int8)
+        und = np.nonzero(out == int(Verdict.BUDGET_EXCEEDED))[0]
+        self.device_decided += len(histories) - und.size
+        if und.size:
+            tail_v = np.asarray(self.tail.check_histories(
+                spec, [histories[i] for i in und]), dtype=np.int8)
+            out[und] = tail_v
+            self.tail_histories += int(und.size)
+        return out
+
+    def check_witness(self, spec: Spec, history: History):
+        """Witness from whichever side decided the history (device
+        witnesses verify search-free; host oracles produce their own)."""
+        v = Verdict(int(self.device.check_histories(spec, [history])[0]))
+        if v != Verdict.BUDGET_EXCEEDED:
+            return self.device.check_witness(spec, history)
+        return self.tail.check_witness(spec, history)
+
+
+def _default_tail(spec: Spec):
+    from ..native import CppOracle, native_available
+    from .wing_gong_cpu import WingGongCPU
+
+    if native_available():
+        return CppOracle(spec)
+    return WingGongCPU(memo=True)

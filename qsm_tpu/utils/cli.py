@@ -29,9 +29,9 @@ from .stats import schedule_coverage
 # else the memoised oracle) — the default for `run`, where a user just
 # wants verdicts (kv-64 under the raw memo oracle costs ~17s per 60
 # trials; the native path ~1s, identical verdicts)
-_BACKENDS = ("auto", "auto-tpu", "cpu", "cpp", "tpu", "pcomp", "pcomp-cpp",
-             "pcomp-tpu", "segdc", "segdc-cpp", "segdc-tpu", "rootsplit",
-             "rootsplit-tpu")
+_BACKENDS = ("auto", "auto-tpu", "cpu", "cpp", "tpu", "hybrid-tpu", "pcomp",
+             "pcomp-cpp", "pcomp-tpu", "segdc", "segdc-cpp", "segdc-tpu",
+             "rootsplit", "rootsplit-tpu")
 
 # index == Verdict value (ops/backend.py); ONE site for the rendering
 _VERDICT_NAMES = ("VIOLATION", "LINEARIZABLE", "BUDGET_EXCEEDED")
@@ -132,6 +132,14 @@ def _make_backend_inner(name: str, spec):
         from ..ops.jax_kernel import JaxTPU
 
         return JaxTPU(spec)
+    if name == "hybrid-tpu":
+        # device majority under the tight base budget, stragglers to the
+        # fastest host checker — the priced oracle-resolution plan
+        # (ops/hybrid.py; measured in the bench_scale budget2k/hybrid rows)
+        _ensure_device_reachable()
+        from ..ops.hybrid import HybridDevice
+
+        return HybridDevice(spec)
     if name == "auto-tpu":
         # per-history routing across the device strategies: pcomp for
         # partitionable specs, segdc for shattered histories, the plain
@@ -716,11 +724,11 @@ def cmd_explore(args) -> int:
 def cmd_fuzz(args) -> int:
     from .fuzz import fuzz_parity
 
-    if {"device", "segdc", "auto"} & set(args.backends.split(",")):
+    if {"device", "segdc", "auto", "hybrid"} & set(args.backends.split(",")):
         # same guard as --backend tpu: constructing JaxTPU (also the
-        # inner of segdc/auto) on a wedged chip tunnel hangs the first
-        # in-process jax.devices() forever, and a cpu-pinned process
-        # would run the lockstep kernel on host
+        # inner of segdc/auto/hybrid) on a wedged chip tunnel hangs the
+        # first in-process jax.devices() forever, and a cpu-pinned
+        # process would run the lockstep kernel on host
         _ensure_device_reachable()
     rep = fuzz_parity(n_specs=args.specs, hists_per_spec=args.histories,
                       seed=args.seed, n_pids=args.pids, n_ops=args.ops,
@@ -842,7 +850,7 @@ def main(argv=None) -> int:
     p.add_argument("--ops", type=int, default=10)
     p.add_argument("--p-pending", type=float, default=0.1)
     p.add_argument("--backends", default="memo,cpp,device",
-                   help="comma list from {memo, cpp, device, segdc, auto}")
+                   help="comma list from {memo, cpp, device, segdc, auto, hybrid}")
     p.set_defaults(fn=cmd_fuzz)
 
     p = sub.add_parser("coverage", help="schedule-coverage stats")

@@ -221,6 +221,9 @@ class FuzzReport:
     # every history fell back to the same Python oracle being compared
     # against, so "zero mismatches" proves nothing about the C++ code)
     cpp_native_histories: int = 0
+    # histories the hybrid lane's HOST TAIL decided (0 = the tiny device
+    # budget decided everything and the tail path went unexercised)
+    hybrid_tail_histories: int = 0
 
     @property
     def ok(self) -> bool:
@@ -253,6 +256,7 @@ def fuzz_parity(n_specs: int = 10, hists_per_spec: int = 32,
     oracle = WingGongCPU(memo=False)
     lin = vio = bud = 0
     cpp_native = 0
+    hybrid_tail = 0
     mismatches: List[Tuple[int, int, str, int, int]] = []
     for k in range(n_specs):
         spec_seed = seed * 1_000_003 + k
@@ -288,11 +292,21 @@ def fuzz_parity(n_specs: int = 10, hists_per_spec: int = 32,
                 from ..ops.router import AutoDevice
 
                 backend = AutoDevice(spec)
+            elif name == "hybrid":
+                # device majority + host tail as ONE backend; a tiny
+                # device budget forces real traffic through BOTH sides
+                # under fuzz (budget 200 let the device decide every
+                # random-spec history; 12 splits the corpus)
+                from ..ops.hybrid import HybridDevice
+
+                backend = HybridDevice(spec, budget=12)
             else:
                 raise ValueError(f"unknown fuzz backend {name!r}")
             got = backend.check_histories(spec, hists)
             if name == "cpp":
                 cpp_native += backend.native_histories
+            elif name == "hybrid":
+                hybrid_tail += backend.tail_histories
             for i, (w, g) in enumerate(zip(want, got)):
                 undecided = int(Verdict.BUDGET_EXCEEDED)
                 if int(g) == undecided or int(w) == undecided:
@@ -303,4 +317,5 @@ def fuzz_parity(n_specs: int = 10, hists_per_spec: int = 32,
     return FuzzReport(specs=n_specs, histories=n_specs * hists_per_spec,
                       linearizable=lin, violations=vio,
                       budget_exceeded=bud, mismatches=mismatches,
-                      cpp_native_histories=cpp_native)
+                      cpp_native_histories=cpp_native,
+                      hybrid_tail_histories=hybrid_tail)

@@ -146,3 +146,16 @@ def test_fuzz_router_backend():
     rep = fuzz_parity(n_specs=2, hists_per_spec=10, seed=22,
                       backends=("segdc",), vector_bounds=(3, 2, 2))
     assert rep.mismatches == []
+
+
+def test_fuzz_hybrid_backend():
+    """Device-majority + host-tail as one backend: the fuzz target uses a
+    tiny device budget so random specs push real traffic through the tail
+    (ops/hybrid.py); every decided verdict must match the exact oracle."""
+    rep = fuzz_parity(n_specs=3, hists_per_spec=24, seed=6,
+                      backends=("hybrid",))
+    assert rep.ok, rep.mismatches[:10]
+    assert rep.linearizable > 0 and rep.violations > 0
+    # the lane is only non-vacuous if the host tail really decided some
+    # histories (same discipline as cpp_native_histories)
+    assert rep.hybrid_tail_histories > 0

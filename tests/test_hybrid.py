@@ -1,0 +1,77 @@
+"""HybridDevice: device majority under a tight budget + host tail
+(ops/hybrid.py) — verdict parity with the exact oracle, real tail
+traffic when the budget forces deferral, and witness delegation."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import qsm_tpu as q
+from qsm_tpu.models import CasSpec
+from qsm_tpu.models.register import RegisterSpec
+from qsm_tpu.ops.backend import Verdict, verify_witness
+from qsm_tpu.ops.hybrid import HybridDevice
+from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+from qsm_tpu.utils.corpus import build_corpus
+from qsm_tpu.models import AtomicCasSUT, RacyCasSUT
+
+
+def _corpus(n=24, ops=24):
+    return build_corpus(CasSpec(), (AtomicCasSUT, RacyCasSUT), n=n,
+                        n_pids=4, max_ops=ops, seed_base=77,
+                        seed_prefix="hybrid")
+
+
+def test_parity_with_oracle_and_tail_traffic():
+    spec = CasSpec()
+    corpus = _corpus()
+    memo = WingGongCPU(memo=True)
+    want = np.asarray(memo.check_histories(spec, corpus))
+
+    # budget 1 defers essentially every lane -> the tail decides; parity
+    # must hold and the counters must show the traffic honestly
+    hb = HybridDevice(spec, budget=1)
+    got = np.asarray(hb.check_histories(spec, corpus))
+    assert (got == want).all()
+    assert hb.tail_histories > 0
+    assert hb.tail_histories + hb.device_decided == len(corpus)
+
+
+def test_device_decides_majority_under_real_budget():
+    spec = CasSpec()
+    corpus = _corpus()
+    memo = WingGongCPU(memo=True)
+    want = np.asarray(memo.check_histories(spec, corpus))
+
+    hb = HybridDevice(spec, budget=2_000)
+    got = np.asarray(hb.check_histories(spec, corpus))
+    assert (got == want).all()
+    assert hb.device_decided > 0  # the device really did the easy part
+
+
+def test_no_budget_exceeded_leaks_with_exact_tail():
+    """The default tail is exact on these sizes (its node budget is far
+    beyond them), so the hybrid's output contains no BUDGET_EXCEEDED."""
+    spec = CasSpec()
+    corpus = _corpus()
+    hb = HybridDevice(spec, budget=1)
+    got = np.asarray(hb.check_histories(spec, corpus))
+    assert not (got == int(Verdict.BUDGET_EXCEEDED)).any()
+
+
+def test_witness_delegation_both_sides():
+    spec = RegisterSpec(n_values=4)
+    ok = q.overlapping_history(
+        [(0, 1, 3, 0, 0, 1), (1, 0, 0, 3, 2, 3)])  # write then read: OK
+
+    # device side decides it (generous budget)
+    hb = HybridDevice(spec, budget=2_000)
+    v, order = hb.check_witness(spec, ok)
+    assert v == Verdict.LINEARIZABLE
+    assert verify_witness(spec, ok, order)
+
+    # tail side decides it (budget 1 forces deferral)
+    hb1 = HybridDevice(spec, budget=1)
+    v1, order1 = hb1.check_witness(spec, ok)
+    assert v1 == Verdict.LINEARIZABLE
+    assert verify_witness(spec, ok, order1)
