@@ -143,7 +143,8 @@ def hash_slots_np(keys: np.ndarray, cache_slots: int) -> np.ndarray:
 
 
 def build_stepper(spec: Spec, n_ops: int, budget: int,
-                  cache_slots: int = 0, cache_write: str = "onehot"):
+                  cache_slots: int = 0, cache_write: str = "onehot",
+                  unroll: int = 1):
     """Build the resumable single-history checker for one (spec, N) shape.
 
     Returns ``(init_one, run_one)``:
@@ -390,7 +391,30 @@ def build_stepper(spec: Spec, n_ops: int, budget: int,
             def cond(c):
                 return (c["status"] == RUNNING) & (c["iters"] - start < chunk)
 
-        return jax.lax.while_loop(cond, body, carry)
+        if unroll <= 1:
+            return jax.lax.while_loop(cond, body, carry)
+
+        # K micro-steps per while-loop trip, each behind the SAME guard
+        # the loop cond applies, so a lane frozen (decided / budget /
+        # chunk boundary) mid-trip no-ops through the remaining
+        # micro-steps: verdicts AND per-lane iteration counts are
+        # bit-identical to unroll=1 (tests/test_kernel_driver.py pins
+        # this).  Why: the first banked real-TPU window measured ~5 ms
+        # per sequential while-loop TRIP on the axon tunnel — if trip
+        # overhead (not body compute) dominates, K-unrolling cuts trips
+        # K× for the same lockstep work; on compute-bound platforms it is
+        # neutral.  tools/bench_scale.py measures it on-chip.
+        def micro(c):
+            out = body(c)
+            return jax.tree.map(
+                lambda new, old: jnp.where(cond(c), new, old), out, c)
+
+        def body_k(c):
+            for _ in range(unroll):
+                c = micro(c)
+            return c
+
+        return jax.lax.while_loop(cond, body_k, carry)
 
     return init_one, run_one
 
@@ -445,6 +469,17 @@ class JaxTPU:
     # batch*slots <= 1<<17, the largest product seen safe at batch >= 256.
     MAX_SLOTS_FOR_BATCH = {8: 8192, 64: 4096, 256: 512, 1024: 128, 4096: 32,
                            16384: 8, 65536: 2}
+    # Micro-steps per while-loop trip (build_stepper unroll).  None =
+    # auto: 8 on a real device backend, 1 on the CPU platform.  Per-TRIP
+    # overhead dominates the loop on both the axon tunnel (~5 ms/trip,
+    # BENCH_TPU_r04.json arithmetic) and the XLA CPU backend (unroll8
+    # measured 5.2× there: 228→1189 h/s, bench_scale scan) — but tests
+    # live on the CPU platform with tiny batches where the ~2.4× compile
+    # cost of the unrolled body outweighs the win, so auto keeps CPU at
+    # 1 and measurement surfaces (bench.py, tools/bench_*.py) opt in
+    # explicitly.  Verdicts and per-lane iteration counts are
+    # bit-identical at any value (tests/test_kernel_driver.py).
+    UNROLL: Optional[int] = None
     # Split threshold for check_histories: batches beyond this run as
     # separate sequential device calls.  4096 is the round-1..4 behavior;
     # tools/bench_scale.py raises it per-backend to measure whether wider
@@ -539,20 +574,28 @@ class JaxTPU:
                 self.effective_rescue_slots or 0, slots)
         return slots
 
+    def _unroll(self) -> int:
+        if self.UNROLL is not None:
+            return self.UNROLL
+        import jax
+
+        return 8 if jax.default_backend() != "cpu" else 1
+
     def _stepper(self, n_ops: int, slots: int):
-        key = (n_ops, slots)
+        key = (n_ops, slots, self._unroll())
         fns = self._steppers.get(key)
         if fns is None:
             fns = build_stepper(self.kspec, n_ops, self.total_budget,
                                 cache_slots=slots,
-                                cache_write=self.cache_write)
+                                cache_write=self.cache_write,
+                                unroll=self._unroll())
             self._steppers[key] = fns
         return fns
 
     def _init_fn(self, n_ops: int, batch: int, slots: int):
         import jax
 
-        key = ("init", n_ops, batch, slots)
+        key = ("init", n_ops, batch, slots, self._unroll())
         fn = self._compiled.get(key)
         if fn is None:
             init_one, _ = self._stepper(n_ops, slots)
@@ -564,7 +607,8 @@ class JaxTPU:
                   donate: bool = True):
         import jax
 
-        key = ("chunk", n_ops, batch, slots, chunk, donate)
+        key = ("chunk", n_ops, batch, slots, chunk, donate,
+               self._unroll())
         fn = self._compiled.get(key)
         if fn is None:
             _, run_one = self._stepper(n_ops, slots)

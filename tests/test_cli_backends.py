@@ -57,6 +57,7 @@ def test_fuzz_device_backend_is_probe_gated():
 
 def test_every_backend_choice_constructs(healthy_probe):
     from qsm_tpu.native import CppOracle
+    from qsm_tpu.ops.hybrid import HybridDevice
     from qsm_tpu.ops.jax_kernel import JaxTPU
     from qsm_tpu.ops.pcomp import PComp
     from qsm_tpu.ops.router import AutoDevice
@@ -82,6 +83,7 @@ def test_every_backend_choice_constructs(healthy_probe):
         # auto = fastest exact host checker (native here: toolchain baked)
         "auto": (CppOracle, QueueSpec),
         "auto-tpu": (AutoDevice, QueueSpec),
+        "hybrid-tpu": (HybridDevice, QueueSpec),
     }
     assert set(want) == set(_BACKENDS)
     for name, (ty, mk_spec) in want.items():
@@ -103,6 +105,9 @@ def test_every_backend_choice_constructs(healthy_probe):
     assert isinstance(b.plain, JaxTPU)  # router over the device kernel
     b = _make_backend("auto-tpu", KvSpec())
     assert b.pcomp is not None  # partitionable specs decompose per key
+    b = _make_backend("hybrid-tpu", CasSpec())
+    assert isinstance(b.device, JaxTPU)  # device majority + host tail
+    assert isinstance(b.tail, CppOracle)
 
 
 def test_unknown_backend_refused():

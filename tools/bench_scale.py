@@ -81,7 +81,13 @@ def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
         f.write(json.dumps(lines[0]) + "\n")
         f.flush()
 
-    def measure(batch, variant=None, schedule=None, backend_kw=None):
+    def measure(batch, variant=None, schedule=None, backend_kw=None,
+                unroll=8):
+        # unroll=8 is the production setting bench.py runs the headline
+        # with (5.2x on the CPU platform; per-trip overhead dominates) —
+        # width rows measure THAT kernel so best_scale_batch adoption
+        # and the headline share a basis; the unroll1 control row keeps
+        # the A/B on-chip evidence.
         reps = (batch + len(corpus) - 1) // len(corpus)
         device_corpus = (corpus * reps)[:batch]
         tiled_memo = np.tile(memo_verdicts, reps)[:batch]
@@ -91,6 +97,7 @@ def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
         try:
             backend = JaxTPU(spec, budget=2_000, **(backend_kw or {}))
             backend.MAX_BATCH = batch
+            backend.UNROLL = unroll
             if schedule is not None:
                 backend.CHUNK_SCHEDULE = schedule
             elif on_tpu:
@@ -162,6 +169,7 @@ def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
         emit({"variant": "diagnostics", "skipped": "time box exhausted"})
     if good and time.perf_counter() - t_start <= TIME_BOX_S:
         bstar = max(good, key=lambda r: r["rate_h_per_s"])["batch"]
+        emit(measure(bstar, variant="unroll1", unroll=1))
         emit(measure(bstar, variant="oneshot", schedule=(65536,)))
         if time.perf_counter() - t_start <= TIME_BOX_S:
             b2k = measure(bstar, variant="budget2k",
