@@ -663,6 +663,17 @@ def main(argv=None) -> int:
             return 0
         force_cpu_platform()
 
+    # cross-process persistent compile cache, DEVICE runs only: inside a
+    # healing window the seize pipeline runs several bench/scale/e2e
+    # subprocesses — only the first should pay the 20-40 s first-compiles.
+    # Not on the CPU fallback: XLA:CPU's AOT cache loader warns about
+    # machine-feature mismatches ("could lead to SIGILL"), and the
+    # fallback is the path that guards the round's headline.
+    if on_tpu:
+        from qsm_tpu.utils.device import enable_compile_cache
+
+        enable_compile_cache()
+
     try:
         result = run_bench(on_tpu, probe_detail, args.profile,
                            sweep=not args.no_sweep,

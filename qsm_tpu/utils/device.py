@@ -118,6 +118,14 @@ def probe_or_force_cpu(force_cpu: bool = False,
         on_tpu, detail = p.is_device, p.detail
     if not on_tpu:
         force_cpu_platform()
+    else:
+        # device runs only: on XLA:CPU the AOT cache loader warns about
+        # machine-feature mismatches ("could lead to SIGILL") — the
+        # fallback path that guards the round's headline must not gamble
+        # on that, and CPU compiles are cheap anyway.  On the chip the
+        # cache is the window-economics win (seize subprocesses share
+        # first-compiles).
+        enable_compile_cache()
     import jax
 
     header = {
@@ -128,6 +136,33 @@ def probe_or_force_cpu(force_cpu: bool = False,
         "tpu_probe": detail,
     }
     return on_tpu, detail, header
+
+
+def enable_compile_cache(dirpath: Optional[str] = None) -> None:
+    """Turn on JAX's persistent (on-disk, cross-process) compilation cache.
+
+    Why here: healing-window economics.  The unrolled kernel bodies cost
+    ~2.4× to compile, the seize pipeline runs bench/scale/e2e as SEPARATE
+    bounded subprocesses, and first-compiles are 20-40 s on the chip — a
+    shared on-disk cache means only the window's first process pays them.
+    Safe to call any time before (or after) backend init; never raises —
+    an old jax without the knobs just skips it."""
+    if dirpath is None:
+        dirpath = os.environ.get(
+            "QSM_TPU_COMPILE_CACHE",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", dirpath)
+        # default thresholds skip small/fast compiles; the kernel's many
+        # (bucket, slots, chunk, unroll) executables are individually
+        # cheap-ish but numerous — cache them all
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 — a cache is an optimization, never
+        pass           # a reason to fail a bench or a test
 
 
 def force_cpu_platform(n_devices: Optional[int] = None) -> None:

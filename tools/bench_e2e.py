@@ -72,9 +72,23 @@ def main(argv=None) -> int:
         "artifact": "bench_e2e", "config": "cas 32ops x 8pids, 4 schedules",
         **header,
     }]
+    def _hybrid(s):
+        from qsm_tpu.ops.hybrid import HybridDevice
+
+        return HybridDevice(s)
+
+    # UNROLL stays on auto (8 on device, 1 on the CPU platform): e2e
+    # corpora are tiny (4-256 histories/call), so the unrolled body's
+    # ~2.4× compile cost lands INSIDE the measured runs and wipes out
+    # the per-trip win on the fallback — measured: device atomic tb=1
+    # fell 62 → 16 h/s with a forced unroll8 here, while the bench.py
+    # corpus (4096+ lanes, warmup outside the timer) gains 5.2×.
     backends = {
         "memo": lambda s: WingGongCPU(memo=True),
         "device": lambda s: JaxTPU(s),
+        # device majority + host tail as one backend (ops/hybrid.py):
+        # the e2e plan the scale-scan hybrid_derived row prices
+        "hybrid": _hybrid,
     }
     try:
         from qsm_tpu.native import CppOracle, native_available
@@ -89,7 +103,8 @@ def main(argv=None) -> int:
     # dominating the device path at batch 4
     for bname, mk in backends.items():
         for sut_name in ("atomic", "racy"):
-            for tb in ((1,) if bname != "device" else (1, 64)):
+            for tb in ((1,) if bname not in ("device", "hybrid")
+                       else (1, 64)):
                 rec = run_one(f"cas-{sut_name}", bname, mk, sut_name,
                               args.trials, trial_batch=tb)
                 rec["trial_batch"] = tb
