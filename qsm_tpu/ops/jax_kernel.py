@@ -86,7 +86,8 @@ SUCCESS = 1  # == Verdict.LINEARIZABLE
 FAILURE = 2
 BUDGET = 3
 
-_BATCH_BUCKETS = (8, 64, 256, 1024, 4096, 16384, 65536)
+_BATCH_BUCKETS = (8, 64, 256, 1024, 4096, 16384, 65536,
+                  262144)
 
 
 def _batch_bucket(b: int) -> int:
@@ -468,7 +469,7 @@ class JaxTPU:
     # verified points stand as-is; unverified buckets are capped so that
     # batch*slots <= 1<<17, the largest product seen safe at batch >= 256.
     MAX_SLOTS_FOR_BATCH = {8: 8192, 64: 4096, 256: 512, 1024: 128, 4096: 32,
-                           16384: 8, 65536: 2}
+                           16384: 8, 65536: 2, 262144: 0}
     # Micro-steps per while-loop trip (build_stepper unroll).  None =
     # auto: 8 on a real device backend, 1 on the CPU platform.  Per-TRIP
     # overhead dominates the loop on both the axon tunnel (~5 ms/trip,
@@ -999,7 +1000,7 @@ class JaxTPU:
 
         if slots > 0:
             key_words = host["keys"].shape[2] if "keys" in host else (
-                self._stepper_key_words())
+                self._stepper_key_words(host["taken"].shape[1]))
             keys = np.zeros((bucket, slots, key_words), np.uint32)
             occ = np.zeros((bucket, slots), np.int32)
             if "keys" in host and old_slots:
@@ -1026,14 +1027,22 @@ class JaxTPU:
         :meth:`_compact_carry_host` is the behavioral reference."""
         import jax.numpy as jnp
 
-        if slots > 0 and "keys" not in carry:
-            raise AssertionError(
-                "cache slots grew from 0 mid-run; _slots_for is monotone "
-                "per bucket so this cannot happen")
         idx = np.zeros(bucket, np.int32)
         idx[:lanes.size] = lanes
         live = np.zeros(bucket, bool)
         live[:lanes.size] = True
+        if slots > 0 and "keys" not in carry:
+            # compacting OUT of a cache-off bucket (the widest buckets run
+            # slots=0 — MAX_SLOTS_FOR_BATCH) into a cached one: there is
+            # nothing to re-hash, survivors just get a fresh empty table
+            # (key width = packed taken words + state words, the
+            # build_stepper layout)
+            new = self._compact_fn(bucket, 0, 0)(
+                carry, jnp.asarray(idx), jnp.asarray(live))
+            kw = self._stepper_key_words(carry["taken"].shape[1])
+            new["keys"] = jnp.zeros((bucket, slots, kw), jnp.uint32)
+            new["occ"] = jnp.zeros((bucket, slots), jnp.int32)
+            return self._shard_carry(new)
         new = self._compact_fn(bucket, slots, old_slots or 0)(
             carry, jnp.asarray(idx), jnp.asarray(live))
         return self._shard_carry(new)
@@ -1053,11 +1062,13 @@ class JaxTPU:
         batched = jax.NamedSharding(mesh, P(axis))
         return {k: jax.device_put(v, batched) for k, v in carry.items()}
 
-    def _stepper_key_words(self) -> int:
-        # only needed when a cache appears where none existed (old_slots=0)
-        raise AssertionError(
-            "cache slots grew from 0 mid-run; _slots_for is monotone per "
-            "bucket so this cannot happen")
+    def _stepper_key_words(self, n_ops: int) -> int:
+        """Key width of the in-kernel memo cache: packed taken-bitmask
+        words + state words — MUST mirror build_stepper's layout (the one
+        other definition).  Needed when survivors compact OUT of a
+        cache-off bucket (the widest buckets run slots=0) into a cached
+        one: there is no existing table to read the width from."""
+        return (n_ops + 31) // 32 + self.kspec.STATE_DIM
 
     def _pad_args(self, active, bucket, cmd, arg, resp, valid, prec):
         import jax.numpy as jnp

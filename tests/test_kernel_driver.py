@@ -121,3 +121,32 @@ def test_unroll_bit_identical_to_single_step():
     # but lockstep_cost is sensitive to every per-trip iter delta)
     assert base.lockstep_cost == k8.lockstep_cost
     assert base.rescued == k8.rescued
+
+
+def test_compaction_out_of_cache_off_bucket():
+    """The widest buckets run cache-off (slots=0, MAX_SLOTS_FOR_BATCH);
+    survivors compacting into a cached bucket must get a fresh empty
+    table (nothing to re-hash) with verdicts still oracle-identical."""
+    import numpy as np
+
+    from qsm_tpu.models import AtomicCasSUT, CasSpec, RacyCasSUT
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+    from qsm_tpu.utils.corpus import build_corpus
+
+    spec = CasSpec()
+    corpus = build_corpus(spec, (AtomicCasSUT, RacyCasSUT), n=40,
+                          n_pids=4, max_ops=24, seed_base=91,
+                          seed_prefix="cacheoff")
+
+    b = JaxTPU(spec, budget=2_000)
+    # corpus of 40 starts in the 64 bucket CACHE-OFF; survivors compact
+    # into the 8-bucket with a real cache -> exercises the 0 -> K path
+    b.MAX_SLOTS_FOR_BATCH = dict(b.MAX_SLOTS_FOR_BATCH)
+    b.MAX_SLOTS_FOR_BATCH[64] = 0
+    b.CHUNK_SCHEDULE = (16, 64, 2048)
+    got = np.asarray(b.check_histories(spec, corpus))
+    want = np.asarray(WingGongCPU(memo=True).check_histories(spec, corpus))
+    both = (got != 2) & (want != 2)
+    assert both.all() and (got == want).all()
+    assert b.compactions >= 1  # the 0 -> K transition really happened

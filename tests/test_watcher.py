@@ -67,7 +67,7 @@ def test_seize_all_banked_is_silent(w, tmp_path, monkeypatch):
     (tmp_path / "BENCH_E2E_TPU_WINDOW.json").write_text("{}")
     scale = [{"h": 1, "device_fallback": None}] + [
         {"batch": b, "rate_h_per_s": 1.0, "wrong": 0}
-        for b in (4096, 16384, 65536)] + [
+        for b in (4096, 16384, 65536, 262144)] + [
         {"variant": "unroll1", "rate_h_per_s": 1.0, "wrong": 0},
         {"variant": "budget2k", "rate_h_per_s": 1.0, "wrong": 0}]
     (tmp_path / "BENCH_SCALE_TPU_WINDOW.json").write_text(
@@ -214,3 +214,27 @@ def test_run_tool_timeout_promotes_bigger_partial(w, tmp_path,
     assert len(kept) == 2  # promoted: 1 measured row > 0 banked
     # and the committed twin was banked too
     assert (tmp_path / "BENCH_SCALE_TPU_r04.json").exists()
+
+
+def test_scale_completeness_is_content_based(w, tmp_path):
+    """A pre-ladder-growth artifact (complete for the OLD widths) must
+    read as incomplete so the new widest row gets chased — a row-count
+    gate went stale exactly this way in round 4."""
+    p = tmp_path / "BENCH_SCALE_TPU_WINDOW.json"
+    rows = [{"artifact": "s", "device_fallback": None}] + [
+        {"batch": b, "rate_h_per_s": 1.0, "wrong": 0}
+        for b in (4096, 16384, 65536)] + [
+        {"variant": "unroll1", "rate_h_per_s": 1.0},
+        {"variant": "budget2k", "rate_h_per_s": 1.0}]
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert w._scale_complete(str(p)) is False  # 262144 missing
+
+    rows.insert(5, {"batch": 262144,
+                    "error": "RESOURCE_EXHAUSTED"})  # an answer, not a gap
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert w._scale_complete(str(p)) is True
+
+    # CPU-fallback header is never complete
+    rows[0]["device_fallback"] = "cpu"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert w._scale_complete(str(p)) is False
