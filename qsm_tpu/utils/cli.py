@@ -390,6 +390,13 @@ def cmd_bench(args) -> int:
         spec, (entry.impls["atomic"], entry.impls["racy"]),
         n=args.corpus, n_pids=n_pids, max_ops=n_ops, seed_prefix="bench")
     backend = _make_backend(args.backend, spec)
+    if getattr(args, "unroll", None) is not None:
+        # the kernel may sit behind a combinator (hybrid/router/segdc)
+        for kern in (backend, getattr(backend, "device", None),
+                     getattr(backend, "plain", None),
+                     getattr(backend, "inner", None)):
+            if kern is not None and hasattr(kern, "UNROLL"):
+                kern.UNROLL = args.unroll
     backend.check_histories(spec, hists)  # warmup
     t0 = time.perf_counter()
     v = backend.check_histories(spec, hists)
@@ -782,6 +789,10 @@ def main(argv=None) -> int:
     p.add_argument("--pids", type=int, default=None)
     p.add_argument("--ops", type=int, default=None)
     p.add_argument("--corpus", type=int, default=256)
+    p.add_argument("--unroll", type=int, default=None,
+                   help="micro-steps per while-loop trip for device "
+                        "kernels (default: auto — 8 on a real device, 1 "
+                        "on the CPU platform; see docs/EXPERIMENTS.md)")
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser(

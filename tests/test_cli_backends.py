@@ -120,3 +120,14 @@ def test_pcomp_refuses_non_decomposable_spec():
 
     with pytest.raises(ValueError, match="decomposable"):
         PComp(QueueSpec())
+
+
+def test_bench_unroll_flag(capsys):
+    """--unroll is accepted and applied to any kernel the backend wraps
+    (no-op for host backends); auto stays the default."""
+    from qsm_tpu.utils.cli import main
+
+    assert main(["bench", "--model", "cas", "--backend", "cpu",
+                 "--corpus", "8", "--unroll", "4"]) == 0
+    out = capsys.readouterr().out
+    assert '"histories": 8' in out
