@@ -309,19 +309,11 @@ def _seize_window(bench_timeout: float) -> bool:
         # chase the upgrades only while the window is demonstrably open;
         # after a failed bank the flicker closed — a full sweep on the
         # CPU fallback would block probing for up to bench_timeout.
-        # Cheapest-and-most-informative first: the round-4 window spent
-        # 40 min on the sweep and closed before configs/e2e/profile got a
-        # turn, so the sweep now goes LAST.
-        _run_tool("bench_configs.py",
-                  os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"),
-                  bench_timeout, "window_configs")
-        _run_tool("bench_e2e.py",
-                  os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json"),
-                  bench_timeout / 2, "window_e2e")
-        # Batch-width scaling scan (tools/bench_scale.py): measures
-        # whether wider lockstep batches amortize the per-trip latency
-        # the first banked window exposed.  min_rows keeps a promoted
-        # partial (window closed mid-scan) from suppressing completion.
+        # Order = flagship first: the scale scan + rescaled headline
+        # directly upgrade the round's headline number (unroll/width
+        # A/B), so they outrank the breadth artifacts (configs, e2e) in
+        # a window that may close any minute; the sweep (longest by far
+        # — it outlived the 48-min round-4 window) stays LAST.
         if _scale_complete(
                 os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json")):
             _log(event="window_scale", ok=True,
@@ -353,6 +345,12 @@ def _seize_window(bench_timeout: float) -> bool:
                 and adopted[0] != cur_batch:
             _run_window_bench(bench_timeout / 2, ["--no-sweep"],
                               "window_bench_rescaled")
+        _run_tool("bench_configs.py",
+                  os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"),
+                  bench_timeout, "window_configs")
+        _run_tool("bench_e2e.py",
+                  os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json"),
+                  bench_timeout / 2, "window_e2e")
         # A PROFILED run, never banked (tracer overhead must not deflate
         # the headline artifact) — captures the first real-TPU
         # jax.profiler trace.  Ordered after the artifact banks so a
