@@ -314,8 +314,7 @@ def _seize_window(bench_timeout: float) -> bool:
         # A/B), so they outrank the breadth artifacts (configs, e2e) in
         # a window that may close any minute; the sweep (longest by far
         # — it outlived the 48-min round-4 window) stays LAST.
-        if _scale_complete(
-                os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json")):
+        if scale_done:
             _log(event="window_scale", ok=True,
                  detail="already banked; kept")
         else:
