@@ -96,7 +96,24 @@ WINDOW_MAX_AGE_S = 14 * 3600.0  # a round is ~12 h; reject older leftovers
 
 # single source for round-stamped artifact names (tools/probe_watcher.py
 # keeps its own ROUND_TAG for the committed window copies — bump both)
-ROUND_TAG = "r04"
+ROUND_TAG = "r05"
+
+# Frozen host-oracle denominators (tools/bench_host_baseline.py, measured
+# once per round on ≥100-sample corpora).  VERDICT r4 weak #4: the live
+# 14-18-sample oracle re-measurement injected ~30% noise into vs_baseline
+# across windows; ratios against the frozen file are comparable across
+# windows, with live ratios kept alongside and drift >20% flagged.
+FROZEN_HOST_FILE = f"BASELINE_HOST_{ROUND_TAG}.json"
+
+
+def _frozen_host_rates() -> dict | None:
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               FROZEN_HOST_FILE)) as f:
+            d = json.load(f)
+        return d if d.get("cpu_oracle_rate") else None
+    except (OSError, ValueError):
+        return None
 
 
 def _load_window_artifact() -> dict | None:
@@ -121,19 +138,10 @@ def _load_window_artifact() -> dict | None:
     return result
 
 
-def best_scale_batch(min_gain: float = 1.2, dirpath: str | None = None):
-    """Best lockstep batch width from a DEVICE-captured bench_scale
-    artifact (tools/bench_scale.py), or None.
-
-    The first real-TPU window showed per-trip latency dominating the
-    chunked driver at 4096 lanes; wider batches amortize it.  Adoption
-    discipline: only a width the scale scan actually measured on the real
-    chip with ZERO wrong verdicts and ≥ ``min_gain`` × the 4096-row rate
-    is adopted (the gain gate also bounds the adopted headline's
-    wall-clock, which matters inside short healing windows).  Returns
-    ``(batch, rate)`` or None."""
+def _device_scale_rows(dirpath: str | None = None) -> list:
+    """Data rows of the freshest DEVICE-captured bench_scale artifact
+    (window copy preferred), or [] when none is usable."""
     here = dirpath or os.path.dirname(os.path.abspath(__file__))
-    rows = None
     for name in ("BENCH_SCALE_TPU_WINDOW.json",
                  f"BENCH_SCALE_TPU_{ROUND_TAG}.json"):
         path = os.path.join(here, name)
@@ -148,12 +156,52 @@ def best_scale_batch(min_gain: float = 1.2, dirpath: str | None = None):
             # round's kernel; the next window re-scans anyway
         if not lines or lines[0].get("device_fallback") is not None:
             continue
-        rows = [r for r in lines[1:]
-                if r.get("wrong") == 0 and "error" not in r
-                and "skipped" not in r and "variant" not in r
-                and r.get("rate_h_per_s")]
-        if rows:
-            break
+        if len(lines) > 1:
+            return lines[1:]
+    return []
+
+
+def best_scale_unroll(dirpath: str | None = None):
+    """Unroll setting the on-chip A/B decided, or None when undecided.
+
+    Compares the unroll8 control row against the unroll1 variant at the
+    SAME batch width from a device-captured scale artifact (both
+    zero-wrong).  Returns ``(unroll, rate)`` for the winner.  The round-4
+    windows never measured this on-chip — the only post-unroll datapoint
+    regressed 1.7× with everything else confounded (VERDICT r4 weak #3);
+    this function is how the headline adopts whichever setting the real
+    chip actually prefers."""
+    rows = _device_scale_rows(dirpath)
+    ok = [r for r in rows if r.get("wrong") == 0 and "error" not in r
+          and "skipped" not in r and r.get("rate_h_per_s")]
+    u1 = next((r for r in ok if r.get("variant") == "unroll1"), None)
+    if u1 is None or u1.get("batch") is None:
+        return None
+    u8 = next((r for r in ok if "variant" not in r
+               and r.get("batch") == u1["batch"]), None)
+    if u8 is None:
+        return None
+    if u1["rate_h_per_s"] > u8["rate_h_per_s"]:
+        return 1, float(u1["rate_h_per_s"])
+    return 8, float(u8["rate_h_per_s"])
+
+
+def best_scale_batch(min_gain: float = 1.2, dirpath: str | None = None):
+    """Best lockstep batch width from a DEVICE-captured bench_scale
+    artifact (tools/bench_scale.py), or None.
+
+    The first real-TPU window showed per-trip latency dominating the
+    chunked driver at 4096 lanes; wider batches amortize it.  Adoption
+    discipline: only a width the scale scan actually measured on the real
+    chip with ZERO wrong verdicts and ≥ ``min_gain`` × the 4096-row rate
+    is adopted (the gain gate also bounds the adopted headline's
+    wall-clock, which matters inside short healing windows).  Returns
+    ``(batch, rate)`` or None."""
+    all_rows = _device_scale_rows(dirpath)
+    rows = [r for r in all_rows
+            if r.get("wrong") == 0 and "error" not in r
+            and "skipped" not in r and "variant" not in r
+            and r.get("rate_h_per_s")]
     if not rows:
         return None
     base = next((r["rate_h_per_s"] for r in rows if r["batch"] == 4096),
@@ -179,20 +227,27 @@ def _scale(on_tpu: bool) -> dict:
     (the lockstep vmapped while-loop is orders of magnitude slower on host —
     an unreduced run would take hours, which is its own kind of hang)."""
     if on_tpu:
+        # reps=1: the round-5 seize runs the scale scan FIRST, so the
+        # headline's job is one SHORT timed rep at the adopted
+        # configuration (VERDICT r4 task #1: the window buys the
+        # decision, not a third 300-440 s headline).  Run-to-run variance
+        # is covered by the captures history the watcher appends
+        # (BENCH_TPU_CAPTURES_*.jsonl), not by in-run reps.
         sc = dict(n_unique=512, device_batch=4096, cpu_sample=64,
-                  cpu_timebox_s=90.0, reps=3, budget=2_000,
-                  batch_from_scale=None)
+                  cpu_timebox_s=90.0, reps=1, budget=2_000,
+                  batch_from_scale=None, unroll=8, unroll_from_scale=None)
         adopted = best_scale_batch()
         if adopted is not None:
             sc["device_batch"] = adopted[0]
-            # keep timed lane-work roughly constant: 3 reps × 4096 lanes
-            # was the round-4 window budget
-            sc["reps"] = max(1, (3 * 4096) // adopted[0])
             sc["batch_from_scale"] = adopted[0]
+        u = best_scale_unroll()
+        if u is not None:
+            sc["unroll"] = u[0]
+            sc["unroll_from_scale"] = u[0]
         return sc
     return dict(n_unique=128, device_batch=256, cpu_sample=24,
                 cpu_timebox_s=45.0, reps=1, budget=2_000,
-                batch_from_scale=None)
+                batch_from_scale=None, unroll=8, unroll_from_scale=None)
 
 
 def _sweep_cells_measured(sw: dict) -> int:
@@ -446,31 +501,41 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
     # rare blowups report BUDGET_EXCEEDED and are excluded from the decided
     # count (the property layer resolves them via the oracle — SURVEY.md §7
     # hard-parts #5), so the headline rate only counts decided verdicts.
+    from qsm_tpu.utils.device import compile_cache_entries
+
     backend = JaxTPU(spec, budget=sc["budget"])
     # a scale-artifact-adopted width needs the split threshold raised too
     backend.MAX_BATCH = max(backend.MAX_BATCH, sc["device_batch"])
     # K micro-steps per while trip: 5.2× on the CPU platform (scale-scan
-    # unroll8 variant, 228→1189 h/s, zero wrong) and the banked TPU
-    # window's ~5 ms/trip arithmetic says per-trip overhead dominates
-    # the tunnel even harder.  Verdict/iteration parity at any K is
+    # unroll8 variant, 228→1189 h/s, zero wrong), but the only post-unroll
+    # on-chip datapoint regressed — so the setting is ADOPTED from the
+    # scale scan's on-chip unroll A/B when one is banked (best_scale_unroll)
+    # and defaults to 8 otherwise.  Verdict/iteration parity at any K is
     # pinned in tests/test_kernel_driver.py.
-    backend.UNROLL = 8
+    backend.UNROLL = sc.get("unroll", 8)
     if on_tpu:
         # healing windows are short and first-compiles are the enemy: two
         # chunk stages instead of four halves the executables per bucket
         # at a small lockstep-waste cost (the escalation still happens,
         # just coarser)
         backend.CHUNK_SCHEDULE = (2048, 65536)
+    cache_before = compile_cache_entries()
+    t0 = time.perf_counter()
     backend.check_histories(spec, device_corpus)  # warmup: compile + run
+    warm_s = time.perf_counter() - t0
+    cache_after = compile_cache_entries()
     backend.lockstep_cost = 0   # count only the timed passes below
     backend.rounds_run = 0
     if profile_dir:
         import jax
 
         jax.profiler.start_trace(profile_dir)
+    rep_times = []
     t0 = time.perf_counter()
     for _ in range(sc["reps"]):
+        t1 = time.perf_counter()
         dev_verdicts = backend.check_histories(spec, device_corpus)
+        rep_times.append(round(time.perf_counter() - t1, 3))
     dev_s = time.perf_counter() - t0
     if profile_dir:
         import jax
@@ -545,6 +610,24 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
         except Exception as e:  # noqa: BLE001 — the headline must survive
             sweep_extras = {"sweep_error": f"{type(e).__name__}: {e}"}
 
+    # ratios against the frozen per-round host denominators, alongside the
+    # live ones; live-vs-frozen drift >20% is flagged rather than silently
+    # averaged away (VERDICT r4 task #5)
+    frozen = _frozen_host_rates()
+    frozen_extras = {}
+    if frozen:
+        f_naive = frozen["cpu_oracle_rate"]
+        f_best = max(frozen.get("cpu_memo_oracle_rate") or 0.0,
+                     frozen.get("cpp_oracle_rate") or 0.0)
+        frozen_extras = {
+            "vs_baseline_frozen": round(dev_rate / f_naive, 2),
+            "vs_best_host_frozen": (round(dev_rate / f_best, 2)
+                                    if f_best else None),
+            "frozen_denominator_file": FROZEN_HOST_FILE,
+            "denominator_drift_gt20pct": bool(
+                abs(cpu_rate - f_naive) > 0.2 * f_naive),
+        }
+
     return {
         "metric": f"histories_per_sec_linearized_{N_OPS}ops_x_{N_PIDS}pids",
         "value": round(dev_rate, 1),
@@ -558,6 +641,7 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
         # comparability.
         "vs_best_host": round(dev_rate / max(memo_rate, cpp_rate or 0.0), 2),
         "extras": {
+            **frozen_extras,
             "cpu_oracle_rate": round(cpu_rate, 3),
             "cpu_oracle_median_s": round(float(np.median(cpu_times)), 4),
             "cpu_memo_oracle_rate": round(memo_rate, 1),
@@ -570,6 +654,13 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
             "tpu_probe": probe_detail[:160],
             "device_batch": sc["device_batch"],
             "batch_from_scale": sc.get("batch_from_scale"),
+            "unroll": sc.get("unroll", 8),
+            "unroll_from_scale": sc.get("unroll_from_scale"),
+            "reps": sc["reps"],
+            "per_rep_s": rep_times,
+            "warm_s": round(warm_s, 2),
+            "cache_entries_before": cache_before,
+            "cache_entries_after": cache_after,
             "device_budget": sc["budget"],
             # the measured configuration, for cross-round comparability
             # (the TPU path coarsens the schedule to halve window compiles)
@@ -706,7 +797,10 @@ def _slim_line(result: dict) -> str:
     the probe log."""
     line = json.dumps(result)
     droppable = ("max_ops_solved_60s", "probe_attempts", "tpu_probe",
-                 "chunk_schedule", "lockstep_iters_r2_ladder")
+                 "chunk_schedule", "lockstep_iters_r2_ladder",
+                 "cache_entries_before", "cache_entries_after",
+                 "cpu_oracle_median_s", "corpus_gen_sec",
+                 "frozen_denominator_file")
     ex = result.get("extras", {})
     for key in droppable:
         if len(line) <= MAX_LINE:

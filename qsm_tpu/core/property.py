@@ -56,6 +56,14 @@ class PropertyConfig:
     # device see G×k-lane batches; verdict semantics are unchanged (the
     # first failing trial in canonical order shrinks, exactly as ungrouped —
     # later trials in its group were merely also checked).
+    # The group size RAMPS 1→2→4→…→trial_batch rather than starting at
+    # trial_batch: generating + executing a full group is host-side work
+    # paid before the batch is checked, so an early violation inside a
+    # 64-trial group wasted ~60 trials of execution — the measured
+    # regression on violating SUTs (BENCH_E2E_r04: hybrid/racy 48.9 h/s at
+    # trial_batch=64 vs 75.5 at 1; VERDICT.md round 4, "Next round" #7).
+    # Ramping bounds the waste to < the trials already run while keeping
+    # the steady-state (no-violation) batch at full width.
     trial_batch: int = 1
     # message transport for the scheduler plane: "memory" (default) or
     # "tcp" (real loopback sockets, sched/transport.py).  Histories are
@@ -301,7 +309,11 @@ def _prop_concurrent_body(spec, sut, cfg, backend, oracle, transport,
     schedules_run = 0
     distinct = 0
     k = max(1, cfg.schedules_per_program)
-    group_n = max(1, cfg.trial_batch)
+    group_target = max(1, cfg.trial_batch)
+    # geometric ramp toward the configured width (see PropertyConfig):
+    # early violations stop the run having wasted at most as many trials
+    # as already ran; violation-free runs reach full width in log2 steps
+    group_n = 1
     t = 0
     while t < cfg.n_trials:
         group = list(range(t, min(t + group_n, cfg.n_trials)))
@@ -358,6 +370,7 @@ def _prop_concurrent_body(spec, sut, cfg, backend, oracle, transport,
                     program=mp, history=mh, trial=ti,
                     trial_seed=seeds_all[gi][j], shrink_steps=steps))
         t += len(group)
+        group_n = min(group_target, group_n * 2)
     return PropertyResult(ok=True, trials_run=cfg.n_trials,
                           histories_checked=checked, undecided=undecided,
                           schedules_run=schedules_run,

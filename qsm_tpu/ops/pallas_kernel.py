@@ -1,0 +1,352 @@
+"""``PallasTPU`` — Pallas (Mosaic) prototype of the scalar-table search.
+
+WHY THIS EXISTS (VERDICT.md round 4, "Next round" #4; SURVEY.md §7 M8):
+both banked real-TPU windows showed the XLA ``lax.while_loop`` driver
+paying ~5 ms per sequential loop TRIP on the axon tunnel — a dispatch
+floor that neither lane width nor the freeze-guarded UNROLL measurably
+cut on-chip.  SURVEY.md names Pallas as the escalation when XLA
+while-loop behavior caps the kernel: a Pallas kernel runs its WHOLE
+iteration chunk inside one Mosaic kernel launch, so per-trip cost is VPU
+arithmetic, not XLA loop-trip overhead.  This module is the measured
+A/B, not a replacement: scope is deliberately the scalar-table fast path
+only (CAS / register / ticket / set — ``scalar_state_bound`` specs, the
+headline configuration), no in-kernel memo cache, ≤32 ops (one-word
+bitmasks).
+
+Design — the same branchless DFS as ops/jax_kernel.py, transposed:
+
+* lanes ride the MINOR axis (``[…, L]`` with L a multiple of 128) so
+  every per-op / per-depth sweep is an (8,128)-tiled VPU op;
+* the per-lane DFS state is the same explicit stack (``taken``,
+  ``chosen``, ``states``, depth/status/iters), selected and updated with
+  one-hot mask arithmetic — no scatters, no per-lane dynamic slices;
+* precedence is packed into one uint32 word per op (``prec_word[j]`` =
+  bitmask of ops that must precede j), so the minimality mask is a
+  single word-AND against the untaken bitmask (N ≤ 32 makes W = 1);
+* the step table is precomputed per lane OUTSIDE the kernel (one jitted
+  ``vmap`` of ``spec.step_jax`` over lanes × states × ops) and gathered
+  in-kernel by a one-hot sweep over the S = ``scalar_state_bound``
+  states (S ≤ ~8 for every table spec in-tree);
+* one ``pallas_call`` advances every lane by exactly ``chunk``
+  iterations via ``jax.lax.fori_loop``; decided lanes no-op through the
+  remaining trips (the same freeze-guard contract as the XLA kernel's
+  UNROLL micro-steps).
+
+Verdict semantics are identical to ``JaxTPU``: SUCCESS / FAILURE /
+BUDGET_EXCEEDED (honest indecision), pending ops expanded host-side,
+out-of-domain histories deferred to the oracle.  The host driver (class
+``PallasTPU``) subclasses ``JaxTPU`` so all of that host logic is
+inherited; only ``_run_device`` is replaced.
+
+On the CPU platform the kernel runs in Pallas interpret mode (Mosaic
+compiles only on a real TPU) — correct but slow, so tests keep corpora
+tiny; the measured A/B lives in tools/bench_scale.py's ``pallas``
+variant cell, which only a real device window runs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.history import History, bucket_for, encode_batch
+from .jax_kernel import BUDGET, FAILURE, RUNNING, SUCCESS, JaxTPU
+
+MAX_PALLAS_OPS = 32     # one-word taken/precedence bitmasks
+MAX_PALLAS_STATES = 64  # the in-kernel state gather is a one-hot sweep
+# over S rows (O(S·N) VPU work per trip) and the step table lives in VMEM
+# as [S, N, L] — S=1280 (the queue/stack scalarized shadows) would blow
+# both; every non-vector spec in-tree is ≤49
+
+
+def build_pallas_chunk(spec, n_ops: int, state_bound: int, lanes: int,
+                       chunk: int, budget: int, interpret: bool):
+    """One compiled pallas_call advancing ``lanes``-wide blocks by
+    ``chunk`` DFS iterations.  Returns ``fn(tables, carry) -> carry`` over
+    lane-minor arrays (see module docstring for layouts)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    N, S, L = n_ops, state_bound, lanes
+
+    def kernel(nxt_ref, ok_ref, prec_ref, valid_ref, nreq_ref,
+               taken_ref, chosen_ref, states_ref, dsi_ref,
+               taken_o, chosen_o, states_o, dsi_o):
+        nxt_tab = nxt_ref[:]        # [S, N, L] int32
+        ok_tab = ok_ref[:]          # [S, N, L] int32 (0/1)
+        prec = prec_ref[:]          # [N, L] uint32
+        valid = valid_ref[:]        # [N, L] int32 (0/1)
+        nreq = nreq_ref[0, :]       # [L]
+
+        nio = jax.lax.broadcasted_iota(jnp.int32, (N, L), 0)
+        kio = jax.lax.broadcasted_iota(jnp.int32, (N + 1, L), 0)
+        sio = jax.lax.broadcasted_iota(jnp.int32, (S, L), 0)
+        shift = nio.astype(jnp.uint32)
+
+        def body(_, c):
+            taken, chosen, states, d, status, iters = c
+            active = status == RUNNING                       # [L]
+            dm = (kio == d[None, :]).astype(jnp.int32)       # [N+1, L]
+            state = jnp.sum(states * dm, axis=0)             # [L]
+            cur = jnp.sum(chosen * dm, axis=0)               # [L]
+            untaken = valid * (1 - taken)                    # [N, L]
+            uw = jnp.sum(untaken.astype(jnp.uint32) << shift, axis=0)
+            blocked = (prec & uw[None, :]) != jnp.uint32(0)  # [N, L]
+            sm = (sio == state[None, :]).astype(jnp.int32)   # [S, L]
+            ok_row = jnp.sum(ok_tab * sm[:, None, :], axis=0)    # [N, L]
+            nxt_row = jnp.sum(nxt_tab * sm[:, None, :], axis=0)  # [N, L]
+            cand = ((untaken == 1) & ~blocked & (ok_row == 1)
+                    & (nio > cur[None, :]))                  # [N, L]
+            has = jnp.any(cand, axis=0)                      # [L]
+            jstar = jnp.min(jnp.where(cand, nio, N), axis=0)
+            jm = (nio == jstar[None, :]).astype(jnp.int32)   # [N, L]
+            child = jnp.sum(nxt_row * jm, axis=0)            # [L]
+            success = has & (d + 1 == nreq)
+            descend = has & active
+            d_back = jnp.maximum(d - 1, 0)
+            dbm = (kio == d_back[None, :]).astype(jnp.int32)
+            prev = jnp.maximum(jnp.sum(chosen * dbm, axis=0), 0)
+            back = active & ~has & (d > 0)
+
+            taken_n = jnp.where(
+                descend[None, :], jnp.maximum(taken, jm),
+                jnp.where(back[None, :] & (nio == prev[None, :]),
+                          0, taken))
+            chosen_n = jnp.where(
+                descend[None, :] & (kio == d[None, :]),
+                jstar[None, :],
+                jnp.where(descend[None, :] & (kio == d[None, :] + 1),
+                          -1, chosen))
+            states_n = jnp.where(
+                descend[None, :] & (kio == d[None, :] + 1),
+                child[None, :], states)
+            d_n = jnp.where(descend, d + 1,
+                            jnp.where(active, d_back, d))
+            iters_n = iters + active.astype(jnp.int32)
+            status_n = jnp.where(
+                active & success, SUCCESS,
+                jnp.where(active & ~has & (d == 0), FAILURE, status))
+            status_n = jnp.where(
+                (status_n == RUNNING) & (iters_n >= budget),
+                BUDGET, status_n)
+            return (taken_n, chosen_n, states_n, d_n, status_n, iters_n)
+
+        init = (taken_ref[:], chosen_ref[:], states_ref[:],
+                dsi_ref[0, :], dsi_ref[1, :], dsi_ref[2, :])
+        taken, chosen, states, d, status, iters = jax.lax.fori_loop(
+            0, chunk, body, init)
+        taken_o[:] = taken
+        chosen_o[:] = chosen
+        states_o[:] = states
+        dsi_o[0, :] = d
+        dsi_o[1, :] = status
+        dsi_o[2, :] = iters
+
+    def fn(nxt, ok, prec, valid, nreq, taken, chosen, states, dsi):
+        B = nxt.shape[-1]
+        grid = (B // L,)
+        out_shape = (
+            jax.ShapeDtypeStruct((N, B), jnp.int32),
+            jax.ShapeDtypeStruct((N + 1, B), jnp.int32),
+            jax.ShapeDtypeStruct((N + 1, B), jnp.int32),
+            jax.ShapeDtypeStruct((3, B), jnp.int32),
+        )
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((S, N, L), lambda i: (0, 0, i)),
+                pl.BlockSpec((S, N, L), lambda i: (0, 0, i)),
+                pl.BlockSpec((N, L), lambda i: (0, i)),
+                pl.BlockSpec((N, L), lambda i: (0, i)),
+                pl.BlockSpec((1, L), lambda i: (0, i)),
+                pl.BlockSpec((N, L), lambda i: (0, i)),
+                pl.BlockSpec((N + 1, L), lambda i: (0, i)),
+                pl.BlockSpec((N + 1, L), lambda i: (0, i)),
+                pl.BlockSpec((3, L), lambda i: (0, i)),
+            ],
+            out_specs=(
+                pl.BlockSpec((N, L), lambda i: (0, i)),
+                pl.BlockSpec((N + 1, L), lambda i: (0, i)),
+                pl.BlockSpec((N + 1, L), lambda i: (0, i)),
+                pl.BlockSpec((3, L), lambda i: (0, i)),
+            ),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(nxt, ok, prec, valid, nreq, taken, chosen, states, dsi)
+
+    return jax.jit(fn)
+
+
+class PallasTPU(JaxTPU):
+    """Pallas-kernel backend for scalar-table specs (prototype).
+
+    Inherits every host-side contract from :class:`JaxTPU` (pending
+    expansion, domain gating, scalarized shadows, witness plumbing) and
+    replaces only the device driver.  Raises at construction for specs
+    outside the prototype's scope — use ``JaxTPU`` there."""
+
+    name = "pallas_tpu"
+
+    LANES = 256          # lanes per Mosaic block (minor axis; 128-mult)
+    PALLAS_CHUNK = 1024  # DFS iterations per pallas_call
+
+    def __init__(self, spec, budget: int = 2_000, interpret=None, **kw):
+        super().__init__(spec, budget=budget, **kw)
+        if not self._uses_table:
+            raise ValueError(
+                "PallasTPU covers scalar-table specs only (CAS / register "
+                "/ ticket / set — scalar_state_bound); use JaxTPU")
+        bound = self.kspec.scalar_state_bound(MAX_PALLAS_OPS)
+        if bound is None or bound > MAX_PALLAS_STATES:
+            raise ValueError(
+                f"PallasTPU covers scalar-table specs with state bound "
+                f"<= {MAX_PALLAS_STATES} (got {bound}); use JaxTPU")
+        self.interpret = interpret  # None = auto (interpret off-TPU)
+        self._pallas_fns: Dict[Tuple, object] = {}
+        self._table_fns: Dict[int, object] = {}
+        self.pallas_calls = 0
+        self.pallas_trips = 0  # chunk iterations dispatched (per lane)
+
+    def _interpret(self) -> bool:
+        if self.interpret is not None:
+            return self.interpret
+        import jax
+
+        return jax.default_backend() != "tpu"
+
+    def _chunk_kernel(self, n_ops: int, state_bound: int):
+        key = (n_ops, state_bound, self.PALLAS_CHUNK, self._interpret())
+        fn = self._pallas_fns.get(key)
+        if fn is None:
+            fn = build_pallas_chunk(self.kspec, n_ops, state_bound,
+                                    self.LANES, self.PALLAS_CHUNK,
+                                    self.total_budget, self._interpret())
+            self._pallas_fns[key] = fn
+        return fn
+
+    def _table_fn(self, n_ops: int):
+        """Jitted per-lane step-table builder:
+        (cmd[B,N], arg, resp) -> (nxt[B,S,N], ok[B,S,N])."""
+        fn = self._table_fns.get(n_ops)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            S = self.kspec.scalar_state_bound(n_ops)
+            spec = self.kspec
+
+            def one(cmd, arg, resp):
+                def row(s):
+                    st = jnp.full((1,), s, jnp.int32)
+                    nxt, ok = jax.vmap(
+                        lambda cc, aa, rr: spec.step_jax(st, cc, aa, rr),
+                        out_axes=(0, 0))(cmd, arg, resp)
+                    return (nxt.reshape(-1).astype(jnp.int32),
+                            ok.reshape(-1).astype(jnp.int32))
+
+                return jax.vmap(row)(jnp.arange(S, dtype=jnp.int32))
+
+            fn = jax.jit(jax.vmap(one))
+            self._table_fns[n_ops] = fn
+        return fn
+
+    # -- the pallas driver: flat batch in, statuses out -------------------
+    def _run_device(self, flat: Sequence[History],
+                    flat_inits: Optional[List] = None,
+                    collect_chosen: bool = False):
+        import jax.numpy as jnp
+
+        top = self.MAX_BATCH
+        if len(flat) > top:
+            parts = [
+                self._run_device(
+                    flat[i:i + top],
+                    flat_inits[i:i + top] if flat_inits else None,
+                    collect_chosen=collect_chosen)
+                for i in range(0, len(flat), top)]
+            if collect_chosen:
+                width = max(p[1].shape[1] for p in parts)
+                padded = [np.pad(p[1], ((0, 0), (0, width - p[1].shape[1])),
+                                 constant_values=-1) for p in parts]
+                return (np.concatenate([p[0] for p in parts]),
+                        np.concatenate(padded))
+            return np.concatenate(parts)
+
+        n_ops = bucket_for(max(len(h) for h in flat) or 1)
+        if n_ops > MAX_PALLAS_OPS:
+            raise ValueError(
+                f"PallasTPU covers ≤{MAX_PALLAS_OPS} ops (one-word "
+                f"bitmasks); got bucket {n_ops} — use JaxTPU")
+        S = self.kspec.scalar_state_bound(n_ops)
+        enc = encode_batch(flat, self.kspec.initial_state(), max_ops=n_ops)
+        b = len(flat)
+        B = ((b + self.LANES - 1) // self.LANES) * self.LANES  # lane pad
+        N = n_ops
+
+        cmd = enc.ops[:, :, 1].astype(np.int32)
+        arg = enc.ops[:, :, 2].astype(np.int32)
+        resp = enc.ops[:, :, 3].astype(np.int32)
+        valid = enc.valid.astype(bool)
+        prec = enc.precedes().astype(bool)          # [b, N, N] i precedes j
+        inits = np.tile(np.asarray(enc.init_state, np.int32), (b, 1))
+        if flat_inits is not None:
+            for i, s in enumerate(flat_inits):
+                inits[i] = (np.asarray([self._shadow.pack(s)], np.int32)
+                            if self._shadow is not None
+                            else np.asarray(s, np.int32))
+
+        # per-lane step tables (one jitted call), then lane-minor layout
+        nxt_t, ok_t = self._table_fn(n_ops)(
+            jnp.asarray(cmd), jnp.asarray(arg), jnp.asarray(resp))
+        nxt = np.zeros((S, N, B), np.int32)
+        ok = np.zeros((S, N, B), np.int32)
+        nxt[:, :, :b] = np.transpose(np.asarray(nxt_t), (1, 2, 0))
+        ok[:, :, :b] = np.transpose(np.asarray(ok_t), (1, 2, 0))
+        prec_word = np.zeros((N, B), np.uint32)
+        pw = (prec.astype(np.uint64)
+              << np.arange(N, dtype=np.uint64)[None, :, None]).sum(axis=1)
+        prec_word[:, :b] = pw.astype(np.uint32).T
+        valid_lm = np.zeros((N, B), np.int32)
+        valid_lm[:, :b] = valid.T
+        nreq = np.zeros((1, B), np.int32)
+        nreq[0, :b] = valid.sum(axis=1)
+
+        taken = np.zeros((N, B), np.int32)
+        chosen = np.full((N + 1, B), -1, np.int32)
+        states = np.zeros((N + 1, B), np.int32)
+        states[0, :b] = inits[:, 0]
+        dsi = np.zeros((3, B), np.int32)
+        # padding lanes (and genuinely empty histories) have n_req == 0:
+        # immediately SUCCESS, frozen through every trip
+        dsi[1] = np.where(nreq[0] == 0, SUCCESS, RUNNING)
+
+        fn = self._chunk_kernel(n_ops, S)
+        tables = (jnp.asarray(nxt), jnp.asarray(ok),
+                  jnp.asarray(prec_word), jnp.asarray(valid_lm),
+                  jnp.asarray(nreq))
+        carry = (jnp.asarray(taken), jnp.asarray(chosen),
+                 jnp.asarray(states), jnp.asarray(dsi))
+        max_calls = -(-self.total_budget // self.PALLAS_CHUNK)
+        for _ in range(max_calls):
+            carry = fn(*tables, *carry)
+            self.pallas_calls += 1
+            self.pallas_trips += self.PALLAS_CHUNK
+            status_h = np.asarray(carry[3][1])
+            self.rounds_run += 1
+            self.lockstep_cost += self.PALLAS_CHUNK * B
+            if not (status_h == RUNNING).any():
+                break
+        status_h = np.asarray(carry[3][1])[:b].astype(np.int32)
+        # any lane still RUNNING after the budget's worth of chunks is
+        # honest indecision (belt and braces; in-kernel budget already
+        # flips these to BUDGET)
+        status_h = np.where(status_h == RUNNING, BUDGET, status_h)
+        self.device_histories += b
+        self.batches_run += 1
+        if collect_chosen:
+            chosen_h = np.asarray(carry[1]).T[:b]
+            return status_h, chosen_h
+        return status_h

@@ -29,9 +29,9 @@ from .stats import schedule_coverage
 # else the memoised oracle) — the default for `run`, where a user just
 # wants verdicts (kv-64 under the raw memo oracle costs ~17s per 60
 # trials; the native path ~1s, identical verdicts)
-_BACKENDS = ("auto", "auto-tpu", "cpu", "cpp", "tpu", "hybrid-tpu", "pcomp",
-             "pcomp-cpp", "pcomp-tpu", "segdc", "segdc-cpp", "segdc-tpu",
-             "rootsplit", "rootsplit-tpu")
+_BACKENDS = ("auto", "auto-tpu", "cpu", "cpp", "tpu", "hybrid-tpu",
+             "pallas-tpu", "pcomp", "pcomp-cpp", "pcomp-tpu", "segdc",
+             "segdc-cpp", "segdc-tpu", "rootsplit", "rootsplit-tpu")
 
 # index == Verdict value (ops/backend.py); ONE site for the rendering
 _VERDICT_NAMES = ("VIOLATION", "LINEARIZABLE", "BUDGET_EXCEEDED")
@@ -148,6 +148,14 @@ def _make_backend_inner(name: str, spec):
         from ..ops.router import AutoDevice
 
         return AutoDevice(spec)
+    if name == "pallas-tpu":
+        # Mosaic-kernel prototype of the scalar-table search: the whole
+        # iteration chunk runs inside one kernel launch instead of an XLA
+        # while-loop (ops/pallas_kernel.py; scalar-table specs ≤32 ops)
+        _ensure_device_reachable()
+        from ..ops.pallas_kernel import PallasTPU
+
+        return PallasTPU(spec)
     if name == "pcomp":
         from ..ops.pcomp import PComp
 

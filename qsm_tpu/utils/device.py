@@ -165,6 +165,24 @@ def enable_compile_cache(dirpath: Optional[str] = None) -> None:
         pass           # a reason to fail a bench or a test
 
 
+def compile_cache_entries(dirpath: Optional[str] = None) -> Optional[int]:
+    """Entry count of the persistent compile cache directory, or None when
+    it does not exist.  Benchmarks stamp this before/after their compile
+    phase so an artifact records whether its warm-up paid real
+    first-compiles or hit the cross-process cache (VERDICT.md round 4,
+    "What's weak" #3: nothing in the banked windows records compile-cache
+    state, so compile-cost-inside-the-window could not be ruled out)."""
+    if dirpath is None:
+        dirpath = os.environ.get(
+            "QSM_TPU_COMPILE_CACHE",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    try:
+        return sum(1 for e in os.scandir(dirpath) if e.is_file())
+    except OSError:
+        return None
+
+
 def force_cpu_platform(n_devices: Optional[int] = None) -> None:
     """Force THIS process onto the JAX CPU platform (before any device use).
 

@@ -59,6 +59,7 @@ def test_every_backend_choice_constructs(healthy_probe):
     from qsm_tpu.native import CppOracle
     from qsm_tpu.ops.hybrid import HybridDevice
     from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.ops.pallas_kernel import PallasTPU
     from qsm_tpu.ops.pcomp import PComp
     from qsm_tpu.ops.router import AutoDevice
     from qsm_tpu.ops.rootsplit import RootSplit
@@ -84,6 +85,8 @@ def test_every_backend_choice_constructs(healthy_probe):
         "auto": (CppOracle, QueueSpec),
         "auto-tpu": (AutoDevice, QueueSpec),
         "hybrid-tpu": (HybridDevice, QueueSpec),
+        # pallas covers scalar-table specs only — constructed on CAS
+        "pallas-tpu": (PallasTPU, CasSpec),
     }
     assert set(want) == set(_BACKENDS)
     for name, (ty, mk_spec) in want.items():

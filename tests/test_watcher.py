@@ -32,10 +32,12 @@ def w(tmp_path, monkeypatch):
                         str(tmp_path / "BENCH_TPU_WINDOW.json"))
     mod.COMMITTED_COPIES = {
         str(tmp_path / "BENCH_TPU_WINDOW.json"):
-            str(tmp_path / "BENCH_TPU_r04.json"),
+            str(tmp_path / "BENCH_TPU_r05.json"),
         str(tmp_path / "BENCH_SCALE_TPU_WINDOW.json"):
-            str(tmp_path / "BENCH_SCALE_TPU_r04.json"),
+            str(tmp_path / "BENCH_SCALE_TPU_r05.json"),
     }
+    monkeypatch.setattr(mod, "CAPTURES_LOG",
+                        str(tmp_path / "BENCH_TPU_CAPTURES_r05.jsonl"))
     return mod
 
 
@@ -59,23 +61,29 @@ def test_tool_rows_excludes_skipped_markers(w, tmp_path):
 
 
 def test_seize_all_banked_is_silent(w, tmp_path, monkeypatch):
-    """With every artifact banked, a healthy probe cycle must neither log
-    event spam nor launch any subprocess (the round-4 review found the
-    pre-fix watcher appending ~5 fake-success lines per cycle)."""
-    (tmp_path / "BENCH_TPU_WINDOW.json").write_text("{}")
+    """With every artifact banked — and the headline's stamped settings
+    matching what the banked scan decided — a healthy probe cycle must
+    neither log event spam nor launch any subprocess (the round-4 review
+    found the pre-fix watcher appending ~5 fake-success lines per
+    cycle)."""
+    (tmp_path / "BENCH_TPU_WINDOW.json").write_text(
+        json.dumps({"extras": {"device_batch": 4096, "unroll": 8}}))
     (tmp_path / "BENCH_CONFIGS_TPU_WINDOW.json").write_text("{}")
     (tmp_path / "BENCH_E2E_TPU_WINDOW.json").write_text("{}")
     scale = [{"h": 1, "device_fallback": None}] + [
         {"batch": b, "rate_h_per_s": 1.0, "wrong": 0}
         for b in (4096, 16384, 65536, 262144)] + [
-        {"variant": "unroll1", "rate_h_per_s": 1.0, "wrong": 0},
+        {"batch": 4096, "variant": "unroll1", "rate_h_per_s": 1.0,
+         "wrong": 0},
+        {"batch": 4096, "variant": "pallas", "rate_h_per_s": 1.0,
+         "wrong": 0},
         {"variant": "budget2k", "rate_h_per_s": 1.0, "wrong": 0}]
     (tmp_path / "BENCH_SCALE_TPU_WINDOW.json").write_text(
         "\n".join(json.dumps(r) for r in scale) + "\n")
-    pdir = tmp_path / "profiles" / "r04_tpu" / "plugins"
+    pdir = tmp_path / "profiles" / "r05_tpu" / "plugins"
     pdir.mkdir(parents=True)
     (pdir / "t.xplane.pb").write_bytes(b"x")
-    (tmp_path / "BENCH_SWEEP_r04.json").write_text(
+    (tmp_path / "BENCH_SWEEP_r05.json").write_text(
         json.dumps({"device_fallback": None}))
 
     def boom(*a, **k):
@@ -92,11 +100,11 @@ def test_fresh_headline_still_chases_missing_upgrades(w, tmp_path,
     the round-4 window banked the headline and closed before the
     upgrades; a same-round reopen must chase them."""
     (tmp_path / "BENCH_TPU_WINDOW.json").write_text(
-        json.dumps({"extras": {"device_batch": 4096}}))
+        json.dumps({"extras": {"device_batch": 4096, "unroll": 8}}))
     chased = []
     monkeypatch.setattr(
         w, "_run_tool",
-        lambda script, out, timeout, label, min_rows=0:
+        lambda script, out, timeout, label, min_rows=0, extra_args=():
             chased.append(label))
     monkeypatch.setattr(
         w, "_run_window_bench",
@@ -105,35 +113,43 @@ def test_fresh_headline_still_chases_missing_upgrades(w, tmp_path,
     assert "window_configs" in chased
     assert "window_e2e" in chased
     assert "window_scale" in chased
-    # headline bench was NOT re-run (fresh), only logged as kept
+    # the scan outranks everything: round-4's windows died headline-first
+    assert chased[0] == "window_scale"
+    # headline bench was NOT re-run (fresh, settings current since no
+    # banked scan contradicts them), only logged as kept
     assert "window_bench_headline" not in chased
     assert any(e.get("event") == "window_bench_headline"
-               and "fresh capture" in e.get("detail", "")
+               and "kept" in e.get("detail", "")
                for e in _events(w))
 
 
 def test_stale_headline_is_rebenched(w, tmp_path, monkeypatch):
     art = tmp_path / "BENCH_TPU_WINDOW.json"
-    art.write_text(json.dumps({"extras": {"device_batch": 4096}}))
+    art.write_text(json.dumps({"extras": {"device_batch": 4096,
+                                          "unroll": 8}}))
     old = time.time() - 4 * 3600
     os.utime(art, (old, old))
     ran = []
     monkeypatch.setattr(
         w, "_run_tool",
-        lambda script, out, timeout, label, min_rows=0: ran.append(label))
+        lambda script, out, timeout, label, min_rows=0, extra_args=():
+            ran.append(label))
     monkeypatch.setattr(
         w, "_run_window_bench",
         lambda *a, **k: ran.append(a[2]) or True)
     w._seize_window(600.0)
-    assert ran[0] == "window_bench_headline"
+    # scan first (the decision), then the stale headline re-bench
+    assert ran[0] == "window_scale"
+    assert "window_bench_headline" in ran
 
 
-def test_scale_best_batch_triggers_headline_rescale(w, tmp_path,
-                                                    monkeypatch):
-    """When the banked scan validates a better width than the banked
-    headline used, the headline is re-benched in the same window."""
+def test_scale_decision_triggers_headline_rescale(w, tmp_path,
+                                                  monkeypatch):
+    """When the banked scan's decision (width OR unroll) differs from the
+    settings the banked headline ran with, the headline is re-benched in
+    the same window even though it is fresh."""
     (tmp_path / "BENCH_TPU_WINDOW.json").write_text(
-        json.dumps({"extras": {"device_batch": 4096}}))
+        json.dumps({"extras": {"device_batch": 4096, "unroll": 8}}))
     scale = [{"artifact": "bench_scale", "device_fallback": None},
              {"batch": 4096, "rate_h_per_s": 100.0, "wrong": 0},
              {"batch": 65536, "rate_h_per_s": 900.0, "wrong": 0}]
@@ -142,21 +158,38 @@ def test_scale_best_batch_triggers_headline_rescale(w, tmp_path,
     ran = []
     monkeypatch.setattr(
         w, "_run_tool",
-        lambda script, out, timeout, label, min_rows=0: ran.append(label))
+        lambda script, out, timeout, label, min_rows=0, extra_args=():
+            ran.append(label))
     monkeypatch.setattr(
         w, "_run_window_bench",
         lambda *a, **k: ran.append(a[2]) or True)
-    # best_scale_batch reads files next to bench.py — point it at the
-    # sandbox via the real bench module's dirpath parameter (the watcher
-    # imports it from sys.modules["bench"] at seize time)
-    import bench as bench_mod
-    orig = bench_mod.best_scale_batch
-    monkeypatch.setattr(
-        bench_mod, "best_scale_batch",
-        lambda min_gain=1.2, dirpath=None: orig(min_gain,
-                                                dirpath=str(tmp_path)))
     w._seize_window(600.0)
-    assert "window_bench_rescaled" in ran
+    assert "window_bench_headline" in ran  # 65536 ≠ banked 4096
+
+
+def test_scale_unroll_decision_triggers_headline_rescale(w, tmp_path,
+                                                         monkeypatch):
+    """The scan deciding unroll1 invalidates a headline that ran
+    unroll8 — the exact regression the round-4 windows could not
+    attribute."""
+    (tmp_path / "BENCH_TPU_WINDOW.json").write_text(
+        json.dumps({"extras": {"device_batch": 4096, "unroll": 8}}))
+    scale = [{"artifact": "bench_scale", "device_fallback": None},
+             {"batch": 4096, "rate_h_per_s": 60.0, "wrong": 0},
+             {"batch": 4096, "variant": "unroll1",
+              "rate_h_per_s": 105.0, "wrong": 0}]
+    (tmp_path / "BENCH_SCALE_TPU_WINDOW.json").write_text(
+        "\n".join(json.dumps(r) for r in scale) + "\n")
+    ran = []
+    monkeypatch.setattr(
+        w, "_run_tool",
+        lambda script, out, timeout, label, min_rows=0, extra_args=():
+            ran.append(label))
+    monkeypatch.setattr(
+        w, "_run_window_bench",
+        lambda *a, **k: ran.append(a[2]) or True)
+    w._seize_window(600.0)
+    assert "window_bench_headline" in ran  # scan says unroll1 wins
 
 
 def test_run_tool_timeout_promotion_is_monotonic(w, tmp_path,
@@ -213,7 +246,7 @@ def test_run_tool_timeout_promotes_bigger_partial(w, tmp_path,
     kept = [json.loads(ln) for ln in out.read_text().splitlines()]
     assert len(kept) == 2  # promoted: 1 measured row > 0 banked
     # and the committed twin was banked too
-    assert (tmp_path / "BENCH_SCALE_TPU_r04.json").exists()
+    assert (tmp_path / "BENCH_SCALE_TPU_r05.json").exists()
 
 
 def test_scale_completeness_is_content_based(w, tmp_path):
@@ -225,6 +258,7 @@ def test_scale_completeness_is_content_based(w, tmp_path):
         {"batch": b, "rate_h_per_s": 1.0, "wrong": 0}
         for b in (4096, 16384, 65536)] + [
         {"variant": "unroll1", "rate_h_per_s": 1.0},
+        {"variant": "pallas", "error": "Mosaic lowering failed"},
         {"variant": "budget2k", "rate_h_per_s": 1.0}]
     p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
     assert w._scale_complete(str(p)) is False  # 262144 missing

@@ -116,7 +116,7 @@ def bench_config(model: str, on_tpu: bool, n_corpus: int) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="/root/repo/BENCH_CONFIGS_r04.json")
+    ap.add_argument("--out", default="/root/repo/BENCH_CONFIGS_r05.json")
     ap.add_argument("--force-cpu", action="store_true")
     ap.add_argument("--probe-timeout", type=float, default=45.0)
     ap.add_argument("--corpus", type=int, default=None,
@@ -128,15 +128,16 @@ def main(argv=None) -> int:
     on_tpu, _detail, header = probe_or_force_cpu(args.force_cpu,
                                                  args.probe_timeout)
     n_corpus = args.corpus or (256 if on_tpu else 128)
-    lines = [{"artifact": "bench_configs", **header}]
+    # incremental writes so a window that closes mid-matrix still banks
+    # the configs already measured
+    with open(args.out, "w") as f:
+        f.write(json.dumps({"artifact": "bench_configs", **header}) + "\n")
     for model in ("register", "ticket", "cas", "queue", "kv",
                   "set", "stack"):
         rec = bench_config(model, on_tpu, n_corpus)
-        lines.append(rec)
         print(json.dumps(rec), flush=True)
-    with open(args.out, "w") as f:
-        for ln in lines:
-            f.write(json.dumps(ln) + "\n")
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
     return 0
 
 

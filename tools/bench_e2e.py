@@ -54,7 +54,7 @@ def run_one(label: str, backend_name: str, make_backend, sut_name: str,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="/root/repo/BENCH_E2E_r04.json")
+    ap.add_argument("--out", default="/root/repo/BENCH_E2E_r05.json")
     ap.add_argument("--force-cpu", action="store_true")
     ap.add_argument("--probe-timeout", type=float, default=45.0)
     ap.add_argument("--trials", type=int, default=150)
@@ -62,16 +62,20 @@ def main(argv=None) -> int:
 
     from qsm_tpu.utils.device import probe_or_force_cpu
 
-    _on_tpu, _detail, header = probe_or_force_cpu(args.force_cpu,
-                                                  args.probe_timeout)
+    on_tpu, _detail, header = probe_or_force_cpu(args.force_cpu,
+                                                 args.probe_timeout)
 
     from qsm_tpu.ops.jax_kernel import JaxTPU
     from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
 
-    lines = [{
-        "artifact": "bench_e2e", "config": "cas 32ops x 8pids, 4 schedules",
-        **header,
-    }]
+    # incremental writes: a window that closes mid-run still banks the
+    # rows already measured (round-4's window_e2e died twice leaving
+    # nothing — the all-at-the-end write was the reason)
+    with open(args.out, "w") as f:
+        f.write(json.dumps({
+            "artifact": "bench_e2e",
+            "config": "cas 32ops x 8pids, 4 schedules", **header,
+        }) + "\n")
     def _hybrid(s):
         from qsm_tpu.ops.hybrid import HybridDevice
 
@@ -100,19 +104,24 @@ def main(argv=None) -> int:
     # trial_batch=1 is the reference-shaped serial loop; 64 makes the
     # device see 256-lane batches (64 trials × 4 schedules) — the grouping
     # exists precisely because the split below showed per-call dispatch
-    # dominating the device path at batch 4
-    for bname, mk in backends.items():
+    # dominating the device path at batch 4.  On a real device the
+    # device-path rows run FIRST: they are the rows only a window can
+    # measure (round-3 task #8, still open on-chip), and host rows would
+    # burn window wall-clock on the host core.
+    names = list(backends)
+    if on_tpu:
+        names.sort(key=lambda n: n not in ("device", "hybrid"))
+    for bname in names:
+        mk = backends[bname]
         for sut_name in ("atomic", "racy"):
             for tb in ((1,) if bname not in ("device", "hybrid")
                        else (1, 64)):
                 rec = run_one(f"cas-{sut_name}", bname, mk, sut_name,
                               args.trials, trial_batch=tb)
                 rec["trial_batch"] = tb
-                lines.append(rec)
                 print(json.dumps(rec), flush=True)
-    with open(args.out, "w") as f:
-        for ln in lines:
-            f.write(json.dumps(ln) + "\n")
+                with open(args.out, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
     return 0
 
 

@@ -1,0 +1,107 @@
+"""Frozen host-oracle denominators — measured once per round, committed.
+
+VERDICT.md round 4, "Next round" #5: vs_baseline swung 694× → 512×
+between the two banked windows purely from host-side re-measurement of
+the naive oracle on a 14-18-history sample under unknown host load.  The
+number the round is judged on must not inherit ~30% noise from its
+denominator.  This tool measures the three host checkers ONCE on the
+exact bench.py corpus (CAS 32 ops × 8 pids, seed_base 1000) with a
+≥100-sample naive corpus, and writes ``BASELINE_HOST_rN.json``;
+bench.py then reports ``vs_baseline_frozen`` / ``vs_best_host_frozen``
+against this file alongside the live-remeasured ratios, flagging >20%
+drift.
+
+Host-only by design: run it while the chip is wedged (most of the round)
+so the measurement happens on an otherwise idle machine.
+
+Usage: python tools/bench_host_baseline.py [--out BASELINE_HOST_rN.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/root/repo/BASELINE_HOST_r05.json")
+    ap.add_argument("--naive-sample", type=int, default=128,
+                    help="histories for the naive-oracle rate (>=100 per "
+                         "VERDICT r4 task #5)")
+    ap.add_argument("--naive-timebox", type=float, default=1500.0)
+    args = ap.parse_args(argv)
+
+    from qsm_tpu.utils.device import force_cpu_platform
+
+    force_cpu_platform()  # never touch the chip; host rates only
+
+    from bench import build_corpus
+    from qsm_tpu.models import CasSpec
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+
+    spec = CasSpec()
+    corpus = build_corpus(spec, 512)
+
+    # --- naive oracle (the reference-faithful baseline denominator) ------
+    oracle = WingGongCPU(node_budget=20_000_000)
+    times = []
+    t0 = time.perf_counter()
+    for h in corpus[:args.naive_sample]:
+        t1 = time.perf_counter()
+        oracle.check_histories(spec, [h])
+        times.append(time.perf_counter() - t1)
+        if time.perf_counter() - t0 > args.naive_timebox:
+            break
+    naive_s = time.perf_counter() - t0
+    naive_rate = len(times) / naive_s
+
+    # --- memoised oracle (best pure-Python host checker) -----------------
+    memo = WingGongCPU(memo=True)
+    t0 = time.perf_counter()
+    memo.check_histories(spec, corpus)
+    memo_rate = len(corpus) / (time.perf_counter() - t0)
+
+    # --- native C++ checker (best host checker overall) ------------------
+    cpp_rate = None
+    try:
+        from qsm_tpu.native import CppOracle, native_available
+
+        if native_available():
+            cpp = CppOracle(spec)
+            cpp.check_histories(spec, corpus)  # build + table compile
+            t0 = time.perf_counter()
+            cpp.check_histories(spec, corpus)
+            if cpp.native_histories > 0:
+                cpp_rate = round(len(corpus) / (time.perf_counter() - t0), 1)
+    except Exception:  # noqa: BLE001 — optional fast path
+        pass
+
+    result = {
+        "artifact": "host_baseline",
+        "config": "cas 32ops x 8pids, seed_base 1000 (bench.py corpus)",
+        "iso": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "cpu_oracle_rate": round(naive_rate, 4),
+        "cpu_oracle_sample": len(times),
+        "cpu_oracle_median_s": round(float(np.median(times)), 4),
+        "cpu_oracle_p90_s": round(float(np.percentile(times, 90)), 4),
+        "cpu_memo_oracle_rate": round(memo_rate, 1),
+        "cpp_oracle_rate": cpp_rate,
+        "corpus_unique": len(corpus),
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,26 +1,34 @@
-"""Batch-width scaling artifact — does widening the lockstep batch
-amortize the per-trip latency the first real-TPU window exposed?
+"""Batch-width / unroll scaling artifact — the DECISION measurement the
+round-5 seize pipeline banks FIRST (VERDICT.md round 4, "Next round" #1).
 
-BENCH_TPU_r04.json (the round-4 banked window) showed the chunked device
-driver at 105.6 h/s with batch 4096: ~7.6k while-loop trips per timed
-rep at ~5 ms/trip, i.e. per-trip LATENCY, not lane width, dominates on
-the axon tunnel (a 1-core CPU pays 3.6 ms/trip on a 256-lane batch of
-the same kernel).  If per-trip cost is flat in width, throughput scales
-with batch until HBM bandwidth binds — this tool measures exactly that
-on the real chip: histories/sec at batch 4096 / 16384 / 65536 on the
-bench.py CAS corpus, with full verdict parity against the memoised host
-oracle on every lane.
+BENCH_TPU_r04.json (the round-4 banked windows) left two open questions
+the headline alone cannot answer:
 
-Each row is measured with a fresh ``JaxTPU`` whose ``MAX_BATCH`` is
-raised to the row's batch (the buckets above 4096 exist only for this —
-ops/jax_kernel.py).  Rows are written incrementally (header first, then
-one JSON line per batch as it lands) so a window that closes mid-scan
-still leaves the smaller batches' measurements in the artifact.
+* does UNROLL=8 help or hurt on the real chip?  (The only post-unroll
+  on-chip datapoint moved the WRONG way: 105.6 → 61.6 h/s across the
+  unroll landing, with host denominators also shifting ~3×, so the
+  regression is unattributed.)
+* is per-trip cost flat in lane width?  (If yes, throughput scales with
+  batch until HBM binds and vs_best_host ≥ 1 is reachable; if no, the
+  flagship formally pivots to the hybrid backend.)
+
+Cell order is therefore DECISION-first, so a window that closes after
+any prefix still decides something:
+
+  1. unroll8 @ 4096  — the exact headline configuration (control row);
+  2. unroll1 @ 4096  — the unroll A/B at matched width;
+  3. unroll8 @ 16384 / 65536 / 262144 — the width ladder;
+  4. budget2k / oneshot diagnostics at the best width.
+
+Rows are written incrementally (header first, then one JSON line per
+cell as it lands) and every row stamps the kernel settings it ran with
+(unroll, chunk schedule, budget, MAX_BATCH) so the artifact is
+self-describing across kernel changes.
 
 bench.py reads the best zero-wrong-verdict row of a DEVICE-captured copy
-of this artifact and adopts its batch for the headline; the watcher
-(tools/probe_watcher.py) banks it during a window and re-benches the
-headline when the best batch beats the banked headline's.
+of this artifact and adopts its batch (and unroll, when the unroll1
+control beats the unroll8 control) for the headline; the watcher
+(tools/probe_watcher.py) banks it during a window BEFORE the headline.
 
 Probe-guarded exactly like bench.py.  Usage:
 
@@ -48,12 +56,20 @@ DEVICE_BATCHES = (4096, 16384, 65536, 262144)
 CPU_BATCHES = (256, 1024)
 TIME_BOX_S = 900.0  # stop starting new rows beyond this much measuring
 
+# Width of the unroll A/B cells.  Both controls run at the SAME width so
+# the comparison isolates the unroll knob (the round-4 windows confounded
+# unroll with everything else that moved between captures).
+CONTROL_BATCH = 4096
+CPU_CONTROL_BATCH = 256
 
-def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
+
+def run_scale(on_tpu: bool, out_path: str, header: dict,
+              time_box_s: float = TIME_BOX_S) -> list:
     from bench import build_corpus
     from qsm_tpu.models import CasSpec
     from qsm_tpu.ops.jax_kernel import JaxTPU
     from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+    from qsm_tpu.utils.device import compile_cache_entries
 
     spec = CasSpec()
     n_unique = 512 if on_tpu else 128
@@ -79,42 +95,37 @@ def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
         pass
 
     lines = [{"artifact": "bench_scale", "corpus_unique": len(corpus),
-              "cpp_rate_h_per_s": cpp_rate, **header}]
+              "cpp_rate_h_per_s": cpp_rate,
+              "compile_cache_entries_at_start": compile_cache_entries(),
+              **header}]
     with open(out_path, "w") as f:
         f.write(json.dumps(lines[0]) + "\n")
         f.flush()
 
-    def measure(batch, variant=None, schedule=None, backend_kw=None,
-                unroll=8):
-        # unroll=8 is the production setting bench.py runs the headline
-        # with (5.2x on the CPU platform; per-trip overhead dominates) —
-        # width rows measure THAT kernel so best_scale_batch adoption
-        # and the headline share a basis; the unroll1 control row keeps
-        # the A/B on-chip evidence.
+    def _timed_cell(row, batch, make_backend, counters):
+        """The shared cell scaffold: tile the corpus to ``batch`` lanes,
+        warm (compile) with cache-entry stamps, zero the per-run
+        ``counters`` (row_key -> backend attr), run ONE timed pass, and
+        score rate/undecided/wrong against the tiled memo verdicts.  One
+        definition so the pallas A/B rows stay comparable with the XLA
+        rows they exist to be compared against (any change to the rate
+        or wrong-verdict math lands in every cell)."""
         reps = (batch + len(corpus) - 1) // len(corpus)
         device_corpus = (corpus * reps)[:batch]
         tiled_memo = np.tile(memo_verdicts, reps)[:batch]
-        row = {"batch": batch}
-        if variant:
-            row["variant"] = variant
         try:
-            backend = JaxTPU(spec, budget=2_000, **(backend_kw or {}))
-            backend.MAX_BATCH = batch
-            backend.UNROLL = unroll
-            if schedule is not None:
-                backend.CHUNK_SCHEDULE = schedule
-            elif on_tpu:
-                backend.CHUNK_SCHEDULE = (2048, 65536)
+            backend = make_backend()
+            row.setdefault("settings", {})["cache_entries_before"] = \
+                compile_cache_entries()
             t0 = time.perf_counter()
             backend.check_histories(spec, device_corpus)  # compile + warm
             row["warm_s"] = round(time.perf_counter() - t0, 2)
+            row["settings"]["cache_entries_after"] = \
+                compile_cache_entries()
             # zero EVERY per-run counter the row reports, or the stats
             # mix the warm pass with the timed pass
-            backend.lockstep_cost = 0
-            backend.rounds_run = 0
-            backend.host_sync_s = 0.0
-            backend.compactions = 0
-            backend.rescued = 0
+            for attr in counters.values():
+                setattr(backend, attr, type(getattr(backend, attr))(0))
             t0 = time.perf_counter()
             verdicts = np.asarray(
                 backend.check_histories(spec, device_corpus))
@@ -125,19 +136,82 @@ def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
                 "wall_s": round(wall, 3),
                 "rate_h_per_s": round((batch - undecided) / wall, 1),
                 "undecided": undecided,
-                "wrong": int(np.sum(both
-                             & (verdicts != tiled_memo))),
-                "lockstep_iters": backend.lockstep_cost,
-                "rounds": backend.rounds_run,
-                "host_sync_s": round(backend.host_sync_s, 3),
-                "compactions": backend.compactions,
-                "rescued": backend.rescued,
+                "wrong": int(np.sum(both & (verdicts != tiled_memo))),
             })
-        except Exception as e:  # noqa: BLE001 — a failed width must not
-            # lose the smaller widths' rows (OOM at 65536 is a real
-            # possible outcome this tool exists to discover)
+            row.update({key: (round(getattr(backend, attr), 3)
+                              if isinstance(getattr(backend, attr), float)
+                              else getattr(backend, attr))
+                        for key, attr in counters.items()})
+        except Exception as e:  # noqa: BLE001 — a failed cell must not
+            # lose the earlier cells' rows (OOM at 262144, or the pallas
+            # prototype failing to compile on the real Mosaic stack, are
+            # real possible outcomes this tool exists to discover)
             row["error"] = f"{type(e).__name__}: {e}"[:300]
         return row
+
+    def measure_pallas(batch):
+        """The Pallas-vs-XLA-loop A/B cell (VERDICT r4 task #4): same
+        corpus, same budget semantics, whole iteration chunks inside one
+        Mosaic kernel launch instead of an XLA while-loop.  Only ever
+        run on a real device (interpret mode on the fallback is not a
+        measurement)."""
+        from qsm_tpu.ops.pallas_kernel import PallasTPU
+
+        row = {"batch": batch, "variant": "pallas"}
+
+        def mk():
+            backend = PallasTPU(spec, budget=2_000)
+            backend.MAX_BATCH = batch
+            row["settings"] = {
+                "pallas_chunk": backend.PALLAS_CHUNK,
+                "lanes_per_block": backend.LANES,
+                "budget": 2_000,
+            }
+            return backend
+
+        return _timed_cell(row, batch, mk, {
+            "pallas_calls": "pallas_calls",
+            "lockstep_iters": "lockstep_cost",
+        })
+
+    def measure(batch, variant=None, schedule=None, backend_kw=None,
+                unroll=8):
+        # unroll=8 is the production setting bench.py runs the headline
+        # with (5.2x on the CPU platform; per-trip overhead dominates) —
+        # width rows measure THAT kernel so best_scale_batch adoption
+        # and the headline share a basis; the unroll1 control row keeps
+        # the A/B on-chip evidence.
+        row = {"batch": batch}
+        if variant:
+            row["variant"] = variant
+
+        def mk():
+            backend = JaxTPU(spec, budget=2_000, **(backend_kw or {}))
+            backend.MAX_BATCH = batch
+            backend.UNROLL = unroll
+            if schedule is not None:
+                backend.CHUNK_SCHEDULE = schedule
+            elif on_tpu:
+                backend.CHUNK_SCHEDULE = (2048, 65536)
+            # the settings stamp makes every row self-describing across
+            # kernel-default changes (VERDICT r4 weak #3: the banked
+            # windows never recorded what they actually ran)
+            row["settings"] = {
+                "unroll": unroll,
+                "chunk_schedule": list(backend.CHUNK_SCHEDULE),
+                "budget": 2_000,
+                "mid_budget": (backend_kw or {}).get(
+                    "mid_budget", "default"),
+            }
+            return backend
+
+        return _timed_cell(row, batch, mk, {
+            "lockstep_iters": "lockstep_cost",
+            "rounds": "rounds_run",
+            "host_sync_s": "host_sync_s",
+            "compactions": "compactions",
+            "rescued": "rescued",
+        })
 
     def emit(row):
         lines.append(row)
@@ -147,8 +221,33 @@ def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
 
     t_start = time.perf_counter()
     widths = DEVICE_BATCHES if on_tpu else CPU_BATCHES
+    control = CONTROL_BATCH if on_tpu else CPU_CONTROL_BATCH
+
+    # --- decision cells first (VERDICT r4 task #1) -----------------------
+    # 1. unroll8 control at the headline width: the row every later width
+    #    and the adopted headline compare against.
+    emit(measure(control))
+    # 2. unroll1 at the SAME width: the on-chip unroll A/B the round-4
+    #    windows never measured.  Runs second because it is the single
+    #    cheapest cell that decides a kernel setting.
+    if time.perf_counter() - t_start <= time_box_s:
+        emit(measure(control, variant="unroll1", unroll=1))
+    else:
+        emit({"batch": control, "variant": "unroll1",
+              "skipped": "time box exhausted"})
+    # 3. the Pallas-vs-XLA-loop A/B at the control width (device only:
+    #    interpret mode on the fallback would measure the interpreter).
+    if on_tpu:
+        if time.perf_counter() - t_start <= time_box_s:
+            emit(measure_pallas(control))
+        else:
+            emit({"batch": control, "variant": "pallas",
+                  "skipped": "time box exhausted"})
+    # 4. the width ladder (control width already measured above).
     for batch in widths:
-        if time.perf_counter() - t_start > TIME_BOX_S:
+        if batch == control:
+            continue
+        if time.perf_counter() - t_start > time_box_s:
             emit({"batch": batch, "skipped": "time box exhausted"})
             continue
         emit(measure(batch))
@@ -164,17 +263,17 @@ def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
     # best_scale_batch ignores variant rows by construction.
     good = [r for r in lines[1:]
             if r.get("wrong") == 0 and "error" not in r
-            and "skipped" not in r and r.get("rate_h_per_s")]
-    if good and time.perf_counter() - t_start > TIME_BOX_S:
+            and "skipped" not in r and "variant" not in r
+            and r.get("rate_h_per_s")]
+    if good and time.perf_counter() - t_start > time_box_s:
         # marked, not silently absent — and the watcher's min_rows gate
         # counts rows, so the marker alone does not fake completeness;
         # a future window re-runs the scan and gets the diagnostics
         emit({"variant": "diagnostics", "skipped": "time box exhausted"})
-    if good and time.perf_counter() - t_start <= TIME_BOX_S:
+    if good and time.perf_counter() - t_start <= time_box_s:
         bstar = max(good, key=lambda r: r["rate_h_per_s"])["batch"]
-        emit(measure(bstar, variant="unroll1", unroll=1))
         emit(measure(bstar, variant="oneshot", schedule=(65536,)))
-        if time.perf_counter() - t_start <= TIME_BOX_S:
+        if time.perf_counter() - t_start <= time_box_s:
             b2k = measure(bstar, variant="budget2k",
                           backend_kw=dict(mid_budget=0, rescue_budget=0))
             emit(b2k)
@@ -197,16 +296,20 @@ def run_scale(on_tpu: bool, out_path: str, header: dict) -> list:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="/root/repo/BENCH_SCALE_r04.json")
+    ap.add_argument("--out", default="/root/repo/BENCH_SCALE_r05.json")
     ap.add_argument("--force-cpu", action="store_true")
     ap.add_argument("--probe-timeout", type=float, default=45.0)
+    ap.add_argument("--time-box", type=float, default=TIME_BOX_S,
+                    help="stop starting new cells beyond this many "
+                         "seconds of measuring (the watcher passes a "
+                         "window-sized box)")
     args = ap.parse_args(argv)
 
     from qsm_tpu.utils.device import probe_or_force_cpu
 
     on_tpu, _detail, header = probe_or_force_cpu(args.force_cpu,
                                                  args.probe_timeout)
-    lines = run_scale(on_tpu, args.out, header)
+    lines = run_scale(on_tpu, args.out, header, time_box_s=args.time_box)
     for ln in lines:
         print(json.dumps(ln))
     return 0

@@ -44,7 +44,7 @@ WINDOW_ARTIFACT = os.path.join(REPO, "BENCH_TPU_WINDOW.json")
 # committed evidence — the driver commits any uncommitted files at round
 # end, so writing these non-ignored paths is sufficient even if no human
 # is watching when the window opens).
-ROUND_TAG = "r04"
+ROUND_TAG = "r05"
 COMMITTED_COPIES = {
     WINDOW_ARTIFACT: os.path.join(REPO, f"BENCH_TPU_{ROUND_TAG}.json"),
     os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"):
@@ -54,6 +54,11 @@ COMMITTED_COPIES = {
     os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json"):
         os.path.join(REPO, f"BENCH_SCALE_TPU_{ROUND_TAG}.json"),
 }
+
+# Every banked headline ALSO appends here (committed, never overwritten):
+# run-to-run variance across windows stays visible without digging
+# through git history (ADVICE.md round 4).
+CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 
 
 def _bank_committed_copy(runtime_path: str) -> None:
@@ -123,6 +128,11 @@ def _run_window_bench(bench_timeout: float, extra_args, label: str,
         with open(WINDOW_ARTIFACT, "w") as f:
             json.dump(result, f)
         _bank_committed_copy(WINDOW_ARTIFACT)
+        try:  # per-capture history (ADVICE.md round 4): append, never clobber
+            with open(CAPTURES_LOG, "a") as f:
+                f.write(json.dumps(result) + "\n")
+        except OSError:
+            pass
     return bool(on_device)
 
 
@@ -155,8 +165,11 @@ def _scale_complete(path: str) -> bool:
                    if "variant" not in r and "skipped" not in r}
     have_variants = {r.get("variant") for r in lines[1:]
                      if "variant" in r and "skipped" not in r}
-    return widths <= have_widths and {"unroll1",
-                                      "budget2k"} <= have_variants
+    # pallas: the round-5 A/B cell (an error row IS an answer — the
+    # prototype failing to compile on the real Mosaic stack decides the
+    # escalation question too)
+    return widths <= have_widths and {"unroll1", "budget2k",
+                                      "pallas"} <= have_variants
 
 
 def _tool_rows(path: str) -> int:
@@ -183,7 +196,7 @@ def _tool_rows(path: str) -> int:
 
 
 def _run_tool(script: str, out_path: str, timeout: float, label: str,
-              min_rows: int = 0) -> None:
+              min_rows: int = 0, extra_args=()) -> None:
     """Bank one auxiliary artifact (bench_configs / bench_e2e /
     bench_scale) from the open window.  Device-capture discipline mirrors
     _run_window_bench: a previously banked REAL-device artifact is never
@@ -206,7 +219,7 @@ def _run_tool(script: str, out_path: str, timeout: float, label: str,
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", script),
-             "--probe-timeout", "45", "--out", tmp],
+             "--probe-timeout", "45", "--out", tmp, *extra_args],
             capture_output=True, text=True, timeout=timeout, cwd=REPO)
     except subprocess.TimeoutExpired:
         # tools that write incrementally (bench_scale) may have banked
@@ -237,7 +250,12 @@ def _run_tool(script: str, out_path: str, timeout: float, label: str,
         on_device = header.get("device_fallback") is None
     except (OSError, ValueError):
         pass
-    if on_device:
+    # monotonic here too, not just on timeout: a time-boxed rerun that
+    # exits rc 0 with FEWER measured rows (cells cut to 'skipped'
+    # markers by --time-box on a slow tunnel) must not clobber a richer
+    # banked partial and its committed copy
+    demoted = on_device and _tool_rows(tmp) < _tool_rows(out_path)
+    if on_device and not demoted:
         os.replace(tmp, out_path)
         _bank_committed_copy(out_path)
     else:
@@ -245,40 +263,76 @@ def _run_tool(script: str, out_path: str, timeout: float, label: str,
             os.remove(tmp)
         except OSError:
             pass
-    _log(event=label, ok=on_device, rc=r.returncode,
-         seconds=round(time.time() - t0, 1))
+    _log(event=label, ok=on_device and not demoted, rc=r.returncode,
+         seconds=round(time.time() - t0, 1),
+         **({"detail": "device run banked fewer rows than existing; "
+                       "kept the richer bank"} if demoted else {}))
+
+
+def _headline_settings() -> dict:
+    """(batch, unroll) the banked headline actually ran with, or {}."""
+    try:
+        with open(WINDOW_ARTIFACT) as f:
+            ex = json.load(f).get("extras", {})
+        return {"batch": ex.get("device_batch"), "unroll": ex.get("unroll")}
+    except (OSError, ValueError):
+        return {}
 
 
 def _seize_window(bench_timeout: float) -> bool:
-    """The tunnel just answered: bank a headline-only device line FIRST
-    (sweep-free, fast), then try to upgrade it with the sweep-inclusive
-    full run, then bank the per-config and e2e artifacts.  If the window
-    closes mid-way the earlier captures survive — a killed subprocess's
-    stdout is gone, so never stake the round's only real-chip artifact on
-    the longest run."""
-    # A ≤3 h-old headline capture is left alone (the repo and this
-    # gitignored artifact persist across rounds, so existence alone must
-    # not suppress a later round's seize) — but a fresh headline must NOT
-    # suppress the still-missing upgrade artifacts: the round-4 window
-    # banked the headline, closed before configs/e2e/profile, and the old
-    # main()-level age gate would have skipped all of them had the tunnel
-    # healed again the same round.
-    try:
-        age = time.time() - os.path.getmtime(WINDOW_ARTIFACT)
-    except OSError:
-        age = float("inf")
-    headline_fresh = age <= 3 * 3600.0
-    configs_done = os.path.exists(
-        os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"))
+    """The tunnel just answered.  Round-5 order (VERDICT.md round 4,
+    "Next round" #1): the window buys the DECISION first, not a third
+    300-440 s headline — both round-4 windows spent themselves on the
+    headline and died before the scan that decides how to make the
+    headline fast.
+
+      1. scale scan — unroll A/B + width ladder, time-boxed cells,
+         incremental rows promoted even from a window that dies mid-cell;
+      2. SHORT headline (1 timed rep; bench.py adopts the scan's batch
+         AND unroll) — re-run whenever the banked headline's settings
+         differ from what the scan decided;
+      3. e2e (device/hybrid rows incl. the on-chip trial_batch A/B);
+      4. one profiled run (never banked: tracer overhead);
+      5. per-config matrix;
+      6. the max-ops sweep LAST (longest by far; outlived round-4's
+         48-min window)."""
+    scale_path = os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json")
+    scale_done = _scale_complete(scale_path)
+
+    def headline_state():
+        """(fresh, settings_current) of the banked headline vs the scan."""
+        try:
+            age = time.time() - os.path.getmtime(WINDOW_ARTIFACT)
+        except OSError:
+            age = float("inf")
+        adopted_batch = adopted_unroll = None
+        try:
+            from bench import best_scale_batch, best_scale_unroll
+            a = best_scale_batch(dirpath=REPO)
+            adopted_batch = a[0] if a else 4096
+            u = best_scale_unroll(dirpath=REPO)
+            adopted_unroll = u[0] if u else None
+        except Exception:  # noqa: BLE001 — adoption is advisory
+            pass
+        cur = _headline_settings()
+        current = (
+            cur.get("batch") is not None
+            and (adopted_batch is None
+                 or cur.get("batch") == adopted_batch)
+            and (adopted_unroll is None
+                 or cur.get("unroll") == adopted_unroll))
+        return age <= 3 * 3600.0, current
+
     e2e_done = os.path.exists(
         os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json"))
+    configs_done = os.path.exists(
+        os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"))
     # a profile directory is "captured" only once a completed trace file
-    # exists inside it — jax.profiler creates the directory at trace START,
-    # so a run killed mid-trace (flickering window) leaves a bare/partial
-    # dir that must not suppress retries
+    # exists inside it — jax.profiler creates the directory at trace
+    # START, so a run killed mid-trace must not suppress retries
     profile_dir = os.path.join(REPO, "profiles", f"{ROUND_TAG}_tpu")
     profile_done = False
-    for root, _dirs, files in os.walk(profile_dir):
+    for _root, _dirs, files in os.walk(profile_dir):
         if any(f.endswith(".xplane.pb") for f in files):
             profile_done = True
             break
@@ -293,81 +347,61 @@ def _seize_window(bench_timeout: float) -> bool:
                 "device_fallback", "absent") is None
     except (OSError, ValueError):
         pass
-    scale_done = _scale_complete(
-        os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json"))
-    if (headline_fresh and configs_done and e2e_done and profile_done
-            and sweep_done and scale_done):
+
+    fresh, settings_current = headline_state()
+    if (scale_done and fresh and settings_current and e2e_done
+            and profile_done and configs_done and sweep_done):
         return True  # everything banked: a healthy tunnel cycle is silent
-    if headline_fresh:
+
+    # --- 1. the scale scan: the decision artifact ------------------------
+    if scale_done:
+        _log(event="window_scale", ok=True, detail="already banked; kept")
+    else:
+        # subprocess bound > --time-box so an in-flight cell may finish;
+        # partial rows are promoted either way (incremental writes)
+        _run_tool("bench_scale.py", scale_path, bench_timeout,
+                  "window_scale", min_rows=1 << 30,
+                  extra_args=("--time-box", "600"))
+        fresh, settings_current = headline_state()  # scan may re-decide
+
+    # --- 2. short headline at the adopted configuration ------------------
+    if fresh and settings_current:
         _log(event="window_bench_headline", ok=True,
-             detail=f"fresh capture ({age / 60:.0f} min old); kept")
+             detail="fresh capture, settings match the scan; kept")
         banked = True
     else:
-        banked = _run_window_bench(bench_timeout / 2, ["--no-sweep"],
+        banked = _run_window_bench(bench_timeout / 4, ["--no-sweep"],
                                    "window_bench_headline")
-    if banked:
-        # chase the upgrades only while the window is demonstrably open;
-        # after a failed bank the flicker closed — a full sweep on the
-        # CPU fallback would block probing for up to bench_timeout.
-        # Order = flagship first: the scale scan + rescaled headline
-        # directly upgrade the round's headline number (unroll/width
-        # A/B), so they outrank the breadth artifacts (configs, e2e) in
-        # a window that may close any minute; the sweep (longest by far
-        # — it outlived the 48-min round-4 window) stays LAST.
-        if scale_done:
-            _log(event="window_scale", ok=True,
-                 detail="already banked; kept")
-        else:
-            _run_tool("bench_scale.py",
-                      os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json"),
-                      bench_timeout, "window_scale",
-                      min_rows=1 << 30)  # promotion gate only: existence
-            # never suppresses (completeness is judged above); a partial
-            # with MORE rows than the bank still promotes on timeout
-        # If the scan validated a better width than the banked headline
-        # used, the headline is stale regardless of age: re-bench so THIS
-        # window banks the improved configuration (bench.py adopts the
-        # scale-validated batch automatically).
-        try:
-            from bench import best_scale_batch
-            adopted = best_scale_batch()
-        except Exception:  # noqa: BLE001 — advisory only
-            adopted = None
-        cur_batch = None
-        try:
-            with open(WINDOW_ARTIFACT) as f:
-                cur_batch = json.load(f).get("extras", {}).get(
-                    "device_batch")
-        except (OSError, ValueError):
-            pass
-        if adopted is not None and cur_batch is not None \
-                and adopted[0] != cur_batch:
-            _run_window_bench(bench_timeout / 2, ["--no-sweep"],
-                              "window_bench_rescaled")
-        _run_tool("bench_configs.py",
-                  os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"),
-                  bench_timeout, "window_configs")
+    if not banked:
+        return False
+    # chase the upgrades only while the window is demonstrably open;
+    # after a failed bank the flicker closed — a full sweep on the
+    # CPU fallback would block probing for up to bench_timeout.
+    # --- 3. e2e: the on-chip trial_batch A/B -----------------------------
+    if e2e_done:
+        _log(event="window_e2e", ok=True, detail="already banked; kept")
+    else:
         _run_tool("bench_e2e.py",
                   os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json"),
                   bench_timeout / 2, "window_e2e")
-        # A PROFILED run, never banked (tracer overhead must not deflate
-        # the headline artifact) — captures the first real-TPU
-        # jax.profiler trace.  Ordered after the artifact banks so a
-        # short window feeds evidence before diagnostics.
-        if profile_done:
-            _log(event="window_profile", ok=True, detail="already captured")
-        else:
-            _run_window_bench(bench_timeout / 2,
-                              ["--no-sweep", "--profile", profile_dir],
-                              "window_profile", bank=False)
-        # The on-device max-ops sweep is the longest artifact by far
-        # (>40 min on the round-4 window — it outlived the window); chase
-        # it only after everything cheaper is banked.
-        if sweep_done:
-            _log(event="window_bench_full", ok=True,
-                 detail="device sweep already banked; kept")
-        else:
-            _run_window_bench(bench_timeout, [], "window_bench_full")
+    # --- 4. a PROFILED run, never banked (tracer overhead must not
+    # deflate the headline artifact) — the first real-TPU trace ----------
+    if profile_done:
+        _log(event="window_profile", ok=True, detail="already captured")
+    else:
+        _run_window_bench(bench_timeout / 4,
+                          ["--no-sweep", "--profile", profile_dir],
+                          "window_profile", bank=False)
+    # --- 5. per-config matrix -------------------------------------------
+    _run_tool("bench_configs.py",
+              os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"),
+              bench_timeout, "window_configs")
+    # --- 6. the max-ops sweep: longest by far, strictly last ------------
+    if sweep_done:
+        _log(event="window_bench_full", ok=True,
+             detail="device sweep already banked; kept")
+    else:
+        _run_window_bench(bench_timeout, [], "window_bench_full")
     return banked
 
 
