@@ -340,7 +340,21 @@ def _prop_concurrent_body(spec, sut, cfg, backend, oracle, transport,
         hists_all = _execute_many(sut, jobs, cfg, transport, executor)
         _bump("execute", t0)
         t0 = time.perf_counter()
-        raw = backend.check_histories(spec, hists_all)
+        check_hists = hists_all
+        if len(hists_all) < group_target * k:
+            # ramp-phase AND truncated-final-group batches are padded to
+            # the full configured width with empty (instantly-SUCCESS)
+            # histories so every call hits the SAME compiled executable
+            # as the steady state — without this the 1,2,4,… groups (and
+            # the n_trials remainder) touch extra batch buckets and a
+            # device backend pays extra compile sets inside the run
+            # (measured: device/atomic e2e fell 70 → 39 h/s from exactly
+            # that).  Padding lanes freeze at init, so the extra device
+            # work is bounded by the batch width, not the search.
+            pad = group_target * k - len(hists_all)
+            check_hists = hists_all + [History([])] * pad
+        raw = np.asarray(
+            backend.check_histories(spec, check_hists))[:len(hists_all)]
         _bump("check", t0)
         verdicts = _resolve(spec, raw, hists_all, backend, oracle, timings)
         checked += len(hists_all)

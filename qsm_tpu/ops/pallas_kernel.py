@@ -10,8 +10,8 @@ iteration chunk inside one Mosaic kernel launch, so per-trip cost is VPU
 arithmetic, not XLA loop-trip overhead.  This module is the measured
 A/B, not a replacement: scope is deliberately the scalar-table fast path
 only (CAS / register / ticket / set — ``scalar_state_bound`` specs, the
-headline configuration), no in-kernel memo cache, ≤32 ops (one-word
-bitmasks).
+headline configuration), ≤32 ops (one-word bitmasks), with a per-lane
+memo cache matching the XLA kernel's pruning economics (below).
 
 Design — the same branchless DFS as ops/jax_kernel.py, transposed:
 
@@ -30,7 +30,17 @@ Design — the same branchless DFS as ops/jax_kernel.py, transposed:
 * one ``pallas_call`` advances every lane by exactly ``chunk``
   iterations via ``jax.lax.fori_loop``; decided lanes no-op through the
   remaining trips (the same freeze-guard contract as the XLA kernel's
-  UNROLL micro-steps).
+  UNROLL micro-steps);
+* a per-lane memoisation cache (Lowe-style, the same contract as the
+  XLA kernel's: configurations proven non-linearizable-from are
+  inserted on subtree exhaustion, child configurations already present
+  are pruned without descending) lives in VMEM as three
+  ``[slots, L]`` planes — key word 0 the taken bitmask, key word 1 the
+  scalar state, plus occupancy.  Lookup/insert are one-hot sweeps over
+  ``slots`` (≤64), soundness-safe under collision exactly like the XLA
+  cache: a lost entry only loses a pruning opportunity.  Without it a
+  violating history must exhaust its whole tree and the A/B against the
+  cache-equipped XLA kernel would compare different search economics.
 
 Verdict semantics are identical to ``JaxTPU``: SUCCESS / FAILURE /
 BUDGET_EXCEEDED (honest indecision), pending ops expanded host-side,
@@ -61,39 +71,74 @@ MAX_PALLAS_STATES = 64  # the in-kernel state gather is a one-hot sweep
 
 
 def build_pallas_chunk(spec, n_ops: int, state_bound: int, lanes: int,
-                       chunk: int, budget: int, interpret: bool):
+                       chunk: int, budget: int, interpret: bool,
+                       cache_slots: int = 0):
     """One compiled pallas_call advancing ``lanes``-wide blocks by
     ``chunk`` DFS iterations.  Returns ``fn(tables, carry) -> carry`` over
-    lane-minor arrays (see module docstring for layouts)."""
+    lane-minor arrays (see module docstring for layouts).
+    ``cache_slots`` > 0 (a power of two) enables the per-lane VMEM memo
+    cache; the carry then grows ``ck0``/``ck1``/``occ`` planes."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     N, S, L = n_ops, state_bound, lanes
+    use_cache = cache_slots > 0
+    if cache_slots < 0 or (cache_slots & (cache_slots - 1)) != 0:
+        raise ValueError(
+            f"cache_slots must be 0 or a power of two, got {cache_slots}")
+
+    # ALL word/bitmask math below is int32, not uint32: Mosaic does not
+    # implement reductions over unsigned integers (caught by the
+    # cross-platform lowering check, tests/test_pallas.py — the kernel
+    # would have failed its first real-chip window otherwise).  int32 is
+    # bit-identical here: packed-word sums have one distinct bit per
+    # term (sum == or, no carries), XLA integer ops wrap two's-
+    # complement, and right-shifts use shift_right_logical explicitly.
+    def _i32(x):
+        return jnp.asarray(np.int64(x).astype(np.int32) if x > 0x7FFFFFFF
+                           else x, jnp.int32)
+
+    def _hash(word, state):
+        """Per-lane slot hash over the (taken-word, state) key — a word
+        mixer in the same spirit as the XLA kernel's (independent table,
+        no cross-kernel bit-compat needed; only distribution matters)."""
+        srl = jax.lax.shift_right_logical
+        h = _i32(0x9E3779B9) ^ word
+        h = h * _i32(0x85EBCA6B)
+        h = h ^ srl(h, 16)
+        h = h ^ state
+        h = h * _i32(0xC2B2AE35)
+        h = h ^ srl(h, 13)
+        return h & jnp.int32(cache_slots - 1)
 
     def kernel(nxt_ref, ok_ref, prec_ref, valid_ref, nreq_ref,
                taken_ref, chosen_ref, states_ref, dsi_ref,
-               taken_o, chosen_o, states_o, dsi_o):
+               ck0_ref, ck1_ref, occ_ref,
+               taken_o, chosen_o, states_o, dsi_o,
+               ck0_o, ck1_o, occ_o):
         nxt_tab = nxt_ref[:]        # [S, N, L] int32
         ok_tab = ok_ref[:]          # [S, N, L] int32 (0/1)
-        prec = prec_ref[:]          # [N, L] uint32
+        prec = prec_ref[:]          # [N, L] int32 (packed predecessor bits)
         valid = valid_ref[:]        # [N, L] int32 (0/1)
         nreq = nreq_ref[0, :]       # [L]
 
         nio = jax.lax.broadcasted_iota(jnp.int32, (N, L), 0)
         kio = jax.lax.broadcasted_iota(jnp.int32, (N + 1, L), 0)
         sio = jax.lax.broadcasted_iota(jnp.int32, (S, L), 0)
-        shift = nio.astype(jnp.uint32)
+        shift = nio
+        cio = (jax.lax.broadcasted_iota(jnp.int32, (cache_slots, L), 0)
+               if use_cache else None)
 
         def body(_, c):
-            taken, chosen, states, d, status, iters = c
+            taken, chosen, states, d, status, iters, ck0, ck1, occ = c
             active = status == RUNNING                       # [L]
             dm = (kio == d[None, :]).astype(jnp.int32)       # [N+1, L]
             state = jnp.sum(states * dm, axis=0)             # [L]
             cur = jnp.sum(chosen * dm, axis=0)               # [L]
             untaken = valid * (1 - taken)                    # [N, L]
-            uw = jnp.sum(untaken.astype(jnp.uint32) << shift, axis=0)
-            blocked = (prec & uw[None, :]) != jnp.uint32(0)  # [N, L]
+            uw = jnp.sum(untaken << shift, axis=0)           # [L] int32
+            blocked = (prec & uw[None, :]) != 0              # [N, L]
             sm = (sio == state[None, :]).astype(jnp.int32)   # [S, L]
             ok_row = jnp.sum(ok_tab * sm[:, None, :], axis=0)    # [N, L]
             nxt_row = jnp.sum(nxt_tab * sm[:, None, :], axis=0)  # [N, L]
@@ -104,7 +149,20 @@ def build_pallas_chunk(spec, n_ops: int, state_bound: int, lanes: int,
             jm = (nio == jstar[None, :]).astype(jnp.int32)   # [N, L]
             child = jnp.sum(nxt_row * jm, axis=0)            # [L]
             success = has & (d + 1 == nreq)
-            descend = has & active
+
+            if use_cache:
+                taken_word = jnp.sum(taken << shift, axis=0)     # [L]
+                child_word = taken_word | (
+                    jnp.int32(1) << jnp.minimum(jstar, N - 1))
+                slot_c = _hash(child_word, child)                # [L]
+                sel_c = cio == slot_c[None, :]                   # [slots, L]
+                hit = jnp.any(sel_c & (occ == 1)
+                              & (ck0 == child_word[None, :])
+                              & (ck1 == child[None, :]), axis=0)
+                prune = has & hit & ~success & active
+            else:
+                prune = jnp.zeros_like(has)  # all-False (has is bool)
+            descend = has & active & ~prune
             d_back = jnp.maximum(d - 1, 0)
             dbm = (kio == d_back[None, :]).astype(jnp.int32)
             prev = jnp.maximum(jnp.sum(chosen * dbm, axis=0), 0)
@@ -114,8 +172,10 @@ def build_pallas_chunk(spec, n_ops: int, state_bound: int, lanes: int,
                 descend[None, :], jnp.maximum(taken, jm),
                 jnp.where(back[None, :] & (nio == prev[None, :]),
                           0, taken))
+            # descend: chosen[d]=j, chosen[d+1]=-1; prune: cursor moves
+            # past j at the SAME depth (chosen[d]=j, nothing else)
             chosen_n = jnp.where(
-                descend[None, :] & (kio == d[None, :]),
+                (descend | prune)[None, :] & (kio == d[None, :]),
                 jstar[None, :],
                 jnp.where(descend[None, :] & (kio == d[None, :] + 1),
                           -1, chosen))
@@ -123,7 +183,7 @@ def build_pallas_chunk(spec, n_ops: int, state_bound: int, lanes: int,
                 descend[None, :] & (kio == d[None, :] + 1),
                 child[None, :], states)
             d_n = jnp.where(descend, d + 1,
-                            jnp.where(active, d_back, d))
+                            jnp.where(active & ~prune, d_back, d))
             iters_n = iters + active.astype(jnp.int32)
             status_n = jnp.where(
                 active & success, SUCCESS,
@@ -131,27 +191,51 @@ def build_pallas_chunk(spec, n_ops: int, state_bound: int, lanes: int,
             status_n = jnp.where(
                 (status_n == RUNNING) & (iters_n >= budget),
                 BUDGET, status_n)
-            return (taken_n, chosen_n, states_n, d_n, status_n, iters_n)
+            if use_cache:
+                # exhausted (no candidates): this configuration is proven
+                # non-linearizable-from — insert before backtracking
+                exhausted = active & ~has
+                slot_i = _hash(taken_word, state)
+                wmask = (cio == slot_i[None, :]) & exhausted[None, :]
+                ck0_n = jnp.where(wmask, taken_word[None, :], ck0)
+                ck1_n = jnp.where(wmask, state[None, :], ck1)
+                occ_n = jnp.where(wmask, 1, occ)
+            else:
+                ck0_n, ck1_n, occ_n = ck0, ck1, occ
+            return (taken_n, chosen_n, states_n, d_n, status_n, iters_n,
+                    ck0_n, ck1_n, occ_n)
 
         init = (taken_ref[:], chosen_ref[:], states_ref[:],
-                dsi_ref[0, :], dsi_ref[1, :], dsi_ref[2, :])
-        taken, chosen, states, d, status, iters = jax.lax.fori_loop(
-            0, chunk, body, init)
+                dsi_ref[0, :], dsi_ref[1, :], dsi_ref[2, :],
+                ck0_ref[:], ck1_ref[:], occ_ref[:])
+        (taken, chosen, states, d, status, iters,
+         ck0, ck1, occ) = jax.lax.fori_loop(0, chunk, body, init)
         taken_o[:] = taken
         chosen_o[:] = chosen
         states_o[:] = states
         dsi_o[0, :] = d
         dsi_o[1, :] = status
         dsi_o[2, :] = iters
+        ck0_o[:] = ck0
+        ck1_o[:] = ck1
+        occ_o[:] = occ
 
-    def fn(nxt, ok, prec, valid, nreq, taken, chosen, states, dsi):
+    CS = max(cache_slots, 1)  # shape floor: slots=0 rides 1-row dummies
+
+    def fn(nxt, ok, prec, valid, nreq, taken, chosen, states, dsi,
+           ck0, ck1, occ):
         B = nxt.shape[-1]
         grid = (B // L,)
+        lane2 = lambda rows: pl.BlockSpec(  # noqa: E731
+            (rows, L), lambda i: (0, i))
         out_shape = (
             jax.ShapeDtypeStruct((N, B), jnp.int32),
             jax.ShapeDtypeStruct((N + 1, B), jnp.int32),
             jax.ShapeDtypeStruct((N + 1, B), jnp.int32),
             jax.ShapeDtypeStruct((3, B), jnp.int32),
+            jax.ShapeDtypeStruct((CS, B), jnp.int32),
+            jax.ShapeDtypeStruct((CS, B), jnp.int32),
+            jax.ShapeDtypeStruct((CS, B), jnp.int32),
         )
         return pl.pallas_call(
             kernel,
@@ -159,23 +243,30 @@ def build_pallas_chunk(spec, n_ops: int, state_bound: int, lanes: int,
             in_specs=[
                 pl.BlockSpec((S, N, L), lambda i: (0, 0, i)),
                 pl.BlockSpec((S, N, L), lambda i: (0, 0, i)),
-                pl.BlockSpec((N, L), lambda i: (0, i)),
-                pl.BlockSpec((N, L), lambda i: (0, i)),
-                pl.BlockSpec((1, L), lambda i: (0, i)),
-                pl.BlockSpec((N, L), lambda i: (0, i)),
-                pl.BlockSpec((N + 1, L), lambda i: (0, i)),
-                pl.BlockSpec((N + 1, L), lambda i: (0, i)),
-                pl.BlockSpec((3, L), lambda i: (0, i)),
+                lane2(N),
+                lane2(N),
+                lane2(1),
+                lane2(N),
+                lane2(N + 1),
+                lane2(N + 1),
+                lane2(3),
+                lane2(CS),
+                lane2(CS),
+                lane2(CS),
             ],
             out_specs=(
-                pl.BlockSpec((N, L), lambda i: (0, i)),
-                pl.BlockSpec((N + 1, L), lambda i: (0, i)),
-                pl.BlockSpec((N + 1, L), lambda i: (0, i)),
-                pl.BlockSpec((3, L), lambda i: (0, i)),
+                lane2(N),
+                lane2(N + 1),
+                lane2(N + 1),
+                lane2(3),
+                lane2(CS),
+                lane2(CS),
+                lane2(CS),
             ),
             out_shape=out_shape,
             interpret=interpret,
-        )(nxt, ok, prec, valid, nreq, taken, chosen, states, dsi)
+        )(nxt, ok, prec, valid, nreq, taken, chosen, states, dsi,
+          ck0, ck1, occ)
 
     return jax.jit(fn)
 
@@ -192,6 +283,11 @@ class PallasTPU(JaxTPU):
 
     LANES = 256          # lanes per Mosaic block (minor axis; 128-mult)
     PALLAS_CHUNK = 1024  # DFS iterations per pallas_call
+    # Per-lane memo cache slots (power of two; 0 disables).  64 slots ≈
+    # 192 KB VMEM per 256-lane block — the economics leveller vs the
+    # cache-equipped XLA kernel (module docstring); pruning-only effect,
+    # verdicts identical (tests/test_pallas.py pins both).
+    PALLAS_CACHE_SLOTS = 64
 
     def __init__(self, spec, budget: int = 2_000, interpret=None, **kw):
         super().__init__(spec, budget=budget, **kw)
@@ -218,12 +314,14 @@ class PallasTPU(JaxTPU):
         return jax.default_backend() != "tpu"
 
     def _chunk_kernel(self, n_ops: int, state_bound: int):
-        key = (n_ops, state_bound, self.PALLAS_CHUNK, self._interpret())
+        key = (n_ops, state_bound, self.PALLAS_CHUNK, self._interpret(),
+               self.PALLAS_CACHE_SLOTS)
         fn = self._pallas_fns.get(key)
         if fn is None:
             fn = build_pallas_chunk(self.kspec, n_ops, state_bound,
                                     self.LANES, self.PALLAS_CHUNK,
-                                    self.total_budget, self._interpret())
+                                    self.total_budget, self._interpret(),
+                                    cache_slots=self.PALLAS_CACHE_SLOTS)
             self._pallas_fns[key] = fn
         return fn
 
@@ -305,10 +403,10 @@ class PallasTPU(JaxTPU):
         ok = np.zeros((S, N, B), np.int32)
         nxt[:, :, :b] = np.transpose(np.asarray(nxt_t), (1, 2, 0))
         ok[:, :, :b] = np.transpose(np.asarray(ok_t), (1, 2, 0))
-        prec_word = np.zeros((N, B), np.uint32)
+        prec_word = np.zeros((N, B), np.int32)
         pw = (prec.astype(np.uint64)
               << np.arange(N, dtype=np.uint64)[None, :, None]).sum(axis=1)
-        prec_word[:, :b] = pw.astype(np.uint32).T
+        prec_word[:, :b] = pw.astype(np.uint32).view(np.int32).T
         valid_lm = np.zeros((N, B), np.int32)
         valid_lm[:, :b] = valid.T
         nreq = np.zeros((1, B), np.int32)
@@ -324,11 +422,15 @@ class PallasTPU(JaxTPU):
         dsi[1] = np.where(nreq[0] == 0, SUCCESS, RUNNING)
 
         fn = self._chunk_kernel(n_ops, S)
+        CS = max(self.PALLAS_CACHE_SLOTS, 1)  # dummy row when disabled
         tables = (jnp.asarray(nxt), jnp.asarray(ok),
                   jnp.asarray(prec_word), jnp.asarray(valid_lm),
                   jnp.asarray(nreq))
         carry = (jnp.asarray(taken), jnp.asarray(chosen),
-                 jnp.asarray(states), jnp.asarray(dsi))
+                 jnp.asarray(states), jnp.asarray(dsi),
+                 jnp.zeros((CS, B), jnp.int32),
+                 jnp.zeros((CS, B), jnp.int32),
+                 jnp.zeros((CS, B), jnp.int32))
         max_calls = -(-self.total_budget // self.PALLAS_CHUNK)
         for _ in range(max_calls):
             carry = fn(*tables, *carry)

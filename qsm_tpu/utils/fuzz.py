@@ -300,6 +300,14 @@ def fuzz_parity(n_specs: int = 10, hists_per_spec: int = 32,
                 from ..ops.hybrid import HybridDevice
 
                 backend = HybridDevice(spec, budget=12)
+            elif name == "pallas":
+                # the Mosaic prototype over random scalar tables —
+                # interpret mode off-TPU, so the budget stays tight
+                # (BUDGET deferrals are honest, never mismatches)
+                from ..ops.pallas_kernel import PallasTPU
+
+                backend = PallasTPU(spec, budget=4_000, mid_budget=0,
+                                    rescue_budget=0)
             else:
                 raise ValueError(f"unknown fuzz backend {name!r}")
             got = backend.check_histories(spec, hists)

@@ -87,12 +87,38 @@ def main(argv=None) -> int:
     # the per-trip win on the fallback — measured: device atomic tb=1
     # fell 62 → 16 h/s with a forced unroll8 here, while the bench.py
     # corpus (4096+ lanes, warmup outside the timer) gains 5.2×.
+    # EXCEPT on a real device with a banked scan verdict: then the e2e
+    # device rows run whatever unroll the on-chip A/B decided, same as
+    # the headline (bench.best_scale_unroll).
+    adopted_unroll = None
+    adopt_error = None
+    if on_tpu:
+        try:
+            from bench import best_scale_unroll
+
+            a = best_scale_unroll()
+            adopted_unroll = a[0] if a else None
+        except Exception as e:  # noqa: BLE001 — adoption is advisory,
+            adopt_error = f"{type(e).__name__}: {e}"[:120]  # but recorded
+
+    def _device(s):
+        b = JaxTPU(s)
+        if adopted_unroll is not None:
+            b.UNROLL = adopted_unroll
+        return b
+
+    def _hybrid_adopted(s):
+        b = _hybrid(s)
+        if adopted_unroll is not None:
+            b.device.UNROLL = adopted_unroll
+        return b
+
     backends = {
         "memo": lambda s: WingGongCPU(memo=True),
-        "device": lambda s: JaxTPU(s),
+        "device": _device,
         # device majority + host tail as one backend (ops/hybrid.py):
         # the e2e plan the scale-scan hybrid_derived row prices
-        "hybrid": _hybrid,
+        "hybrid": _hybrid_adopted,
     }
     try:
         from qsm_tpu.native import CppOracle, native_available
@@ -119,6 +145,15 @@ def main(argv=None) -> int:
                 rec = run_one(f"cas-{sut_name}", bname, mk, sut_name,
                               args.trials, trial_batch=tb)
                 rec["trial_batch"] = tb
+                if bname in ("device", "hybrid"):
+                    # settings stamp: two artifacts with different
+                    # effective UNROLL must be distinguishable
+                    rec["unroll"] = (adopted_unroll if adopted_unroll
+                                     is not None
+                                     else ("auto" if on_tpu else 1))
+                    rec["unroll_from_scale"] = adopted_unroll
+                    if adopt_error:
+                        rec["unroll_adopt_error"] = adopt_error
                 print(json.dumps(rec), flush=True)
                 with open(args.out, "a") as f:
                     f.write(json.dumps(rec) + "\n")

@@ -165,6 +165,7 @@ def run_scale(on_tpu: bool, out_path: str, header: dict,
             row["settings"] = {
                 "pallas_chunk": backend.PALLAS_CHUNK,
                 "lanes_per_block": backend.LANES,
+                "cache_slots": backend.PALLAS_CACHE_SLOTS,
                 "budget": 2_000,
             }
             return backend
