@@ -13,6 +13,9 @@ Layers (mirroring SURVEY.md §1, redesigned TPU-first):
 * ``qsm_tpu.models``   — the five milestone specs + correct/racy SUT pairs
   (reference L7)
 * ``qsm_tpu.parallel`` — mesh/sharding for batch-parallel checking at scale
+* ``qsm_tpu.analysis`` — ``qsmlint``: static spec/kernel/determinism
+  analysis that catches window-burning defects before any TPU window
+  opens (docs/ANALYSIS.md)
 * ``qsm_tpu.utils``    — config, structured logging, CLI
 """
 

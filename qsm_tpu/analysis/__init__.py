@@ -1,0 +1,34 @@
+"""``qsm_tpu.analysis`` — the ``qsmlint`` static analyzer.
+
+Round 5 proved the scarcest resource is a live TPU window (717 probes,
+9 device hits — VERDICT.md); any defect that survives until a window
+opens wastes the one thing that cannot be bought back.  This package is
+the "decide cheaply first" lever at the code level: three CPU-only pass
+families that catch window-burning defects before any device backend is
+constructed —
+
+* spec soundness (``spec_passes``): step_py/step_jax parity, domain
+  consistency, declared-bound soundness, precondition reachability;
+* kernel trace hazards (``kernel_passes``): retracing, dtype promotion,
+  host transfers in traced loop bodies, the Pallas VMEM envelope;
+* scheduler determinism (``sched_passes``): nondeterminism sources
+  outside the seeded RNG.
+
+Entry points: :func:`run_lint` (the engine), ``python -m qsm_tpu lint``
+(the CLI gate), tests/test_lint.py (the tier-1 gate) and the
+probe_watcher pre-seize hook.  Rules, severities and the whitelist
+format are documented in docs/ANALYSIS.md.
+"""
+
+from .findings import (ERROR, INFO, WARNING, Finding, Whitelist,
+                       render_json, render_text, sort_findings,
+                       split_whitelisted)
+from .engine import (DEFAULT_OPS_FILES, DEFAULT_SCHED_FILES, LintReport,
+                     default_whitelist_path, run_lint)
+
+__all__ = [
+    "ERROR", "WARNING", "INFO", "Finding", "Whitelist", "LintReport",
+    "run_lint", "render_text", "render_json", "sort_findings",
+    "split_whitelisted", "default_whitelist_path",
+    "DEFAULT_OPS_FILES", "DEFAULT_SCHED_FILES",
+]

@@ -1,0 +1,120 @@
+"""Shared AST plumbing for the lint passes.
+
+Both the kernel trace-hazard passes (ops/) and the determinism passes
+(sched/) are source-level lints: parse the module, find the functions of
+interest, walk their bodies.  The helpers here keep the two pass
+families on one parser so location formatting (``relpath:line``) and the
+loop-body discovery protocol cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+# Call names whose FUNCTION argument is traced device code: the argument
+# index of that function per callee name.  jax.lax.while_loop(cond, body,
+# init) traces args 0 and 1; fori_loop(lo, hi, body, init) arg 2;
+# scan(f, ...) arg 0; pallas_call(kernel, ...) arg 0 (the whole kernel
+# body runs on-device).
+TRACED_FN_ARGS: Dict[str, Tuple[int, ...]] = {
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "scan": (0,),
+    "pallas_call": (0,),
+}
+
+
+def parse_module(path: str) -> ast.Module:
+    with open(path) as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def rel_location(path: str, lineno: int, root: Optional[str] = None) -> str:
+    """``relpath:line`` — repo-relative when ``root`` contains ``path``."""
+    if root:
+        try:
+            path = os.path.relpath(path, root)
+        except ValueError:
+            pass
+    return f"{path}:{lineno}"
+
+
+def attr_chain(node: ast.AST) -> List[str]:
+    """``jax.lax.while_loop`` -> ["jax", "lax", "while_loop"]; [] when the
+    expression is not a plain name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Last component of the called name (``pl.pallas_call`` ->
+    ``pallas_call``), or None for computed callees."""
+    chain = attr_chain(call.func)
+    return chain[-1] if chain else None
+
+
+def collect_function_defs(tree: ast.Module) -> Dict[str, List[ast.AST]]:
+    """name -> FunctionDef nodes anywhere in the module (nested included).
+    Same-name collisions across scopes over-approximate — acceptable for
+    a lint (a false transitive edge can only widen the flagged set)."""
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def traced_function_names(tree: ast.Module) -> Set[str]:
+    """Names of functions passed as traced bodies to while_loop /
+    fori_loop / scan / pallas_call, plus the transitive closure of
+    module-local functions they call (build_stepper's ``body_k`` calls
+    ``micro`` calls ``body`` — all three are traced)."""
+    defs = collect_function_defs(tree)
+    flagged: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        idxs = TRACED_FN_ARGS.get(name or "")
+        if not idxs:
+            continue
+        for i in idxs:
+            if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                arg = node.args[i].id
+                if arg in defs:
+                    flagged.add(arg)
+    # transitive closure over local defs referenced from flagged functions
+    changed = True
+    while changed:
+        changed = False
+        for name in list(flagged):
+            for fn in defs.get(name, ()):
+                for sub in ast.walk(fn):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Name)
+                            and sub.func.id in defs
+                            and sub.func.id not in flagged):
+                        flagged.add(sub.func.id)
+                        changed = True
+    return flagged
+
+
+def iter_flagged_bodies(tree: ast.Module, names: Set[str]):
+    """Yield (function_name, node) for every AST node inside the named
+    function defs (the defs themselves excluded)."""
+    defs = collect_function_defs(tree)
+    for name in sorted(names):
+        for fn in defs.get(name, ()):
+            for node in ast.walk(fn):
+                if node is fn:
+                    continue
+                yield name, node

@@ -1,0 +1,171 @@
+"""``qsmlint`` orchestration — every pass family over the in-tree corpus.
+
+One entry point, :func:`run_lint`: spec soundness passes over every
+registry model family, kernel trace-hazard passes over the five
+lineariser engine modules (ops/jax_kernel.py, ops/pallas_kernel.py,
+ops/segdc.py, ops/rootsplit.py, ops/pcomp.py), determinism passes over
+the scheduler plane (sched/).  CPU-only by contract: callers pin the
+platform (utils/cli.py cmd_lint forces it) and nothing here constructs
+a device backend — the entire point is deciding cheaply BEFORE any TPU
+window opens.
+
+Consumed by ``python -m qsm_tpu lint`` (exit 1 on non-whitelisted
+error findings), tests/test_lint.py (the tier-1 gate) and
+tools/probe_watcher.py (the pre-seize hook that refuses to spend a
+healing window on statically-broken code).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Union
+
+from .findings import (ERROR, Finding, Whitelist, render_json,
+                       split_whitelisted)
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(_PKG_DIR)
+
+# the five lineariser engine modules the kernel passes cover
+DEFAULT_OPS_FILES = ("ops/jax_kernel.py", "ops/pallas_kernel.py",
+                     "ops/segdc.py", "ops/rootsplit.py", "ops/pcomp.py")
+# the scheduler plane the determinism passes cover
+DEFAULT_SCHED_FILES = ("sched/scheduler.py", "sched/pool.py",
+                       "sched/transport.py", "sched/runner.py")
+
+
+def default_whitelist_path() -> str:
+    return os.path.join(REPO_ROOT, ".qsmlint")
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: List[Finding]          # non-whitelisted
+    whitelisted: List[Finding]
+    passes: Dict[str, float]         # pass family -> seconds
+    seconds: float
+    models: List[str]
+    whitelist_path: Optional[str] = None  # the file actually loaded
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def ok(self) -> bool:
+        """True when no non-whitelisted error-severity findings."""
+        return not self.errors
+
+    def to_json(self) -> str:
+        return render_json(
+            self.findings, self.whitelisted,
+            meta={"ok": self.ok,
+                  "seconds": round(self.seconds, 3),
+                  "passes": {k: round(v, 3)
+                             for k, v in self.passes.items()},
+                  "models": self.models})
+
+
+def _resolve_whitelist(whitelist: Union[None, str, Whitelist]
+                       ) -> Optional[Whitelist]:
+    if isinstance(whitelist, Whitelist):
+        return whitelist
+    if isinstance(whitelist, str):
+        return Whitelist.load(whitelist)
+    path = default_whitelist_path()
+    if os.path.exists(path):
+        return Whitelist.load(path)
+    return None
+
+
+def _retrace_corpora(entry, spec):
+    """Two same-bucket corpora for the retrace check (the second call
+    must hit the warm compile cache); falls back to re-running the first
+    corpus when generation buckets them differently."""
+    from ..core.history import bucket_for
+    from ..utils.corpus import build_corpus
+
+    impls = (entry.impls["atomic"], entry.impls["racy"]) \
+        if {"atomic", "racy"} <= set(entry.impls) \
+        else tuple(entry.impls.values())[:2]
+    a = build_corpus(spec, impls, n=6, n_pids=4, max_ops=10,
+                     seed_base=0, seed_prefix="lint_a")
+    b = build_corpus(spec, impls, n=6, n_pids=4, max_ops=10,
+                     seed_base=100, seed_prefix="lint_b")
+    bucket = bucket_for(max(len(h) for h in a) or 1)
+    if bucket_for(max(len(h) for h in b) or 1) != bucket:
+        b = a  # identical re-check is still a valid retrace probe
+    return [a, b]
+
+
+def run_lint(models: Optional[Sequence[str]] = None,
+             retrace: bool = True,
+             whitelist: Union[None, str, Whitelist] = None,
+             ops_files: Optional[Sequence[str]] = None,
+             sched_files: Optional[Sequence[str]] = None,
+             seed: int = 0) -> LintReport:
+    from ..models.registry import MODELS
+    from .kernel_passes import (check_host_transfers, check_pallas_vmem,
+                                check_retracing, check_step_dtypes)
+    from .sched_passes import check_sched_file
+    from .spec_passes import check_spec
+
+    t_start = time.perf_counter()
+    names = list(models) if models else sorted(MODELS)
+    unknown = [n for n in names if n not in MODELS]
+    if unknown:
+        raise ValueError(f"unknown model families {unknown}; "
+                         f"one of {sorted(MODELS)}")
+    findings: List[Finding] = []
+    passes: Dict[str, float] = {}
+
+    # --- (a) spec soundness + step_jax dtype abstract eval ---------------
+    t0 = time.perf_counter()
+    specs = []
+    for name in names:
+        spec = MODELS[name].make_spec()
+        loc = f"model:{name}"
+        specs.append((name, spec, loc))
+        findings += check_spec(spec, loc, seed=seed)
+        findings += check_step_dtypes(spec, loc)
+    passes["spec"] = time.perf_counter() - t0
+
+    # --- (b) kernel trace hazards ----------------------------------------
+    t0 = time.perf_counter()
+    for rel in (ops_files if ops_files is not None else DEFAULT_OPS_FILES):
+        path = rel if os.path.isabs(rel) else os.path.join(_PKG_DIR, rel)
+        findings += check_host_transfers(path, root=REPO_ROOT)
+    findings += check_pallas_vmem(
+        [(spec, loc) for _, spec, loc in specs],
+        "qsm_tpu/ops/pallas_kernel.py:MAX_PALLAS_STATES")
+    if retrace and specs:
+        # one representative family is enough: the check exercises the
+        # DRIVER's compile-key discipline, which is spec-independent
+        name, spec, _loc = specs[0]
+        from ..ops.jax_kernel import JaxTPU
+
+        backend = JaxTPU(spec, budget=2_000, mid_budget=0,
+                         rescue_budget=0, rescue_slots=64)
+        backend.CHUNK_SCHEDULE = (512,)   # one chunk shape: any cache
+        backend.DOUBLE_BUFFER = False     # growth is a real retrace
+        findings += check_retracing(
+            spec, backend, _retrace_corpora(MODELS[name], spec),
+            "qsm_tpu/ops/jax_kernel.py")
+    passes["kernel"] = time.perf_counter() - t0
+
+    # --- (c) determinism / race ------------------------------------------
+    t0 = time.perf_counter()
+    for rel in (sched_files if sched_files is not None
+                else DEFAULT_SCHED_FILES):
+        path = rel if os.path.isabs(rel) else os.path.join(_PKG_DIR, rel)
+        findings += check_sched_file(path, root=REPO_ROOT)
+    passes["sched"] = time.perf_counter() - t0
+
+    wl = _resolve_whitelist(whitelist)
+    kept, allowed = split_whitelisted(findings, wl)
+    return LintReport(findings=kept, whitelisted=allowed, passes=passes,
+                      seconds=time.perf_counter() - t_start,
+                      models=names,
+                      whitelist_path=wl.path if wl else None)

@@ -1,0 +1,136 @@
+"""Structured findings + whitelist for the ``qsmlint`` static analyzer.
+
+Every pass emits :class:`Finding` records — ``{severity, rule_id,
+location, message, fix_hint}`` — never prints.  Rendering (text for
+humans, JSON for probe_watcher/CI archival) and whitelist filtering live
+here so the pass modules stay pure.
+
+Severity policy (docs/ANALYSIS.md):
+
+* ``error``   — would waste a live TPU window or produce a wrong verdict
+  (spec parity divergence, state-bound violation, VMEM over-envelope,
+  per-call retracing, nondeterminism feeding scheduler decisions).
+  Non-whitelisted errors fail ``python -m qsm_tpu lint`` (exit 1) and
+  block the probe_watcher seize sequence.
+* ``warning`` — suspicious but often legitimate (wall-clock reads in the
+  scheduler plane used only for timing stats).  Reported, never fatal.
+* ``info``    — notes (passes that ran vacuously, sampled coverage).
+
+Whitelist format (default file: ``<repo>/.qsmlint``): one accepted
+finding per line, ``RULE_ID location-prefix`` with ``#`` comments —
+
+    # timing stats, not delivery decisions
+    QSM-DET-TIME qsm_tpu/sched/pool.py
+
+A finding is whitelisted when its ``rule_id`` matches exactly and its
+``location`` starts with the given prefix (``*`` matches any location).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+SEVERITIES = (ERROR, WARNING, INFO)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer finding; ``location`` is ``path:line`` for AST passes
+    and ``model:<family>`` / ``spec:<name>`` for semantic passes."""
+
+    severity: str
+    rule_id: str
+    location: str
+    message: str
+    fix_hint: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Whitelist:
+    """Accepted findings: exact rule_id + location prefix per entry."""
+
+    def __init__(self, entries: Sequence[Tuple[str, str]] = (),
+                 path: Optional[str] = None):
+        self.entries = list(entries)
+        self.path = path
+
+    @classmethod
+    def load(cls, path: str) -> "Whitelist":
+        entries = []
+        with open(path) as f:
+            for ln in f:
+                ln = ln.split("#", 1)[0].strip()
+                if not ln:
+                    continue
+                parts = ln.split(None, 1)
+                rule = parts[0]
+                prefix = parts[1].strip() if len(parts) > 1 else "*"
+                entries.append((rule, prefix))
+        return cls(entries, path=path)
+
+    def allows(self, finding: Finding) -> bool:
+        for rule, prefix in self.entries:
+            if finding.rule_id != rule:
+                continue
+            if prefix == "*" or finding.location.startswith(prefix):
+                return True
+        return False
+
+
+def split_whitelisted(findings: Sequence[Finding],
+                      whitelist: Optional[Whitelist]
+                      ) -> Tuple[List[Finding], List[Finding]]:
+    """(kept, whitelisted); severity ordering is preserved within each."""
+    if whitelist is None:
+        return list(findings), []
+    kept, allowed = [], []
+    for f in findings:
+        (allowed if whitelist.allows(f) else kept).append(f)
+    return kept, allowed
+
+
+def sort_findings(findings: Sequence[Finding]) -> List[Finding]:
+    rank = {s: i for i, s in enumerate(SEVERITIES)}
+    return sorted(findings,
+                  key=lambda f: (rank.get(f.severity, len(SEVERITIES)),
+                                 f.rule_id, f.location))
+
+
+def render_text(findings: Sequence[Finding],
+                whitelisted: Sequence[Finding] = ()) -> str:
+    """Human rendering: one line per finding plus a summary tail."""
+    lines = []
+    for f in sort_findings(findings):
+        lines.append(f"{f.severity.upper():7s} {f.rule_id}  {f.location}")
+        lines.append(f"        {f.message}")
+        if f.fix_hint:
+            lines.append(f"        fix: {f.fix_hint}")
+    n_err = sum(1 for f in findings if f.severity == ERROR)
+    n_warn = sum(1 for f in findings if f.severity == WARNING)
+    lines.append(f"qsmlint: {n_err} error(s), {n_warn} warning(s), "
+                 f"{len(findings) - n_err - n_warn} info, "
+                 f"{len(whitelisted)} whitelisted")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding],
+                whitelisted: Sequence[Finding] = (),
+                meta: Optional[Dict] = None) -> str:
+    """One JSON document — the ``--json`` / probe_watcher archive form."""
+    doc = {
+        "tool": "qsmlint",
+        "errors": sum(1 for f in findings if f.severity == ERROR),
+        "warnings": sum(1 for f in findings if f.severity == WARNING),
+        "findings": [f.to_dict() for f in sort_findings(findings)],
+        "whitelisted": [f.to_dict() for f in sort_findings(whitelisted)],
+    }
+    if meta:
+        doc.update(meta)
+    return json.dumps(doc)

@@ -1,0 +1,86 @@
+"""Seeded-bug fixtures — TRUE POSITIVES the lint gate must keep catching.
+
+Each fixture plants exactly the class of defect one pass family exists
+for; tests/test_lint.py asserts the analyzer flags each with the right
+rule_id.  A lint whose true positives rot is a green light with the bulb
+removed — these fixtures are the bulb check.  Nothing here is exported
+through the package ``__init__`` and nothing in the production paths
+imports this module.
+"""
+
+from __future__ import annotations
+
+from ..models.cas import CAS, READ, WRITE, CasSpec
+from ..ops.jax_kernel import JaxTPU
+
+
+class ParityBrokenCasSpec(CasSpec):
+    """Seeded bug for QSM-SPEC-PARITY: ``step_jax`` acks EVERY cas as
+    successful (``resp == 1``) while ``step_py`` keeps the real
+    compare — the exact divergence class where the device kernel would
+    bless histories the oracle rejects."""
+
+    name = "parity_broken_cas"
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        value = state[0]
+        old = arg // self.n_values
+        new = arg % self.n_values
+        succ = value == old
+        ok = jnp.where(
+            cmd == READ, resp == value,
+            jnp.where(cmd == WRITE, resp == 0, resp == 1))  # <-- bug
+        new_value = jnp.where(
+            cmd == WRITE, arg,
+            jnp.where((cmd == CAS) & succ, new, value))
+        return jnp.stack([new_value.astype(state.dtype)]), ok
+
+
+class RetracingJaxTPU(JaxTPU):
+    """Seeded bug for QSM-KERN-RETRACE: the chunk executable is keyed on
+    a per-call nonce, so every batch re-jits the stepper — the
+    silent-recompile failure mode that costs 20-40 s per call inside a
+    real window."""
+
+    name = "retracing_jax_tpu"
+
+    def _chunk_fn(self, n_ops, batch, slots, chunk, donate=True):
+        import jax
+
+        nonce = len(self._compiled)  # <-- bug: per-call cache key
+        _, run_one = self._stepper(n_ops, slots)
+
+        def run_chunk(carry, cmd, arg, resp, valid, precedes):
+            return run_one(carry, cmd, arg, resp, valid, precedes,
+                           chunk=chunk)
+
+        fn = jax.jit(jax.vmap(run_chunk, in_axes=(0, 0, 0, 0, 0, 0)))
+        self._compiled[("chunk-nonce", nonce, n_ops, batch, slots,
+                        chunk)] = fn
+        return fn
+
+
+class UnorderedSchedulerStub:
+    """Seeded bug for the determinism passes: a delivery loop whose
+    choice is fed from set iteration order (QSM-DET-SET-ITER), an
+    unseeded module-level RNG (QSM-DET-RANDOM), a wall-clock read
+    (QSM-DET-TIME) and an id()-keyed sort (QSM-DET-ID).  Never executed;
+    tests point the sched AST pass at this file and assert every rule
+    fires."""
+
+    def __init__(self):
+        self.pool = []
+
+    def deliver_one(self):
+        import random
+        import time
+
+        pending = set(self.pool)
+        for inflight in pending:  # set order decides delivery
+            if random.random() < 0.5:  # unseeded draw
+                break
+        k = int(time.time()) % max(len(self.pool), 1)  # clock-fed pick
+        order = sorted(self.pool, key=id)  # address-ordered tiebreak
+        return order[k]

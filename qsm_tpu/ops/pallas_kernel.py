@@ -88,13 +88,38 @@ def build_pallas_chunk(spec, n_ops: int, state_bound: int, lanes: int,
         raise ValueError(
             f"cache_slots must be 0 or a power of two, got {cache_slots}")
 
-    # ALL word/bitmask math below is int32, not uint32: Mosaic does not
-    # implement reductions over unsigned integers (caught by the
-    # cross-platform lowering check, tests/test_pallas.py — the kernel
-    # would have failed its first real-chip window otherwise).  int32 is
-    # bit-identical here: packed-word sums have one distinct bit per
-    # term (sum == or, no carries), XLA integer ops wrap two's-
-    # complement, and right-shifts use shift_right_logical explicitly.
+    # ALL word/bitmask math below is int32, not uint32, and NO
+    # jnp.sum/any/min reductions appear inside the kernel: the pinned
+    # Mosaic lowering implements no integer reductions AT ALL
+    # ("Reductions over integers not implemented" — caught by the
+    # cross-platform lowering check, tests/test_pallas.py; the first
+    # version assumed only unsigned reductions were missing and would
+    # have failed its first real-chip window).  Every one-hot
+    # select/pack below therefore reduces via the statically unrolled
+    # helpers `_sum0`/`_any0`/`_min0` — elementwise adds/ors/mins over
+    # the small static leading axis (N+1 ≤ 33, S ≤ 64, slots ≤ 64),
+    # bit-identical to the reduction form: packed-word sums have one
+    # distinct bit per term (sum == or, no carries), XLA integer ops
+    # wrap two's-complement, and right-shifts use shift_right_logical
+    # explicitly.
+    def _sum0(x):
+        acc = x[0]
+        for i in range(1, x.shape[0]):
+            acc = acc + x[i]
+        return acc
+
+    def _any0(x):
+        acc = x[0]
+        for i in range(1, x.shape[0]):
+            acc = acc | x[i]
+        return acc
+
+    def _min0(x):
+        acc = x[0]
+        for i in range(1, x.shape[0]):
+            acc = jnp.minimum(acc, x[i])
+        return acc
+
     def _i32(x):
         return jnp.asarray(np.int64(x).astype(np.int32) if x > 0x7FFFFFFF
                            else x, jnp.int32)
@@ -134,38 +159,38 @@ def build_pallas_chunk(spec, n_ops: int, state_bound: int, lanes: int,
             taken, chosen, states, d, status, iters, ck0, ck1, occ = c
             active = status == RUNNING                       # [L]
             dm = (kio == d[None, :]).astype(jnp.int32)       # [N+1, L]
-            state = jnp.sum(states * dm, axis=0)             # [L]
-            cur = jnp.sum(chosen * dm, axis=0)               # [L]
+            state = _sum0(states * dm)                       # [L]
+            cur = _sum0(chosen * dm)                         # [L]
             untaken = valid * (1 - taken)                    # [N, L]
-            uw = jnp.sum(untaken << shift, axis=0)           # [L] int32
+            uw = _sum0(untaken << shift)                     # [L] int32
             blocked = (prec & uw[None, :]) != 0              # [N, L]
             sm = (sio == state[None, :]).astype(jnp.int32)   # [S, L]
-            ok_row = jnp.sum(ok_tab * sm[:, None, :], axis=0)    # [N, L]
-            nxt_row = jnp.sum(nxt_tab * sm[:, None, :], axis=0)  # [N, L]
+            ok_row = _sum0(ok_tab * sm[:, None, :])          # [N, L]
+            nxt_row = _sum0(nxt_tab * sm[:, None, :])        # [N, L]
             cand = ((untaken == 1) & ~blocked & (ok_row == 1)
                     & (nio > cur[None, :]))                  # [N, L]
-            has = jnp.any(cand, axis=0)                      # [L]
-            jstar = jnp.min(jnp.where(cand, nio, N), axis=0)
+            has = _any0(cand)                                # [L]
+            jstar = _min0(jnp.where(cand, nio, N))
             jm = (nio == jstar[None, :]).astype(jnp.int32)   # [N, L]
-            child = jnp.sum(nxt_row * jm, axis=0)            # [L]
+            child = _sum0(nxt_row * jm)                      # [L]
             success = has & (d + 1 == nreq)
 
             if use_cache:
-                taken_word = jnp.sum(taken << shift, axis=0)     # [L]
+                taken_word = _sum0(taken << shift)               # [L]
                 child_word = taken_word | (
                     jnp.int32(1) << jnp.minimum(jstar, N - 1))
                 slot_c = _hash(child_word, child)                # [L]
                 sel_c = cio == slot_c[None, :]                   # [slots, L]
-                hit = jnp.any(sel_c & (occ == 1)
-                              & (ck0 == child_word[None, :])
-                              & (ck1 == child[None, :]), axis=0)
+                hit = _any0(sel_c & (occ == 1)
+                            & (ck0 == child_word[None, :])
+                            & (ck1 == child[None, :]))
                 prune = has & hit & ~success & active
             else:
                 prune = jnp.zeros_like(has)  # all-False (has is bool)
             descend = has & active & ~prune
             d_back = jnp.maximum(d - 1, 0)
             dbm = (kio == d_back[None, :]).astype(jnp.int32)
-            prev = jnp.maximum(jnp.sum(chosen * dbm, axis=0), 0)
+            prev = jnp.maximum(_sum0(chosen * dbm), 0)
             back = active & ~has & (d > 0)
 
             taken_n = jnp.where(

@@ -3,7 +3,8 @@
 The reference's knobs are QuickCheck ``Args`` (maxSuccess, replay seed, size)
 (SURVEY.md §5 config): here that's a plain argparse CLI over the registry —
 ``run`` (property check), ``replay`` (reproduce a persisted failure),
-``bench`` (checker throughput), ``coverage`` (schedule diversity).
+``bench`` (checker throughput), ``coverage`` (schedule diversity),
+``lint`` (the qsmlint static analyzer — docs/ANALYSIS.md).
 """
 
 from __future__ import annotations
@@ -540,6 +541,72 @@ def cmd_check(args) -> int:
     return 2 if v == int(Verdict.BUDGET_EXCEEDED) else 1
 
 
+def cmd_lint(args) -> int:
+    """Static spec/kernel/determinism analysis (qsm_tpu/analysis) —
+    CPU-only by contract: the process is pinned to the CPU platform
+    BEFORE anything imports jax, so the lint gate can never touch (or
+    hang on) the chip tunnel it exists to protect.  Exit 1 on
+    non-whitelisted error-severity findings, 0 otherwise; the seeded-bug
+    fixtures that prove each rule still fires live in
+    tests/test_lint.py."""
+    # Exit-code contract (the watcher's seize gate depends on it):
+    # 0 clean, 1 REAL FINDINGS (seize refused), 2 usage error, 3
+    # analyzer trouble (waved through).  EVERYTHING — imports, platform
+    # pinning, the run itself — sits inside the crash guard: a broken
+    # analysis module exiting 1 would refuse every healed window of the
+    # round as if it were a real finding.
+    import os
+
+    try:
+        from .device import force_cpu_platform
+
+        force_cpu_platform()
+        from ..analysis import render_json, render_text, run_lint
+
+        wl = args.whitelist
+        if wl is not None and not os.path.exists(wl):
+            print(f"whitelist file not found: {wl}", file=sys.stderr)
+            return 2
+        models = args.models.split(",") if args.models else None
+        if models:
+            # validate HERE, not via a broad except around run_lint: a
+            # ValueError from deep inside an analysis pass is analyzer
+            # trouble (rc 3 with traceback), not a usage error
+            unknown = sorted(set(models) - set(MODELS))
+            if unknown:
+                print(f"unknown model families {unknown}; one of "
+                      f"{sorted(MODELS)}", file=sys.stderr)
+                return 2
+        # default-whitelist resolution (.qsmlint at the repo root when
+        # present) happens INSIDE run_lint — one definition; the report
+        # carries the resolved path back for the label
+        rep = run_lint(models=models, retrace=not args.no_retrace,
+                       whitelist=wl)
+        doc = rep.to_json()
+        if args.out:
+            # archived alongside bench artifacts (probe_watcher/CI) —
+            # always the JSON form regardless of what stdout renders;
+            # INSIDE the guard: an unwritable --out (disk full, bad
+            # path) is analyzer trouble, not findings
+            with open(args.out, "w") as f:
+                f.write(doc + "\n")
+        if args.json:
+            print(doc)
+        else:
+            print(render_text(rep.findings, rep.whitelisted))
+            print(f"({rep.seconds:.1f}s over models: "
+                  f"{', '.join(rep.models)};"
+                  f" whitelist: {rep.whitelist_path or 'none'})")
+    except Exception as e:  # noqa: BLE001 — analyzer trouble, not findings
+        import traceback
+
+        traceback.print_exc()
+        print(f"qsmlint crashed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 3
+    return 0 if rep.ok else 1
+
+
 def cmd_list(args) -> int:
     """Discoverability: every registry model (with sizes + impls) and
     every backend choice, as one JSON object.  Uses the compile-free
@@ -819,6 +886,25 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("list", help="models, impls, and backend choices")
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser(
+        "lint",
+        help="static spec/kernel/determinism analysis (CPU-only; exit 1 "
+             "on non-whitelisted error findings)")
+    p.add_argument("--json", action="store_true",
+                   help="print one JSON document instead of text")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON findings document to this "
+                        "path (probe_watcher/CI archive)")
+    p.add_argument("--whitelist", default=None,
+                   help="accepted-findings file (default: .qsmlint at "
+                        "the repo root when present)")
+    p.add_argument("--models", default=None,
+                   help="comma list of registry families (default: all)")
+    p.add_argument("--no-retrace", action="store_true",
+                   help="skip the dynamic jit-cache retracing check "
+                        "(the one pass that executes a backend)")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser(
         "explore",

@@ -138,6 +138,22 @@ def probe_or_force_cpu(force_cpu: bool = False,
     return on_tpu, detail, header
 
 
+def _compile_cache_dir(dirpath: Optional[str] = None) -> str:
+    """THE default-directory derivation for the persistent compile
+    cache: env override, else ``<repo>/.jax_cache``.  One definition
+    shared by :func:`enable_compile_cache` and
+    :func:`compile_cache_entries` (ADVICE.md round 5, finding 4: the
+    duplicated env-var + three-dirname-hops derivation could silently
+    desynchronize, and the before/after cache stamps benchmarks embed
+    would then count the wrong directory)."""
+    if dirpath is not None:
+        return dirpath
+    return os.environ.get(
+        "QSM_TPU_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+
+
 def enable_compile_cache(dirpath: Optional[str] = None) -> None:
     """Turn on JAX's persistent (on-disk, cross-process) compilation cache.
 
@@ -147,11 +163,7 @@ def enable_compile_cache(dirpath: Optional[str] = None) -> None:
     shared on-disk cache means only the window's first process pays them.
     Safe to call any time before (or after) backend init; never raises —
     an old jax without the knobs just skips it."""
-    if dirpath is None:
-        dirpath = os.environ.get(
-            "QSM_TPU_COMPILE_CACHE",
-            os.path.join(os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    dirpath = _compile_cache_dir(dirpath)
     try:
         import jax
 
@@ -172,13 +184,9 @@ def compile_cache_entries(dirpath: Optional[str] = None) -> Optional[int]:
     first-compiles or hit the cross-process cache (VERDICT.md round 4,
     "What's weak" #3: nothing in the banked windows records compile-cache
     state, so compile-cost-inside-the-window could not be ruled out)."""
-    if dirpath is None:
-        dirpath = os.environ.get(
-            "QSM_TPU_COMPILE_CACHE",
-            os.path.join(os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
     try:
-        return sum(1 for e in os.scandir(dirpath) if e.is_file())
+        return sum(1 for e in os.scandir(_compile_cache_dir(dirpath))
+                   if e.is_file())
     except OSError:
         return None
 

@@ -1,0 +1,201 @@
+"""The qsmlint tier-1 gate (ISSUE 1 acceptance): the in-tree corpus —
+all eight registry model families and all five lineariser engine
+modules — must lint clean (no non-whitelisted error findings), and each
+seeded-bug fixture (parity-broken spec, retracing kernel, nondeterministic
+scheduler stub) must be flagged with the correct rule_id.  A lint whose
+true positives rot is a green light with the bulb removed."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+import qsm_tpu.analysis.fixtures as fixtures
+from qsm_tpu.analysis import (ERROR, Finding, Whitelist, run_lint)
+from qsm_tpu.analysis.engine import (DEFAULT_OPS_FILES,
+                                     DEFAULT_SCHED_FILES,
+                                     _retrace_corpora)
+from qsm_tpu.analysis.kernel_passes import (VMEM_BUDGET_BYTES,
+                                            check_retracing,
+                                            check_step_dtypes,
+                                            pallas_vmem_bytes)
+from qsm_tpu.analysis.sched_passes import check_sched_file
+from qsm_tpu.analysis.spec_passes import check_spec
+from qsm_tpu.models.registry import MODELS
+
+
+@pytest.fixture(scope="module")
+def report():
+    t0 = time.perf_counter()
+    rep = run_lint()
+    rep.wall = time.perf_counter() - t0
+    return rep
+
+
+def test_in_tree_corpus_is_clean(report):
+    """All eight families + the five engine modules + the scheduler
+    plane: zero non-whitelisted error findings."""
+    assert sorted(MODELS) == report.models  # really covered everything
+    assert len(DEFAULT_OPS_FILES) == 5      # the five lineariser engines
+    assert len(DEFAULT_SCHED_FILES) == 4
+    assert report.ok, "\n".join(
+        f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
+
+
+def test_lint_is_window_cheap(report):
+    """The acceptance bound is <120 s on CPU; the analyzer must stay far
+    inside it or the watcher's pre-seize gate becomes its own window
+    burner."""
+    assert report.wall < 120.0
+
+
+def test_whitelist_entries_are_all_live(report):
+    """Every .qsmlint entry must still match a real finding — dead
+    entries are expired claims that hide future regressions at the same
+    location."""
+    used_rules = {f.rule_id for f in report.whitelisted}
+    from qsm_tpu.analysis import default_whitelist_path
+
+    wl = Whitelist.load(default_whitelist_path())
+    for rule, _prefix in wl.entries:
+        assert rule in used_rules, \
+            f"whitelist entry {rule} matches nothing; remove it"
+
+
+# --- the seeded-bug fixtures: every pass family proves it still fires ----
+
+def test_parity_broken_spec_is_caught():
+    findings = check_spec(fixtures.ParityBrokenCasSpec(),
+                          "fixture:parity_broken_cas")
+    errs = {f.rule_id for f in findings if f.severity == ERROR}
+    assert "QSM-SPEC-PARITY" in errs
+
+
+def test_retracing_kernel_is_caught():
+    spec = MODELS["cas"].make_spec()
+    backend = fixtures.RetracingJaxTPU(
+        spec, budget=2_000, mid_budget=0, rescue_budget=0,
+        rescue_slots=64)
+    backend.CHUNK_SCHEDULE = (512,)
+    backend.DOUBLE_BUFFER = False
+    findings = check_retracing(spec, backend,
+                               _retrace_corpora(MODELS["cas"], spec),
+                               "fixture:retracing_kernel")
+    assert {f.rule_id for f in findings} == {"QSM-KERN-RETRACE"}
+
+
+def test_nondeterministic_scheduler_stub_is_caught():
+    findings = check_sched_file(fixtures.__file__)
+    rules = {f.rule_id for f in findings}
+    assert {"QSM-DET-SET-ITER", "QSM-DET-RANDOM", "QSM-DET-TIME",
+            "QSM-DET-ID"} <= rules
+
+
+def test_unseeded_random_construction_is_flagged(tmp_path):
+    """The Random-constructor exemption is for SEEDED construction
+    only: `random.Random()` draws from OS entropy — the same
+    unreplayable nondeterminism the rule exists to forbid."""
+    p = tmp_path / "stub.py"
+    p.write_text("import random\n"
+                 "class S:\n"
+                 "    def __init__(self, seed):\n"
+                 "        self.rng = random.Random(seed)   # ok: seeded\n"
+                 "        self.bad = random.Random()       # entropy\n")
+    findings = check_sched_file(str(p))
+    assert [f.rule_id for f in findings] == ["QSM-DET-RANDOM"]
+    assert "UNSEEDED" in findings[0].message
+
+
+def test_dtype_pass_flags_float_state():
+    class FloatStateCas(fixtures.ParityBrokenCasSpec):
+        def step_jax(self, state, cmd, arg, resp):
+            import jax.numpy as jnp
+
+            ns, ok = super().step_jax(state, cmd, arg, resp)
+            return ns.astype(jnp.float32), ok  # seeded promotion
+
+    findings = check_step_dtypes(FloatStateCas(), "fixture:float_state")
+    assert any(f.rule_id == "QSM-KERN-DTYPE" and f.severity == ERROR
+               for f in findings)
+
+
+def test_vmem_estimator_brackets_the_envelope():
+    """The static estimator agrees with the kernel's own ceiling
+    (MAX_PALLAS_STATES fits) and rejects what that ceiling exists to
+    exclude (the S=1280 scalarized queue/stack shadows)."""
+    from qsm_tpu.ops.pallas_kernel import (MAX_PALLAS_OPS,
+                                           MAX_PALLAS_STATES, PallasTPU)
+
+    fits = pallas_vmem_bytes(MAX_PALLAS_OPS, MAX_PALLAS_STATES,
+                             PallasTPU.LANES,
+                             PallasTPU.PALLAS_CACHE_SLOTS)
+    blows = pallas_vmem_bytes(MAX_PALLAS_OPS, 1280, PallasTPU.LANES,
+                              PallasTPU.PALLAS_CACHE_SLOTS)
+    assert fits <= VMEM_BUDGET_BYTES < blows
+
+
+# --- whitelist and CLI plumbing -------------------------------------------
+
+def test_whitelist_filters_exact_rule_and_prefix():
+    wl = Whitelist([("QSM-DET-TIME", "qsm_tpu/sched/pool.py")])
+    hit = Finding("warning", "QSM-DET-TIME",
+                  "qsm_tpu/sched/pool.py:123", "m")
+    other_rule = Finding("error", "QSM-DET-RANDOM",
+                         "qsm_tpu/sched/pool.py:123", "m")
+    other_loc = Finding("warning", "QSM-DET-TIME",
+                        "qsm_tpu/sched/scheduler.py:5", "m")
+    assert wl.allows(hit)
+    assert not wl.allows(other_rule)
+    assert not wl.allows(other_loc)
+    assert Whitelist([("QSM-DET-TIME", "*")]).allows(other_loc)
+
+
+def test_cli_lint_json_and_exit_codes(tmp_path, capsys):
+    """`python -m qsm_tpu lint --json` is the probe_watcher/CI archive
+    form: one JSON document, exit 0 on a clean corpus, findings carried
+    in full."""
+    from qsm_tpu.utils.cli import main
+
+    out_path = tmp_path / "lint.json"
+    rc = main(["lint", "--json", "--models", "cas", "--no-retrace",
+               "--out", str(out_path)])
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and doc["ok"] is True
+    assert doc["tool"] == "qsmlint" and doc["errors"] == 0
+    assert doc["models"] == ["cas"]
+    # the --out archive is the same document
+    assert json.loads(out_path.read_text())["ok"] is True
+
+
+def test_cli_lint_usage_errors_exit_2_not_1(capsys, tmp_path):
+    """Exit-code contract: 1 is reserved for REAL FINDINGS (the watcher
+    refuses window seizes on it); usage mistakes exit 2."""
+    from qsm_tpu.utils.cli import main
+
+    assert main(["lint", "--models", "nope"]) == 2
+    assert "unknown model" in capsys.readouterr().err
+    assert main(["lint", "--whitelist", str(tmp_path / "absent")]) == 2
+
+
+def test_cli_lint_analyzer_crash_exits_3_not_1(monkeypatch):
+    """Analyzer trouble must exit 3 so probe_watcher waves it through
+    instead of refusing every healed window of the round."""
+    import qsm_tpu.analysis as analysis
+    from qsm_tpu.utils.cli import main
+
+    monkeypatch.setattr(analysis, "run_lint",
+                        lambda **kw: (_ for _ in ()).throw(
+                            RuntimeError("boom")))
+    assert main(["lint", "--no-retrace", "--models", "cas"]) == 3
+
+
+def test_report_json_shape(report):
+    doc = json.loads(report.to_json())
+    assert set(doc) >= {"tool", "errors", "warnings", "findings",
+                        "whitelisted", "ok", "seconds", "passes",
+                        "models"}
+    for f in doc["findings"] + doc["whitelisted"]:
+        assert set(f) == {"severity", "rule_id", "location", "message",
+                          "fix_hint"}

@@ -91,39 +91,69 @@ def test_pallas_cache_prunes_without_changing_verdicts(cas_corpus):
     assert out[64][1] < out[0][1]  # measured: 4 -> 1 chunk calls here
 
 
-def test_pallas_mosaic_lowering():
-    """Cross-platform lowering to the REAL Mosaic TPU backend (no chip
-    needed: jax lowers for an explicit target platform).  This is what
-    stands between the prototype and a wasted healed-tunnel window — the
-    first version failed exactly here ('Reductions over unsigned
-    integers not implemented'), which interpret-mode tests can never
-    catch."""
+def _lower_for_tpu(N, S, B, cache_slots):
+    """Trace + lower one build_pallas_chunk config for the real Mosaic
+    TPU target (no chip needed).  ONE definition of the kernel's
+    table/carry argument layout for every lowering test — it must
+    mirror build_pallas_chunk's in_specs exactly, and a carry-plane
+    change edited in only one duplicated literal would leave the other
+    test lowering a stale layout."""
     import jax
     import jax.numpy as jnp
 
     from qsm_tpu.ops.pallas_kernel import build_pallas_chunk
 
-    spec = CasSpec()
-    N, S, L, B = 32, 5, 256, 256
+    CS = max(cache_slots, 1)
+    fn = build_pallas_chunk(CasSpec(), N, S, lanes=256, chunk=64,
+                            budget=2000, interpret=False,
+                            cache_slots=cache_slots)
+    args = (jnp.zeros((S, N, B), jnp.int32),
+            jnp.zeros((S, N, B), jnp.int32),
+            jnp.zeros((N, B), jnp.int32),
+            jnp.zeros((N, B), jnp.int32),
+            jnp.zeros((1, B), jnp.int32),
+            jnp.zeros((N, B), jnp.int32),
+            jnp.full((N + 1, B), -1, jnp.int32),
+            jnp.zeros((N + 1, B), jnp.int32),
+            jnp.zeros((3, B), jnp.int32),
+            jnp.zeros((CS, B), jnp.int32),
+            jnp.zeros((CS, B), jnp.int32),
+            jnp.zeros((CS, B), jnp.int32))
+    return jax.jit(fn).trace(*args).lower(lowering_platforms=("tpu",))
+
+
+def test_pallas_mosaic_lowering():
+    """Cross-platform lowering to the REAL Mosaic TPU backend (no chip
+    needed: jax lowers for an explicit target platform).  This is what
+    stands between the prototype and a wasted healed-tunnel window —
+    two prior versions failed exactly here (unsigned reductions, then
+    ALL integer reductions, unimplemented in Mosaic), which
+    interpret-mode tests can never catch."""
     for cs in (64, 0):
-        CS = max(cs, 1)
-        fn = build_pallas_chunk(spec, N, S, L, chunk=64, budget=2000,
-                                interpret=False, cache_slots=cs)
-        args = (jnp.zeros((S, N, B), jnp.int32),
-                jnp.zeros((S, N, B), jnp.int32),
-                jnp.zeros((N, B), jnp.int32),
-                jnp.zeros((N, B), jnp.int32),
-                jnp.zeros((1, B), jnp.int32),
-                jnp.zeros((N, B), jnp.int32),
-                jnp.full((N + 1, B), -1, jnp.int32),
-                jnp.zeros((N + 1, B), jnp.int32),
-                jnp.zeros((3, B), jnp.int32),
-                jnp.zeros((CS, B), jnp.int32),
-                jnp.zeros((CS, B), jnp.int32),
-                jnp.zeros((CS, B), jnp.int32))
-        lowered = jax.jit(fn).trace(*args).lower(
-            lowering_platforms=("tpu",))
+        lowered = _lower_for_tpu(N=32, S=5, B=256, cache_slots=cs)
         assert len(lowered.as_text()) > 0
+
+
+def test_pallas_mosaic_lowering_at_vmem_envelope():
+    """Mosaic lowering at S = MAX_PALLAS_STATES — the LARGEST table the
+    prototype admits (ADVICE.md round 5, finding 2: the lowering test
+    only exercised S=5, so a big-S table spec could fail VMEM
+    allocation/compile on the real chip and waste a healed window) —
+    cross-checked against the static VMEM estimator: the envelope gate
+    and the lowering must agree in both directions."""
+    from qsm_tpu.analysis.kernel_passes import (VMEM_BUDGET_BYTES,
+                                                pallas_vmem_bytes)
+    from qsm_tpu.ops.pallas_kernel import (MAX_PALLAS_OPS,
+                                           MAX_PALLAS_STATES)
+
+    N, S, L, CS = MAX_PALLAS_OPS, MAX_PALLAS_STATES, 256, 64
+    # the static estimator must admit this config ...
+    assert pallas_vmem_bytes(N, S, L, CS) <= VMEM_BUDGET_BYTES
+    # ... and reject what MAX_PALLAS_STATES exists to exclude (the
+    # S=1280 scalarized queue/stack shadows)
+    assert pallas_vmem_bytes(N, 1280, L, CS) > VMEM_BUDGET_BYTES
+    lowered = _lower_for_tpu(N=N, S=S, B=256, cache_slots=CS)
+    assert len(lowered.as_text()) > 0
 
 
 def test_pallas_rejects_unsupported_specs():

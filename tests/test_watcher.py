@@ -38,6 +38,11 @@ def w(tmp_path, monkeypatch):
     }
     monkeypatch.setattr(mod, "CAPTURES_LOG",
                         str(tmp_path / "BENCH_TPU_CAPTURES_r05.jsonl"))
+    monkeypatch.setattr(mod, "LINT_ARTIFACT",
+                        str(tmp_path / "LINT_r05.json"))
+    # the pre-seize lint gate runs a real analysis subprocess; stub it
+    # open here (its own decision logic is tested separately below)
+    monkeypatch.setattr(mod, "_preflight_lint", lambda *a, **k: True)
     return mod
 
 
@@ -247,6 +252,102 @@ def test_run_tool_timeout_promotes_bigger_partial(w, tmp_path,
     assert len(kept) == 2  # promoted: 1 measured row > 0 banked
     # and the committed twin was banked too
     assert (tmp_path / "BENCH_SCALE_TPU_r05.json").exists()
+
+
+@pytest.fixture()
+def w_lint(tmp_path, monkeypatch):
+    """Watcher module with the REAL _preflight_lint (subprocess patched
+    per-test) — the `w` fixture stubs the gate open."""
+    spec = importlib.util.spec_from_file_location(
+        "watcher_lint_under_test", os.path.join(REPO, "tools",
+                                                "probe_watcher.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    monkeypatch.setattr(m, "REPO", str(tmp_path))
+    monkeypatch.setattr(m, "LOG", str(tmp_path / "probe_log.jsonl"))
+    monkeypatch.setattr(m, "LINT_ARTIFACT", str(tmp_path / "LINT.json"))
+    return m
+
+
+def _fake_lint_run(rc):
+    def run(cmd, **kw):
+        class R:
+            returncode = rc
+            stdout = '{"tool": "qsmlint"}'
+            stderr = ""
+        return R()
+    return run
+
+
+def test_lint_gate_refuses_seize_on_error_findings(w_lint, monkeypatch):
+    """rc 1 (non-whitelisted error findings) must block the seize — a
+    statically-broken kernel/spec may not spend a healing window."""
+    monkeypatch.setattr(w_lint.subprocess, "run", _fake_lint_run(1))
+    assert w_lint._preflight_lint() is False
+    ev = [e for e in _events(w_lint) if e.get("event") == "window_lint"]
+    assert ev and ev[-1]["ok"] is False
+    # cached: a second call must not re-run the subprocess
+    monkeypatch.setattr(w_lint.subprocess, "run",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            AssertionError("must be cached")))
+    assert w_lint._preflight_lint() is False
+
+
+def test_lint_gate_waves_through_analyzer_trouble(w_lint, monkeypatch):
+    """Analyzer crashes (rc != 0/1) must NOT cost the round its windows:
+    seize allowed, warning logged."""
+    monkeypatch.setattr(w_lint.subprocess, "run", _fake_lint_run(2))
+    assert w_lint._preflight_lint() is True
+    ev = [e for e in _events(w_lint) if e.get("event") == "window_lint"]
+    assert ev and "waved through" in ev[-1]["detail"]
+
+
+def test_lint_gate_does_not_cache_transient_trouble(w_lint, monkeypatch):
+    """A timeout (pegged machine) is waved through but NOT cached —
+    caching ok=True under the fingerprint would silently disarm the
+    gate for these sources for the rest of the round."""
+    def timeout_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 1))
+
+    monkeypatch.setattr(w_lint.subprocess, "run", timeout_run)
+    assert w_lint._preflight_lint() is True  # waved through
+    # next call re-runs (not cached) and sees the real verdict
+    monkeypatch.setattr(w_lint.subprocess, "run", _fake_lint_run(1))
+    assert w_lint._preflight_lint() is False
+
+
+def test_lint_gate_cache_clears_when_sources_change(w_lint, tmp_path,
+                                                    monkeypatch):
+    """The cached verdict is keyed on a source fingerprint: a refusal
+    cached before a fix must clear once the sources change — otherwise
+    every later window of the round is refused on a stale verdict."""
+    src = tmp_path / "qsm_tpu"
+    src.mkdir()
+    f = src / "mod.py"
+    f.write_text("x = 1\n")
+    monkeypatch.setattr(w_lint.subprocess, "run", _fake_lint_run(1))
+    assert w_lint._preflight_lint() is False
+    # same sources: cached refusal, no re-run
+    monkeypatch.setattr(w_lint.subprocess, "run", _fake_lint_run(0))
+    assert w_lint._preflight_lint() is False
+    # "fix lands": mtime moves, fingerprint changes, gate re-lints
+    os.utime(f, (time.time() + 10, time.time() + 10))
+    assert w_lint._preflight_lint() is True
+    # whitelisting a finding touches ONLY .qsmlint — that too must
+    # clear the cache (the documented accept-a-finding workflow)
+    monkeypatch.setattr(w_lint.subprocess, "run", _fake_lint_run(1))
+    assert w_lint._preflight_lint() is True  # still cached
+    (tmp_path / ".qsmlint").write_text("# reviewed\n")
+    os.utime(tmp_path / ".qsmlint",
+             (time.time() + 20, time.time() + 20))
+    assert w_lint._preflight_lint() is False  # re-linted
+
+
+def test_lint_gate_clean_allows_seize(w_lint, monkeypatch):
+    monkeypatch.setattr(w_lint.subprocess, "run", _fake_lint_run(0))
+    assert w_lint._preflight_lint() is True
+    ev = [e for e in _events(w_lint) if e.get("event") == "window_lint"]
+    assert ev and ev[-1]["ok"] is True and ev[-1]["detail"] == "clean"
 
 
 def test_scale_completeness_is_content_based(w, tmp_path):

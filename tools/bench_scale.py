@@ -162,11 +162,20 @@ def run_scale(on_tpu: bool, out_path: str, header: dict,
         def mk():
             backend = PallasTPU(spec, budget=2_000)
             backend.MAX_BATCH = batch
+            # total_budget stamped so the row is self-describing
+            # (ADVICE.md round 5, finding 3: budget=2000 alone implied a
+            # 2k iteration cap while the inherited mid=50k/rescue=500k
+            # defaults let the kernel run to 552k).  The DEFAULTS are
+            # deliberately kept: the XLA control row this cell is the
+            # A/B against runs the same inherited budgets — zeroing
+            # them only here would confound driver with a 276×
+            # iteration-cap difference.
             row["settings"] = {
                 "pallas_chunk": backend.PALLAS_CHUNK,
                 "lanes_per_block": backend.LANES,
                 "cache_slots": backend.PALLAS_CACHE_SLOTS,
                 "budget": 2_000,
+                "total_budget": backend.total_budget,
             }
             return backend
 
@@ -203,6 +212,7 @@ def run_scale(on_tpu: bool, out_path: str, header: dict,
                 "budget": 2_000,
                 "mid_budget": (backend_kw or {}).get(
                     "mid_budget", "default"),
+                "total_budget": backend.total_budget,
             }
             return backend
 
@@ -273,6 +283,15 @@ def run_scale(on_tpu: bool, out_path: str, header: dict,
         emit({"variant": "diagnostics", "skipped": "time box exhausted"})
     if good and time.perf_counter() - t_start <= time_box_s:
         bstar = max(good, key=lambda r: r["rate_h_per_s"])["batch"]
+        # matched-width unroll A/B at the ADOPTED width (ADVICE.md round
+        # 5, finding 1): when the ladder picks a width other than the
+        # control, the headline would otherwise run a (width, unroll)
+        # pair never measured together on-chip — the exact settings
+        # confound that burned round 4.  best_scale_unroll keeps
+        # comparing at the FIRST unroll1 row's width (the control), so
+        # this extra cell is diagnostic, not adoption-changing.
+        if bstar != control:
+            emit(measure(bstar, variant="unroll1", unroll=1))
         emit(measure(bstar, variant="oneshot", schedule=(65536,)))
         if time.perf_counter() - t_start <= time_box_s:
             b2k = measure(bstar, variant="budget2k",

@@ -60,6 +60,86 @@ COMMITTED_COPIES = {
 # through git history (ADVICE.md round 4).
 CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 
+# Committed archive of the pre-seize static-analysis findings (the lint
+# gate below); one JSON document, refreshed whenever the gate runs.
+LINT_ARTIFACT = os.path.join(REPO, f"LINT_{ROUND_TAG}.json")
+
+# Cached verdict of the pre-seize lint gate, keyed on a SOURCE
+# fingerprint — not process lifetime: the watcher runs all round while
+# the builder edits the very specs/kernels the analysis covers, so a
+# cached refusal must clear when the defect is fixed (or every later
+# window is wasted on a stale verdict) and a cached pass must expire
+# when a defect lands.  main() warms it BEFORE the probe loop; a
+# mid-round source change re-runs the ~30 s analysis inside the next
+# seize — the correct trade for a fresh verdict.
+_LINT_STATE: dict = {}
+
+
+def _lint_fingerprint() -> str:
+    """Cheap staleness key: newest mtime + file count over every input
+    the analysis reads — the package sources AND the ``.qsmlint``
+    whitelist (accepting a finding by whitelisting it touches only the
+    whitelist, and must clear a cached refusal just like a code fix).
+    Uncommitted edits count — git state would not."""
+    latest, count = 0.0, 0
+    paths = [os.path.join(REPO, ".qsmlint")]
+    for dirpath, _dirs, files in os.walk(os.path.join(REPO, "qsm_tpu")):
+        paths.extend(os.path.join(dirpath, f) for f in files
+                     if f.endswith(".py"))
+    for p in paths:
+        try:
+            latest = max(latest, os.path.getmtime(p))
+            count += 1
+        except OSError:
+            pass
+    return f"{count}:{latest}"
+
+
+def _preflight_lint(timeout_s: float = 420.0) -> bool:
+    """The window-seize gate: run ``python -m qsm_tpu lint`` (CPU-pinned
+    by the lint command itself — it can never touch the tunnel) and
+    refuse to spend a healing window when the analyzer finds
+    non-whitelisted error-severity defects (a spec whose step_jax
+    diverges from the oracle, a retracing kernel, a VMEM-blowing table
+    spec ... would burn the window on statically-knowable failures).
+
+    Verdict semantics: rc 0 -> seize allowed; rc 1 (real findings) ->
+    seize REFUSED; any other failure (timeout, crash, missing module)
+    -> allowed with a logged warning — analyzer trouble must not cost
+    the round its windows.  Cached per source fingerprint (see
+    ``_LINT_STATE``)."""
+    key = _lint_fingerprint()
+    if _LINT_STATE.get("key") == key:
+        return _LINT_STATE["ok"]
+    t0 = time.time()
+    cache = True
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "qsm_tpu", "lint", "--json",
+             "--out", LINT_ARTIFACT],
+            capture_output=True, text=True, timeout=timeout_s, cwd=REPO)
+        ok = r.returncode != 1
+        detail = ("clean" if r.returncode == 0 else
+                  "error findings; seize refused" if r.returncode == 1
+                  else f"lint rc {r.returncode}; waved through: "
+                       + (r.stderr or r.stdout)[-200:])
+    except subprocess.TimeoutExpired:
+        # TRANSIENT trouble (a pegged machine, the very condition the
+        # watcher runs under) is waved through but NOT cached: caching
+        # ok=True under the fingerprint would silently disarm the gate
+        # for these sources for the rest of the round
+        ok, detail, cache = True, \
+            f"lint exceeded {timeout_s:.0f}s; waved through", False
+    except OSError as e:
+        ok, detail, cache = True, \
+            f"lint failed to launch ({e!r}); waved through", False
+    if cache:
+        _LINT_STATE["key"] = key
+        _LINT_STATE["ok"] = ok
+    _log(event="window_lint", ok=ok,
+         seconds=round(time.time() - t0, 1), detail=detail)
+    return ok
+
 
 def _bank_committed_copy(runtime_path: str) -> None:
     dst = COMMITTED_COPIES.get(runtime_path)
@@ -353,6 +433,12 @@ def _seize_window(bench_timeout: float) -> bool:
             and profile_done and configs_done and sweep_done):
         return True  # everything banked: a healthy tunnel cycle is silent
 
+    # --- 0. the static-analysis gate (cached; main() warms it OFF-window
+    # so a healthy run pays nothing here): statically-detectable defects
+    # must never spend a healing window -----------------------------------
+    if not _preflight_lint():
+        return False
+
     # --- 1. the scale scan: the decision artifact ------------------------
     if scale_done:
         _log(event="window_scale", ok=True, detail="already banked; kept")
@@ -414,6 +500,11 @@ def main() -> int:
     ap.add_argument("--no-bench", action="store_true",
                     help="log probes only; never launch the window bench")
     args = ap.parse_args()
+    if not args.no_bench:
+        # warm the lint gate BEFORE the probe loop: the analysis runs on
+        # the CPU while the tunnel is (typically) wedged anyway, so a
+        # later healed window is never spent on it
+        _preflight_lint()
     while True:
         t0 = time.time()
         p = probe_default_backend(args.timeout)
