@@ -291,6 +291,15 @@ def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
 
     caps = {"cpp": NATIVE_MAX_OPS}
 
+    from qsm_tpu.search.stats import collect_search_stats
+
+    def _cell_search(backend) -> dict | None:
+        # every sweep row carries its engine's SearchStats compact form
+        # (iters/nodes per history — the search-efficiency plane's cost
+        # record, qsm_tpu/search); None only for engines exposing none
+        st = collect_search_stats(backend)
+        return st.to_compact() if st is not None else None
+
     def host_cell(backend, spec, corpus):
         times, verds = [], []
         t0 = time.perf_counter()
@@ -309,6 +318,7 @@ def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
             "total_s": round(time.perf_counter() - t0, 2),
             "solved": (len(times) == len(corpus) and und == 0
                        and p90 <= box_s),
+            "search": _cell_search(backend),
         }
 
     def device_cell(make_backend, spec, corpus):
@@ -334,6 +344,7 @@ def run_sweep(on_tpu: bool, buckets=None, n_sample=None,
             "batch_first_s": round(first, 2),
             "per_history_s": round(warm / len(corpus), 4),
             "solved": und == 0 and warm <= box_s,
+            "search": _cell_search(b),
         }
 
     # queue has no scalar step table; on the host-CPU fallback the lockstep
@@ -526,6 +537,16 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
     cache_after = compile_cache_entries()
     backend.lockstep_cost = 0   # count only the timed passes below
     backend.rounds_run = 0
+    # search-accounting counters likewise restart at the timed passes so
+    # the headline's SearchStats describe the measured configuration, not
+    # the warmup (qsm_tpu/search/stats.py) — including rescued/deferred,
+    # which search_stats() reports alongside the counters above
+    backend.device_histories = 0
+    backend.memo_prunes = 0
+    backend.memo_inserts = 0
+    backend.compactions = 0
+    backend.rescued = 0
+    backend.deferred_out_of_domain = 0
     if profile_dir:
         import jax
 
@@ -669,6 +690,14 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
             "rescued": backend.rescued,
             "lockstep_iters": backend.lockstep_cost // sc["reps"],  # per pass
             "chunk_rounds": backend.rounds_run // sc["reps"],
+            # the search-efficiency plane's cost record (qsm_tpu/search):
+            # device iters/history next to BOTH host oracles' nodes/history
+            # — the decomposition of vs_best_host the round is judged on
+            "search_device": backend.search_stats().to_compact(),
+            "search_memo_nph": round(
+                memo.search_stats().nodes_per_history, 1),
+            "search_oracle_nph": round(
+                oracle.search_stats().nodes_per_history, 1),
             # measured once on the CPU-scale corpus (256 lanes, seed_base
             # 1000) with the round-2 rescue-ladder driver; only comparable
             # to the CPU-fallback run of THIS corpus, so omitted elsewhere
@@ -750,6 +779,21 @@ def main(argv=None) -> int:
             ex["window_captured_iso"] = window.pop("captured_iso", None)
             ex["tpu_probe_at_bench_time"] = probe_detail
             ex["probe_attempts"] = _probe_attempts_summary()
+            # the cached line predates bench time, but the frozen host
+            # denominators are per-round constants — compute the frozen
+            # ratio family here so a window-seized headline ALWAYS carries
+            # both families, not only the live ones it was captured with
+            frozen = _frozen_host_rates()
+            if frozen and window.get("value"):
+                f_naive = frozen["cpu_oracle_rate"]
+                f_best = max(frozen.get("cpu_memo_oracle_rate") or 0.0,
+                             frozen.get("cpp_oracle_rate") or 0.0)
+                ex.setdefault("vs_baseline_frozen",
+                              round(window["value"] / f_naive, 2))
+                if f_best:
+                    ex.setdefault("vs_best_host_frozen",
+                                  round(window["value"] / f_best, 2))
+                ex.setdefault("frozen_denominator_file", FROZEN_HOST_FILE)
             print(_slim_line(window))
             return 0
         force_cpu_platform()
@@ -800,7 +844,10 @@ def _slim_line(result: dict) -> str:
                  "chunk_schedule", "lockstep_iters_r2_ladder",
                  "cache_entries_before", "cache_entries_after",
                  "cpu_oracle_median_s", "corpus_gen_sec",
-                 "frozen_denominator_file")
+                 "frozen_denominator_file",
+                 # search stats drop LAST among extras: iph/nph are the
+                 # decomposition the round is judged on
+                 "search_oracle_nph", "search_memo_nph", "search_device")
     ex = result.get("extras", {})
     for key in droppable:
         if len(line) <= MAX_LINE:

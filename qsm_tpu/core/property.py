@@ -64,6 +64,12 @@ class PropertyConfig:
     # trial_batch=64 vs 75.5 at 1; VERDICT.md round 4, "Next round" #7).
     # Ramping bounds the waste to < the trials already run while keeping
     # the steady-state (no-violation) batch at full width.
+    # DEVICE-ONLY KNOB: leave at 1 for every host backend.  The BENCH_E2E
+    # evidence through r05 shows grouping only ever paying on a real
+    # accelerator's per-call dispatch; on host backends (and the CPU
+    # fallback) the wider padded batch measures strictly SLOWER
+    # (BENCH_E2E_r03/r04), so 1 stays the default until on-chip e2e rows
+    # settle a better value.
     trial_batch: int = 1
     # message transport for the scheduler plane: "memory" (default) or
     # "tcp" (real loopback sockets, sched/transport.py).  Histories are
@@ -292,9 +298,21 @@ def prop_concurrent(
 
             executor = PoolExecutor(sut_factory, cfg.executor_workers,
                                     transport=cfg.transport)
-        return _prop_concurrent_body(
+        # search-cost accounting rides the timings dict (flat str → float
+        # by contract): iterations-per-history and host nodes from
+        # whichever engines this run actually used (search/stats.py).
+        # Engines count cumulatively per instance, so snapshot before and
+        # report the delta: timings entries are per-run by contract.
+        from ..search.stats import collect_search_stats, stats_delta
+
+        st0 = collect_search_stats(backend)
+        res = _prop_concurrent_body(
             spec, sut, cfg, backend, oracle, transport, executor,
             timings, _bump)
+        st = stats_delta(collect_search_stats(backend), st0)
+        if st is not None:
+            res.timings.update(st.to_timings())
+        return res
     finally:
         if transport is not None:
             transport.close()

@@ -212,3 +212,52 @@ def compile_step_table(spec: Spec, n_states: int):
                     trans[s, c, a, r] = ns[0]
                     ok[s, c, a, r] = good
     return trans, ok
+
+
+# Selectivity probing caps at this many states: the table is a search
+# HEURISTIC (candidate try-order — qsm_tpu/search/ordering.py), never a
+# soundness input, so a deterministic stride sample of a huge packed
+# domain (stack/queue shadows reach 10⁴–10⁵ states) estimates the same
+# ranks at a bounded compile cost.
+MAX_SELECTIVITY_PROBE_STATES = 512
+
+
+def compile_selectivity_table(
+    spec: Spec, n_states: int,
+    max_probe_states: int = MAX_SELECTIVITY_PROBE_STATES,
+) -> np.ndarray:
+    """Postcondition selectivity per (cmd, arg, resp): the fraction of
+    scalar states in ``[0, n_states)`` whose ``step_py`` accepts the op.
+
+    Compiled alongside :func:`compile_step_table` (same scalar-domain
+    contract, same ``step_py`` source of truth) and consumed by the
+    search plane's candidate ordering: low selectivity = the op's
+    postcondition holds almost nowhere = trying it first either prunes
+    hardest or exposes the dead branch at depth 1.  Domains larger than
+    ``max_probe_states`` are stride-sampled deterministically — the
+    result is a rank estimate, which is all ordering needs (verdicts
+    never depend on it).
+    """
+    assert spec.STATE_DIM == 1, \
+        "selectivity tables only for scalar-state specs"
+    if n_states <= max_probe_states:
+        # the canonical tabulation IS the selectivity source: one loop to
+        # maintain, and the ordering heuristic can never disagree with
+        # the ok-table the kernels' gather path is built from
+        _, ok = compile_step_table(spec, n_states)
+        return ok.mean(axis=0, dtype=np.float64)
+    max_args = max(c.n_args for c in spec.CMDS)
+    max_resps = spec.max_resps
+    stride = -(-n_states // max_probe_states)
+    states = range(0, n_states, stride)
+    sel = np.zeros((spec.n_cmds, max_args, max_resps), np.float64)
+    n_probed = 0
+    for s in states:
+        n_probed += 1
+        for c, sig in enumerate(spec.CMDS):
+            for a in range(sig.n_args):
+                for r in range(sig.n_resps):
+                    _, good = spec.step_py([s], c, a, r)
+                    if good:
+                        sel[c, a, r] += 1.0
+    return sel / max(n_probed, 1)

@@ -82,6 +82,19 @@ class HybridDevice:
             return self.device.check_witness(spec, history)
         return self.tail.check_witness(spec, history)
 
+    def search_stats(self):
+        """Device lockstep cost AND host tail nodes, side by side — the
+        honest composed form (search/stats.py): device iterations saved by
+        deferring stragglers are only savings when the tail's node count
+        is shown next to them."""
+        from ..search.stats import collect_search_stats
+
+        st = self.device.search_stats()
+        st.engine = self.name
+        st.tail_histories = self.tail_histories
+        st.absorb(collect_search_stats(self.tail))
+        return st
+
 
 def _default_tail(spec: Spec):
     from ..native import CppOracle, native_available

@@ -90,17 +90,20 @@ _BATCH_BUCKETS = (8, 64, 256, 1024, 4096, 16384, 65536,
                   262144)
 
 
-def _batch_bucket(b: int) -> int:
+def _batch_bucket(b: int, buckets: tuple = _BATCH_BUCKETS) -> int:
     """Smallest bucket holding ``b`` rows; callers split batches larger than
     ``JaxTPU.MAX_BATCH`` into chunks that size so the compile cache stays
     bounded.  The buckets above 4096 exist for the real chip, where the
     first banked window (BENCH_TPU_r04.json) showed per-trip latency, not
     lane width, dominating the lockstep loop — wider batches amortize it;
-    they are reachable only through an explicitly raised ``MAX_BATCH``."""
-    for s in _BATCH_BUCKETS:
+    they are reachable only through an explicitly raised ``MAX_BATCH``.
+    A :class:`~qsm_tpu.search.planner.SearchPlan` may substitute a finer
+    ladder (``JaxTPU.BATCH_BUCKETS``): on the CPU platform single-lane
+    buckets stop a straggler's exhaustion from paying padded width."""
+    for s in buckets:
         if b <= s:
             return s
-    return _BATCH_BUCKETS[-1]
+    return buckets[-1]
 
 
 def make_hash_slot(key_words: int, cache_slots: int):
@@ -235,6 +238,14 @@ def build_stepper(spec: Spec, n_ops: int, budget: int,
             "status": jnp.where(n_req == 0, SUCCESS,
                                 RUNNING).astype(jnp.int32),
             "iters": jnp.int32(0),
+            # search-accounting counters (qsm_tpu/search/stats.py): memo
+            # hits taken and configurations inserted.  Present even with
+            # the cache off (constant 0) so the carry layout — and the
+            # generic compaction gather over its leaves — is uniform
+            # across the slots=0 and slots>0 steppers a lane migrates
+            # between.
+            "prunes": jnp.int32(0),
+            "inserts": jnp.int32(0),
         }
         if use_cache:
             carry["keys"] = jnp.zeros((cache_slots, key_words), jnp.uint32)
@@ -301,6 +312,7 @@ def build_stepper(spec: Spec, n_ops: int, budget: int,
             j = jnp.argmax(cand).astype(jnp.int32)
             child_state = nxt[j].astype(jnp.int32)
             success = has & (d + 1 == n_req)
+            exhausted = ~has
 
             if use_cache:
                 # child configuration already proven failed? prune: keep
@@ -347,6 +359,11 @@ def build_stepper(spec: Spec, n_ops: int, budget: int,
                 "states": jnp.where(descend, states_desc, states),
                 "status": status.astype(jnp.int32),
                 "iters": iters,
+                # per-lane search accounting (read back by the driver when
+                # the lane decides — SearchStats.memo_prunes/inserts)
+                "prunes": c["prunes"] + prune.astype(jnp.int32),
+                "inserts": (c["inserts"] + exhausted.astype(jnp.int32)
+                            if use_cache else c["inserts"]),
             }
             if use_cache:
                 # exhausted (no candidates left): this configuration is
@@ -468,8 +485,18 @@ class JaxTPU:
     # crashes the worker.  Model it as a per-batch-bucket slot cap: the two
     # verified points stand as-is; unverified buckets are capped so that
     # batch*slots <= 1<<17, the largest product seen safe at batch >= 256.
+    # A SearchPlan overrides this per instance: on the CPU platform there
+    # is no crash region, so starving a wide batch at 32 slots (the
+    # round-5 iters-per-history multiplier) is pure waste there.
     MAX_SLOTS_FOR_BATCH = {8: 8192, 64: 4096, 256: 512, 1024: 128, 4096: 32,
                            16384: 8, 65536: 2, 262144: 0}
+    # Lane-compaction bucket ladder.  A SearchPlan substitutes a finer
+    # ladder per instance (single-lane buckets on the CPU platform);
+    # survivors' memo entries re-hash into the larger table at EVERY
+    # bucket change (_compact_carry), which is what makes the planner's
+    # small-first-chunk schedule an early-compaction policy: the starved
+    # widest-bucket stage ends at the first compaction, not the last.
+    BATCH_BUCKETS = _BATCH_BUCKETS
     # Micro-steps per while-loop trip (build_stepper unroll).  None =
     # auto: 8 on a real device backend, 1 on the CPU platform.  Per-TRIP
     # overhead dominates the loop on both the axon tunnel (~5 ms/trip,
@@ -505,8 +532,32 @@ class JaxTPU:
                  rescue_slots: int = 4096,
                  mid_budget: int = 50_000,
                  mid_slots: int = 512,
-                 cache_write: str = "onehot"):
+                 cache_write: str = "onehot",
+                 plan=None,
+                 ordering: Optional[bool] = None):
         self.spec = spec
+        # A SearchPlan (qsm_tpu/search/planner.py) replaces the hand-tuned
+        # class tuples PER INSTANCE — chunk schedule, bucket ladder, memo
+        # slot policy, unroll — and switches the two search modes.  None
+        # keeps the round-3..5 hand tuning exactly (every existing caller).
+        self.plan = plan
+        if plan is not None:
+            self.CHUNK_SCHEDULE = tuple(plan.chunk_schedule)
+            self.BATCH_BUCKETS = tuple(plan.batch_buckets)
+            self.MAX_SLOTS_FOR_BATCH = dict(plan.slots_for_batch)
+            if plan.unroll is not None:
+                self.UNROLL = plan.unroll
+        # Postcondition-aware candidate ordering (search/ordering.py):
+        # host-side op permutation, applied per history in _run_device and
+        # inverted on witness read-back.  None = from the plan; False
+        # without one (the canonical order, as every prior round ran).
+        if ordering is None:
+            ordering = bool(plan.ordering) if plan is not None else False
+        self._ordering_table = None
+        if ordering:
+            from ..search.ordering import ordering_table
+
+            self._ordering_table = ordering_table(spec)
         self.budget = budget
         self.max_expansions = max_expansions
         self.sharding = sharding  # optional NamedSharding for the batch axis
@@ -544,6 +595,8 @@ class JaxTPU:
         self.rescued = 0
         self.rounds_run = 0
         self.compactions = 0   # batch-shrink / cache-growth events
+        self.memo_prunes = 0   # in-kernel memo hits (subtrees skipped)
+        self.memo_inserts = 0  # configurations proven non-linearizable
         # Σ (while-loop trip count × padded batch) over all chunk calls:
         # the honest lockstep cost of a batch (what every lane PAYS, not
         # what it needed) — the round-3 iteration-efficiency metric.
@@ -558,6 +611,28 @@ class JaxTPU:
         self.speculated_chunks = 0
         self.wasted_chunks = 0
         self.host_sync_s = 0.0  # time blocked fetching chunk status
+
+    def search_stats(self):
+        """Cumulative :class:`~qsm_tpu.search.stats.SearchStats` — the
+        engine's half of the iterations-per-history story.  ``histories``
+        counts device LANES (post pending-expansion), which equals input
+        histories on pending-free corpora; ``lockstep_iters`` is the
+        honest trips × padded-width cost every lane pays."""
+        from ..search.stats import SearchStats
+
+        return SearchStats(
+            engine=self.name,
+            histories=self.device_histories,
+            lockstep_iters=self.lockstep_cost,
+            memo_prunes=self.memo_prunes,
+            memo_inserts=self.memo_inserts,
+            compactions=self.compactions,
+            chunk_rounds=self.rounds_run,
+            rescued=self.rescued,
+            deferred=self.deferred_out_of_domain,
+            ordering=self._ordering_table is not None,
+            plan=self.plan.name if self.plan is not None else "",
+        )
 
     def _double_buffer_on(self) -> bool:
         if self.DOUBLE_BUFFER is not None:
@@ -831,7 +906,7 @@ class JaxTPU:
         """Statuses for a flat batch; with ``collect_chosen`` also the
         final ``chosen`` stack per lane (the linearization witness for
         SUCCESS lanes — :meth:`check_witness`)."""
-        top = min(self.MAX_BATCH, _BATCH_BUCKETS[-1])
+        top = min(self.MAX_BATCH, self.BATCH_BUCKETS[-1])
         if len(flat) > top:
             parts = [
                 self._run_device(
@@ -848,6 +923,20 @@ class JaxTPU:
                 return (np.concatenate([p[0] for p in parts]),
                         np.concatenate(padded))
             return np.concatenate(parts)
+
+        # Postcondition-aware try order: permute each history's op array by
+        # selectivity rank BEFORE encoding, so the kernel's argmax tries
+        # the most constrained candidates first with zero per-iteration
+        # cost.  Linearizability is permutation-invariant (the precedence
+        # order rides the ops' own timestamps — search/ordering.py), so
+        # only iteration counts change; witness indices are mapped back
+        # through the permutation below.
+        perms = None
+        if self._ordering_table is not None:
+            from ..search.ordering import permute_history
+
+            perms = [self._ordering_table.permutation(h) for h in flat]
+            flat = [permute_history(h, p) for h, p in zip(flat, perms)]
 
         n_ops = bucket_for(max(len(h) for h in flat) or 1)
         enc = encode_batch(flat, self.kspec.initial_state(), max_ops=n_ops)
@@ -882,7 +971,7 @@ class JaxTPU:
         pending = None  # speculatively-dispatched NEXT chunk's carry
 
         while active.size:
-            bucket = _batch_bucket(active.size)
+            bucket = _batch_bucket(active.size, self.BATCH_BUCKETS)
             slots = self._slots_for(bucket)
             sched_i = min(round_i, last_sched)
             chunk = self.CHUNK_SCHEDULE[sched_i]
@@ -949,6 +1038,12 @@ class JaxTPU:
                 decided = lane_status[done] != BUDGET
                 self.rescued += int(np.sum(
                     decided & (iters[lanes][done] > self.budget)))
+                # per-lane counters are cumulative in the carry; harvest a
+                # lane's totals exactly once, the round it decides
+                self.memo_prunes += int(
+                    np.asarray(carry["prunes"])[lanes[done]].sum())
+                self.memo_inserts += int(
+                    np.asarray(carry["inserts"])[lanes[done]].sum())
             still = ~done
             active = active[still]
             lanes = lanes[still]
@@ -958,6 +1053,14 @@ class JaxTPU:
             self.wasted_chunks += 1  # batch finished under the gamble
         self.device_histories += b
         if collect_chosen:
+            if perms is not None:
+                # chosen indexes the PERMUTED op array; callers (witness
+                # read-back) speak original indices: permuted[k] =
+                # ops[perm[k]], so chosen value v maps to perm[v]
+                for i, p in enumerate(perms):
+                    row = out_chosen[i]
+                    m = row >= 0
+                    row[m] = p[row[m]]
             return out_status, out_chosen
         return out_status
 

@@ -398,6 +398,17 @@ class PallasTPU(JaxTPU):
                         np.concatenate(padded))
             return np.concatenate(parts)
 
+        # Postcondition-aware try order, same host-side permutation as the
+        # XLA driver (search/ordering.py): the in-kernel `_min0` candidate
+        # pick then tries the most constrained ops first.  Witness indices
+        # are mapped back through the permutation below.
+        perms = None
+        if self._ordering_table is not None:
+            from ..search.ordering import permute_history
+
+            perms = [self._ordering_table.permutation(h) for h in flat]
+            flat = [permute_history(h, p) for h, p in zip(flat, perms)]
+
         n_ops = bucket_for(max(len(h) for h in flat) or 1)
         if n_ops > MAX_PALLAS_OPS:
             raise ValueError(
@@ -475,5 +486,10 @@ class PallasTPU(JaxTPU):
         self.batches_run += 1
         if collect_chosen:
             chosen_h = np.asarray(carry[1]).T[:b]
+            if perms is not None:
+                for i, p in enumerate(perms):
+                    row = chosen_h[i]
+                    m = (row >= 0) & (row < len(p))
+                    row[m] = p[row[m]]
             return status_h, chosen_h
         return status_h

@@ -183,10 +183,13 @@ class SegDC:
         self.segments_total = 0    # segments across them
         self.final_states_device = 0  # (segment × state) lanes sent to device
         self.segments_native = 0   # middle segments enumerated natively
+        self.histories_seen = 0    # inputs (whole + split)
+        self.middle_nodes = 0      # host nodes spent enumerating middles
 
     def check_histories(self, spec: Spec, histories: Sequence[History]
                         ) -> np.ndarray:
         assert spec is self.spec, "SegDC is bound to one spec"
+        self.histories_seen += len(histories)
         out = np.empty(len(histories), np.int8)
         whole: List[int] = []   # indices delegated to the inner backend
         # (index, final-segment history, sorted frontier states) triples of
@@ -224,6 +227,7 @@ class SegDC:
                     verdict = Verdict.VIOLATION
                     break
                 frontier = nxt
+            self.middle_nodes += self.node_budget - budget.left
             if verdict is not None:
                 out[i] = int(verdict)
                 continue
@@ -243,6 +247,22 @@ class SegDC:
             for i, v in zip(whole, sub):
                 out[i] = v
         return out
+
+    def search_stats(self):
+        """Segment accounting plus the inner engine's own counters — a
+        decomposition's cost is the middles' host nodes AND whatever the
+        inner backend paid on finals/uncut wholes (search/stats.py)."""
+        from ..search.stats import SearchStats, collect_search_stats
+
+        st = SearchStats(
+            engine=self.name,
+            histories=self.histories_seen,
+            nodes_explored=self.middle_nodes,
+            segments_split=self.segments_split,
+            segments_total=self.segments_total,
+        )
+        st.absorb(collect_search_stats(self.inner))
+        return st
 
     def _resolve_finals_device(self, spec: Spec, finals, out) -> None:
         """ONE batched inner-backend call deciding every (final segment ×

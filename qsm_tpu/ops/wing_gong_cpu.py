@@ -39,10 +39,19 @@ class WingGongCPU:
     name = "wing_gong_cpu"
 
     def __init__(self, node_budget: int = _DEFAULT_NODE_BUDGET,
-                 memo: bool = False):
+                 memo: bool = False, ordering: bool = False):
         self.node_budget = node_budget
         self.memo = memo
+        # Postcondition-aware candidate try order (search/ordering.py):
+        # rank ops by selectivity so branches that must fail their
+        # postcondition die at depth 1.  Verdicts are invariant under try
+        # order (the DFS explores the same tree, differently); only
+        # nodes_explored changes.  Off by default — the canonical index
+        # order is the parity reference every kernel is pinned against.
+        self.ordering = ordering
+        self._ordering_tables: dict = {}  # (name, kwargs) -> OrderingTable|None
         self.nodes_explored = 0  # cumulative, for stats/benchmarks
+        self.histories_checked = 0
 
     # ------------------------------------------------------------------
     def check_histories(
@@ -76,10 +85,40 @@ class WingGongCPU:
                    if v == Verdict.LINEARIZABLE else None)
 
     # ------------------------------------------------------------------
+    def search_stats(self):
+        """Host-search cost record (search/stats.py): oracle node count
+        per history is the denominator the device's iters-per-history is
+        judged against."""
+        from ..search.stats import SearchStats
+
+        return SearchStats(
+            engine=self.name + ("_memo" if self.memo else ""),
+            histories=self.histories_checked,
+            nodes_explored=self.nodes_explored,
+            ordering=self.ordering,
+        )
+
+    def _try_order(self, spec: Spec, history: History) -> Sequence[int]:
+        if not self.ordering:
+            return range(len(history.ops))
+        # cache key includes the constructor kwargs: two
+        # differently-parameterized specs sharing a name (CasSpec
+        # n_values=2 vs 8) must not reuse each other's table
+        key = (spec.name, repr(spec.spec_kwargs()))
+        if key not in self._ordering_tables:
+            from ..search.ordering import ordering_table
+
+            self._ordering_tables[key] = ordering_table(spec)
+        from ..search.ordering import order_indices
+
+        return order_indices(self._ordering_tables[key], history)
+
+    # ------------------------------------------------------------------
     def _check(self, spec: Spec, history: History,
                init_state=None, witness_out=None) -> Verdict:
         ops = history.ops
         n = len(ops)
+        self.histories_checked += 1
         if n == 0:
             return Verdict.LINEARIZABLE
         prec = history.precedes_matrix()
@@ -95,6 +134,7 @@ class WingGongCPU:
         taken = [False] * n
         budget = [self.node_budget]
         seen = set() if self.memo else None
+        order = self._try_order(spec, history)
 
         def eligible(j: int) -> bool:
             if taken[j]:
@@ -114,7 +154,7 @@ class WingGongCPU:
                 if key in seen:
                     return Verdict.VIOLATION
             saw_budget = False
-            for j in range(n):
+            for j in order:
                 if not eligible(j):
                     continue
                 op = ops[j]

@@ -1,0 +1,48 @@
+"""qsm_tpu.search — the search-efficiency plane.
+
+The checker stack has two cost axes.  The throughput axis (ops/, the
+kernels) decides how fast one lockstep ITERATION runs; this package owns
+the other axis — how many iterations a verdict NEEDS.  The round-4/5
+windows measured the gap: the banked device headline paid ~182k lockstep
+iterations per history while the memoised host oracle decided the same
+corpus exploring ~10²–10³ nodes.  That multiplier is search order,
+memoisation coverage, and decomposition — hardware-independent, and
+measurable on the CPU platform with the engines' existing counters.
+
+Three modules:
+
+* :mod:`~qsm_tpu.search.stats`    — ``SearchStats``, the first-class cost
+  record every engine exposes (``search_stats()``) and every bench row
+  carries;
+* :mod:`~qsm_tpu.search.ordering` — postcondition-aware candidate
+  ordering: per-spec selectivity tables (compiled next to the step
+  tables, core/spec.py) ranking ops so branches that must fail their
+  postcondition die at depth 1;
+* :mod:`~qsm_tpu.search.planner`  — ``SearchPlan`` + ``plan_search``:
+  chunk schedule, batch buckets, memo-slot policy, ordering and
+  decomposition modes picked from corpus statistics and platform,
+  replacing the hand-tuned tuples in ops/jax_kernel.py.
+
+Verdict contract: nothing in this package may change a verdict — only
+iteration/node counts.  tests/test_search.py pins bit-identical verdicts
+across every engine with the plan on and off, and pins the ≥10×
+iters-per-history win on the CAS-32 bench corpus.
+"""
+
+from .ordering import OrderingTable, ordering_table, permute_history
+from .planner import (CorpusProfile, SearchPlan, build_backend, plan_search,
+                      profile_corpus)
+from .stats import SearchStats, collect_search_stats
+
+__all__ = [
+    "CorpusProfile",
+    "OrderingTable",
+    "SearchPlan",
+    "SearchStats",
+    "build_backend",
+    "collect_search_stats",
+    "ordering_table",
+    "permute_history",
+    "plan_search",
+    "profile_corpus",
+]

@@ -1,0 +1,117 @@
+"""Postcondition-aware candidate ordering.
+
+Wing–Gong tries candidate ops in a canonical order (array index, both in
+the oracle's ``for j in range(n)`` and the kernel's ``argmax`` over the
+candidate mask).  That order is blind: a branch that linearises an
+unconstrained op (a write — its postcondition holds in every state) ahead
+of a constrained one (a read of a specific value) discovers the conflict
+only deep in the subtree, after paying its whole expansion.  Ranking the
+constrained ops FIRST makes branches that must fail their postcondition
+die at depth 1: either the constrained op is linearisable now (taking it
+prunes the state space most) or it is not, and the contradiction surfaces
+before the subtree is paid for.
+
+The rank is the op's **selectivity**: the fraction of model states in
+which its ``step`` postcondition holds, computed from the same scalar
+step tabulation the kernel's gather path uses
+(``core.spec.compile_selectivity_table``, compiled alongside
+``compile_step_table``).  For CAS: ``read(v)`` and a succeeding ``cas``
+pass in 1/n_values states (rank ~0.2 — first), a failing ``cas`` in
+(n-1)/n, a ``write`` always (rank 1.0 — last).  Vector specs rank
+through their scalarized shadow when one exists; specs with no scalar
+domain get no table and keep the canonical order.
+
+Consumption is HOST-SIDE permutation: ops are reordered before encoding,
+so the kernel's argmax and the oracle's index loop both try candidates in
+rank order with zero per-iteration cost.  Linearizability is invariant
+under op-array permutation (the precedence partial order rides the ops'
+own timestamps), so verdicts cannot change — only iteration counts do;
+tests/test_search.py pins both claims.  Witness indices are mapped back
+through the permutation by the caller (ops/jax_kernel.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.history import History
+from ..core.spec import Spec, compile_selectivity_table
+
+
+class OrderingTable:
+    """Per-spec selectivity ranks over (cmd, arg, resp)."""
+
+    def __init__(self, sel: np.ndarray, spec_name: str):
+        self.sel = sel  # float64[n_cmds, max_args, max_resps] in [0, 1]
+        self.spec_name = spec_name
+
+    def rank(self, cmd: int, arg: int, resp: int) -> float:
+        """Selectivity of one op; lower = more constrained = tried first.
+
+        Out-of-domain cmd/arg/resp (SUTs can return anything) rank 0.0:
+        such an op's postcondition holds in no tabulated state, so it is
+        maximally constrained — fronting it surfaces the contradiction
+        immediately.
+        """
+        c, a, r = self.sel.shape
+        if not (0 <= cmd < c and 0 <= arg < a):
+            return 0.0
+        if resp < 0:  # pending: completion may pick any response
+            return float(self.sel[cmd, arg].mean())
+        if resp >= r:
+            return 0.0
+        return float(self.sel[cmd, arg, resp])
+
+    def permutation(self, history: History) -> np.ndarray:
+        """Stable try-order permutation: ``permuted_ops[k] =
+        ops[perm[k]]``.  Ties keep invocation order (the ops list's own
+        order), so the permutation — and with it every downstream
+        iteration count — is deterministic."""
+        ops = history.ops
+        order = sorted(range(len(ops)),
+                       key=lambda j: (self.rank(ops[j].cmd, ops[j].arg,
+                                               ops[j].resp), j))
+        return np.asarray(order, np.intp)
+
+
+def permute_history(history: History, perm: Sequence[int]) -> History:
+    """Reorder a history's op array (timestamps — and therefore the
+    precedence partial order — ride along untouched)."""
+    return History([history.ops[j] for j in perm],
+                   seed=history.seed, program_id=history.program_id)
+
+
+def ordering_table(spec: Spec) -> Optional[OrderingTable]:
+    """The spec's selectivity table, or None when it has no scalar domain
+    to tabulate (ordering then stays off — the canonical order is kept).
+
+    Vector specs with declared element bounds rank through their
+    scalarized shadow (ops/scalarize.py): same CMDS, same step semantics,
+    scalar domain.
+    """
+    target = spec
+    if spec.STATE_DIM != 1:
+        from ..ops.scalarize import scalar_shadow
+
+        target = scalar_shadow(spec)
+        if target is None:
+            return None
+    # 128 is the largest op bucket (core/history.py OP_BUCKETS); specs
+    # whose bound grows with history length (ticket) are covered to there
+    bound = target.scalar_state_bound(128)
+    if bound is None or bound <= 0:
+        return None
+    sel = compile_selectivity_table(target, int(bound))
+    return OrderingTable(sel, spec.name)
+
+
+def order_indices(table: Optional[OrderingTable],
+                  history: History) -> List[int]:
+    """Try order for a host-side DFS over ``history.ops`` — identity when
+    no table applies.  (The oracle consumes ranks this way; the kernel
+    permutes the encoded arrays instead.)"""
+    if table is None:
+        return list(range(len(history.ops)))
+    return [int(j) for j in table.permutation(history)]

@@ -1,0 +1,215 @@
+"""The search planner — corpus statistics in, ``SearchPlan`` out.
+
+The chunked device driver (ops/jax_kernel.py) has been steered by
+hand-tuned class constants since round 3: ``CHUNK_SCHEDULE``,
+``MAX_SLOTS_FOR_BATCH``, the module-level batch buckets.  Those tuples
+encode one trade (few compiles, wide lockstep batches) that the round-5
+window priced precisely: ~182k lockstep iterations per history while the
+host oracle explored ~10²–10³ nodes — cache starvation at 4096 lanes ×
+32 memo slots plus lockstep spin in coarse chunks, not step throughput.
+
+``plan_search`` replaces the hand tuning with a policy computed from
+what is actually known:
+
+* **platform** — the empirical (batch × cache-slots) safe region is a
+  property of the axon TPU stack, NOT of the algorithm; on the CPU
+  platform there is no crash region, so the plan grants every bucket the
+  full-size memo table and fine-grained buckets down to single-lane
+  (measured on the CAS-32 bench corpus: starved 32-slot tables cost
+  17.9k iters/history where 4096-slot tables cost 0.8k — the whole
+  starvation story reproduced off-chip).
+* **corpus statistics** (``profile_corpus``) — mean quiescent-cut
+  density decides decomposition (wrap the kernel in the segdc
+  combinator: exhaustion cost is exponential in segment length, so
+  histories that cut should never be searched whole); history length
+  sets the first chunk (a shorter first chunk than the minimum depth of
+  a success path can decide nothing).
+* **spec** — ordering mode is on exactly when the spec has a scalar
+  domain to rank against (search/ordering.py).
+
+The early-compaction policy for the device platform is carried by the
+schedule itself: the first chunk is SMALL (256), so the starved
+widest-bucket stage ends within one chunk and survivors re-hash into the
+large-cache buckets at the FIRST compaction — the round-5 window ran
+(2048, 65536) and paid the 32-slot stage for 2048 iterations straight.
+
+Verdict contract: a plan changes iteration counts only.  Budgets are not
+part of the plan; the driver's honest BUDGET_EXCEEDED/oracle-resolution
+semantics are untouched (tests/test_search.py pins verdict parity with
+planning on and off across every engine).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+from ..core.history import History
+from .ordering import ordering_table
+
+# CPU platform: no crash region — fine buckets to single-lane (a straggler
+# exhausting a violation tree pays bucket-width per iteration; at bucket 8
+# the round-5 tail was 8× the work it needed) and full-size memo tables
+# everywhere.  Wall-clock cost of the extra compiles is real but paid once
+# per process; tests/bench warm explicitly.
+_CPU_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
+                16384, 65536, 262144)
+_CPU_SLOTS = 4096
+# ×2 geometric escalation from just past the 32-op success-path depth:
+# measured on CAS-32 (128 histories, CPU platform) against the hand-tuned
+# (256, 2048, 16384, 65536): 1839 → 440 iters/history kernel-only, 143
+# with ordering + decomposition (tools/bench_search.py artifact).
+_CPU_SCHEDULE = (48, 96, 192, 384, 768, 1536, 3072, 6144, 12288, 24576,
+                 49152)
+
+# Device platform: the verified safe region stands exactly as measured
+# (ops/jax_kernel.py MAX_SLOTS_FOR_BATCH provenance); the plan's lever is
+# the schedule — a small first chunk ends the starved wide stage early.
+_TPU_BUCKETS = (8, 64, 256, 1024, 4096, 16384, 65536, 262144)
+_TPU_SLOTS = {8: 8192, 64: 4096, 256: 512, 1024: 128, 4096: 32,
+              16384: 8, 65536: 2, 262144: 0}
+_TPU_SCHEDULE = (256, 2048, 16384, 65536)
+
+# Decomposition gate: below this mean-segments-per-history the cut scan
+# is overhead on a corpus that mostly cannot cut.  1.15 ≈ "at least one
+# history in 7 cuts once"; the CAS-32 bench corpus profiles at ~1.69.
+_DECOMPOSE_MEAN_SEGMENTS = 1.15
+
+
+@dataclasses.dataclass(frozen=True)
+class CorpusProfile:
+    """What the planner is allowed to know about the workload."""
+
+    n: int = 0
+    max_ops: int = 0
+    mean_ops: float = 0.0
+    pending_fraction: float = 0.0
+    cut_fraction: float = 0.0    # histories with ≥1 quiescent cut
+    mean_segments: float = 1.0   # segments per history
+
+
+def profile_corpus(histories: Sequence[History]) -> CorpusProfile:
+    from ..ops.segdc import split_at_quiescent_cuts
+
+    if not histories:
+        return CorpusProfile()
+    lens = [len(h) for h in histories]
+    segs = [len(split_at_quiescent_cuts(h)) for h in histories]
+    return CorpusProfile(
+        n=len(histories),
+        max_ops=max(lens),
+        mean_ops=sum(lens) / len(histories),
+        pending_fraction=(sum(h.n_pending > 0 for h in histories)
+                          / len(histories)),
+        cut_fraction=sum(s > 1 for s in segs) / len(histories),
+        mean_segments=sum(segs) / len(histories),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchPlan:
+    """Everything the driver used to hard-code, plus the two search
+    modes, with provenance.  Consumed by ``JaxTPU(plan=…)`` and
+    ``build_backend``."""
+
+    name: str
+    chunk_schedule: Tuple[int, ...]
+    batch_buckets: Tuple[int, ...]
+    slots_for_batch: Dict[int, int]
+    ordering: bool          # host-side selectivity permutation
+    decompose: bool         # wrap the kernel in quiescent-cut segdc
+    unroll: Optional[int]   # None = the driver's platform auto
+    why: Tuple[str, ...] = ()
+
+    def describe(self) -> Dict:
+        return {
+            "name": self.name,
+            "chunk_schedule": list(self.chunk_schedule),
+            "buckets": len(self.batch_buckets),
+            "max_slots": max(self.slots_for_batch.values(), default=0),
+            "ordering": self.ordering,
+            "decompose": self.decompose,
+            "unroll": self.unroll,
+            "why": list(self.why),
+        }
+
+
+def plan_search(spec, profile: Optional[CorpusProfile] = None,
+                platform: Optional[str] = None) -> SearchPlan:
+    """Pick the search plan for ``spec`` on ``platform`` ("cpu"/"tpu"; None
+    = whatever jax's default backend reports) given optional corpus
+    statistics.  Pure policy — constructs no backend and touches no
+    device."""
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    on_device = platform not in ("cpu",)
+    why = []
+
+    orderable = ordering_table(spec) is not None
+    why.append(f"ordering={'on' if orderable else 'off'} "
+               f"({spec.name} {'has' if orderable else 'lacks'} a scalar "
+               f"selectivity domain)")
+
+    decompose = False
+    if profile is not None and profile.n:
+        decompose = profile.mean_segments >= _DECOMPOSE_MEAN_SEGMENTS
+        why.append(f"decompose={'on' if decompose else 'off'} "
+                   f"(mean {profile.mean_segments:.2f} segments/history "
+                   f"over {profile.n} histories)")
+    else:
+        why.append("decompose=off (no corpus profile)")
+
+    if on_device:
+        why.append("device platform: verified (batch × slots) safe region "
+                   "kept; small first chunk ends the starved wide stage "
+                   "at the first compaction")
+        return SearchPlan(
+            name="tpu-safe-v1",
+            chunk_schedule=_TPU_SCHEDULE,
+            batch_buckets=_TPU_BUCKETS,
+            slots_for_batch=dict(_TPU_SLOTS),
+            ordering=orderable,
+            decompose=decompose,
+            unroll=8,
+            why=tuple(why),
+        )
+    first = _CPU_SCHEDULE[0]
+    sched = _CPU_SCHEDULE
+    if profile is not None and profile.max_ops > first:
+        # a first chunk below the success-path depth decides nothing:
+        # shift the whole geometric ladder up to cover max_ops
+        while first < profile.max_ops:
+            first *= 2
+        sched = tuple(first * (1 << i) for i in range(len(_CPU_SCHEDULE)))
+        why.append(f"first chunk {first} covers max_ops "
+                   f"{profile.max_ops}")
+    why.append("cpu platform: no crash region — full-size memo tables, "
+               "fine buckets to single-lane")
+    return SearchPlan(
+        name="cpu-fine-v1",
+        chunk_schedule=sched,
+        batch_buckets=_CPU_BUCKETS,
+        slots_for_batch={b: _CPU_SLOTS for b in _CPU_BUCKETS},
+        ordering=orderable,
+        decompose=decompose,
+        unroll=None,
+        why=tuple(why),
+    )
+
+
+def build_backend(spec, plan: SearchPlan, budget: int = 2_000, **device_kw):
+    """The planned checker: a ``JaxTPU`` honoring ``plan``, wrapped in the
+    quiescent-cut segmentation combinator when the plan decomposes.
+    (Imports are local: the search plane must stay importable without
+    jax for the pure-policy callers — lint, docs, profiling.)"""
+    from ..ops.jax_kernel import JaxTPU
+
+    if not plan.decompose:
+        return JaxTPU(spec, budget=budget, plan=plan, **device_kw)
+    from ..ops.segdc import SegDC
+
+    return SegDC(spec,
+                 make_inner=lambda s: JaxTPU(s, budget=budget, plan=plan,
+                                             **device_kw))

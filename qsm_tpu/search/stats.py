@@ -1,0 +1,155 @@
+"""``SearchStats`` — search cost as a first-class artifact.
+
+Every checker engine already keeps counters (the oracle's
+``nodes_explored``, the device driver's ``lockstep_cost`` /
+``compactions`` / ``rescued``, SegDC's segment tallies).  This module
+gives them ONE record type and one collection entry point so the
+property layer, bench.py, the CLI ``stats`` subcommand, and the round
+artifacts all report the same fields — the iterations-per-history number
+the north-star's ``vs_best_host`` gap decomposes into is never again
+reconstructible only by hand from BENCH extras.
+
+Semantics of the two headline fields:
+
+* ``lockstep_iters`` — Σ (while-loop trips × padded batch width) over
+  every device chunk call: what every lane PAYS under lockstep, not what
+  it needed.  Host engines report 0.
+* ``nodes_explored`` — host-side search nodes: oracle step evaluations
+  plus SegDC middle-segment enumeration nodes.  Device engines report 0
+  here; a hybrid/segdc composition reports both, side by side, which is
+  exactly the honest form (device iterations saved by moving work to the
+  host are not savings unless the host nodes are shown too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class SearchStats:
+    """Cumulative search-cost counters of one engine (or composition)."""
+
+    engine: str = ""
+    histories: int = 0          # histories this engine decided work for
+    lockstep_iters: int = 0     # device lockstep cost (trips × width)
+    nodes_explored: int = 0     # host search nodes (oracle + middles)
+    memo_prunes: int = 0        # memo hits: subtrees skipped
+    memo_inserts: int = 0       # configurations proven non-linearizable
+    compactions: int = 0        # batch-shrink / cache-growth events
+    chunk_rounds: int = 0       # device chunk calls
+    rescued: int = 0            # lanes decided past the base budget
+    deferred: int = 0           # histories deferred to the oracle
+    tail_histories: int = 0     # hybrid: lanes the host tail decided
+    segments_split: int = 0     # segdc: histories that actually cut
+    segments_total: int = 0     # segdc: segments across them
+    ordering: bool = False      # postcondition-aware ordering active
+    plan: str = ""              # planner provenance ("" = hand-tuned)
+
+    # -- derived -----------------------------------------------------------
+    @property
+    def iters_per_history(self) -> float:
+        return self.lockstep_iters / self.histories if self.histories else 0.0
+
+    @property
+    def nodes_per_history(self) -> float:
+        return self.nodes_explored / self.histories if self.histories else 0.0
+
+    # -- composition -------------------------------------------------------
+    def absorb(self, other: Optional["SearchStats"],
+               count_histories: bool = False) -> "SearchStats":
+        """Fold a sub-engine's counters into this record (hybrid tails,
+        segdc inners).  ``count_histories`` is off by default: a wrapper
+        usually counts each input history once itself, and the inner's
+        per-lane count (expansions, frontier states) would double-book."""
+        if other is None:
+            return self
+        for f in ("lockstep_iters", "nodes_explored", "memo_prunes",
+                  "memo_inserts", "compactions", "chunk_rounds", "rescued",
+                  "deferred", "tail_histories", "segments_split",
+                  "segments_total"):
+            setattr(self, f, getattr(self, f) + getattr(other, f))
+        if count_histories:
+            self.histories += other.histories
+        self.ordering = self.ordering or other.ordering
+        if not self.plan:
+            self.plan = other.plan
+        return self
+
+    # -- rendering ---------------------------------------------------------
+    def to_dict(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d["iters_per_history"] = round(self.iters_per_history, 1)
+        d["nodes_per_history"] = round(self.nodes_per_history, 1)
+        return d
+
+    def to_compact(self) -> Dict:
+        """The small form bench lines embed (MAX_LINE discipline): the
+        two per-history headline numbers plus the counters that explain
+        them; everything else stays in the full ``stats`` CLI output."""
+        return {
+            "iph": round(self.iters_per_history, 1),
+            "nph": round(self.nodes_per_history, 1),
+            "prunes": self.memo_prunes,
+            "rescued": self.rescued,
+            "segs": self.segments_split,
+            "ord": int(self.ordering),
+            "plan": self.plan,
+        }
+
+    def to_timings(self) -> Dict[str, float]:
+        """Numeric projection for ``PropertyResult.timings`` (a flat
+        str → float mapping by contract)."""
+        return {
+            "search_iters_per_history": round(self.iters_per_history, 1),
+            "search_nodes_per_history": round(self.nodes_per_history, 1),
+            "search_memo_prunes": float(self.memo_prunes),
+            "search_rescued": float(self.rescued),
+            "search_histories": float(self.histories),
+        }
+
+
+_COUNTER_FIELDS = ("histories", "lockstep_iters", "nodes_explored",
+                   "memo_prunes", "memo_inserts", "compactions",
+                   "chunk_rounds", "rescued", "deferred", "tail_histories",
+                   "segments_split", "segments_total")
+
+
+def stats_delta(after: Optional[SearchStats],
+                before: Optional[SearchStats]) -> Optional[SearchStats]:
+    """``after - before`` over the counter fields: the cost of ONE run on
+    an engine whose instance counters are lifetime-cumulative.  The
+    property layer uses this so ``PropertyResult.timings`` stays per-run
+    like every other entry in that dict, even when the caller reuses a
+    backend object across property runs."""
+    if after is None:
+        return None
+    if before is None:
+        return after
+    d = dataclasses.replace(after)
+    for f in _COUNTER_FIELDS:
+        setattr(d, f, getattr(after, f) - getattr(before, f))
+    return d
+
+
+def collect_search_stats(backend) -> Optional[SearchStats]:
+    """``SearchStats`` for any backend, or None when it exposes none.
+
+    Engines own their accounting (``search_stats()``); this helper only
+    adds the generic fallback so callers (property layer, bench, CLI)
+    never need per-engine knowledge.  Unknown combinators are probed for
+    the conventional wrapper attributes (``inner`` / ``device`` /
+    ``plain``) so e.g. the per-history router still reports its kernels'
+    counters.
+    """
+    fn = getattr(backend, "search_stats", None)
+    if callable(fn):
+        return fn()
+    for attr in ("inner", "device", "plain"):
+        sub = getattr(backend, attr, None)
+        if sub is not None and callable(getattr(sub, "search_stats", None)):
+            st = sub.search_stats()
+            st.engine = f"{type(backend).__name__.lower()}({st.engine})"
+            return st
+    return None

@@ -3,8 +3,9 @@
 The reference's knobs are QuickCheck ``Args`` (maxSuccess, replay seed, size)
 (SURVEY.md §5 config): here that's a plain argparse CLI over the registry —
 ``run`` (property check), ``replay`` (reproduce a persisted failure),
-``bench`` (checker throughput), ``coverage`` (schedule diversity),
-``lint`` (the qsmlint static analyzer — docs/ANALYSIS.md).
+``bench`` (checker throughput), ``stats`` (search-cost accounting —
+qsm_tpu/search), ``coverage`` (schedule diversity), ``lint`` (the
+qsmlint static analyzer — docs/ANALYSIS.md).
 """
 
 from __future__ import annotations
@@ -252,9 +253,11 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
                         "(0 = serial; histories are bit-identical)")
     p.add_argument("--trial-batch", type=int, default=1,
                    help="trials decided per backend batch; verdicts are "
-                        "identical. Amortizes per-call dispatch on a real "
-                        "accelerator — on the CPU fallback the bigger "
-                        "padded batch measures SLOWER (BENCH_E2E_r03)")
+                        "identical. DEVICE-ONLY knob: amortizes per-call "
+                        "dispatch on a real accelerator — on host "
+                        "backends and the CPU fallback the bigger padded "
+                        "batch measures SLOWER (BENCH_E2E_r03..r05), so "
+                        "leave the default 1 there")
     _add_fault_args(p)
     p.add_argument("--log", default=None, help="JSONL log path")
     p.add_argument("--save-regression", default=None,
@@ -415,6 +418,59 @@ def cmd_bench(args) -> int:
         "histories": len(hists), "seconds": round(dt, 3),
         "histories_per_sec": round(len(hists) / dt, 1),
         "undecided": int((v == 2).sum())}))
+    return 0
+
+
+def cmd_stats(args) -> int:
+    """Search-cost accounting for one backend on one corpus: the
+    iterations-per-history / nodes-per-history decomposition of the
+    ``vs_best_host`` gap as ONE JSON document (search/stats.py).  Also
+    prints the corpus profile and the plan ``plan_search`` would pick for
+    it — ``--planned`` actually runs the planned backend (device
+    engines only; the planner's levers are the kernel driver's)."""
+    import numpy as np
+
+    from ..search import (collect_search_stats, plan_search, profile_corpus)
+    from .corpus import build_corpus
+
+    entry = MODELS[args.model]
+    spec = entry.make_spec()
+    hists = build_corpus(
+        spec, (entry.impls["atomic"], entry.impls["racy"]),
+        n=args.corpus, n_pids=args.pids or entry.default_pids,
+        max_ops=args.ops or entry.default_ops, seed_prefix="stats")
+    profile = profile_corpus(hists)
+    plan = plan_search(spec, profile,
+                       platform=None if args.planned else "cpu")
+    if args.planned:
+        # the planned checker (search/planner.py build_backend); same
+        # device-reachability contract as --backend tpu
+        _ensure_device_reachable()
+        from ..search.planner import build_backend
+
+        backend = build_backend(spec, plan)
+        bname = f"planned({plan.name})"
+    else:
+        backend = _make_backend(args.backend, spec)
+        bname = args.backend
+    t0 = time.perf_counter()
+    v = backend.check_histories(spec, hists)
+    dt = time.perf_counter() - t0
+    st = collect_search_stats(backend)
+    out = {
+        "model": args.model, "backend": bname,
+        "histories": len(hists), "seconds": round(dt, 3),
+        "undecided": int((np.asarray(v) == 2).sum()),
+        "profile": {
+            "max_ops": profile.max_ops,
+            "mean_ops": round(profile.mean_ops, 1),
+            "pending_fraction": round(profile.pending_fraction, 3),
+            "mean_segments": round(profile.mean_segments, 2),
+        },
+        "plan_for_corpus": plan.describe(),
+        "search_stats": st.to_dict() if st is not None else None,
+    }
+    print(json.dumps(out))
     return 0
 
 
@@ -957,6 +1013,22 @@ def main(argv=None) -> int:
     p.add_argument("--backends", default="memo,cpp,device",
                    help="comma list from {memo, cpp, device, segdc, auto, hybrid}")
     p.set_defaults(fn=cmd_fuzz)
+
+    p = sub.add_parser(
+        "stats",
+        help="search-cost accounting (SearchStats) for one backend on "
+             "one corpus: iters/nodes per history, memo prunes, "
+             "compactions, plus the corpus profile and planner verdict")
+    p.add_argument("--model", default="cas", choices=sorted(MODELS))
+    p.add_argument("--backend", default="cpu", choices=_BACKENDS)
+    p.add_argument("--planned", action="store_true",
+                   help="run the plan_search-built device checker instead "
+                        "of --backend (needs a reachable device, like "
+                        "--backend tpu)")
+    p.add_argument("--pids", type=int, default=None)
+    p.add_argument("--ops", type=int, default=None)
+    p.add_argument("--corpus", type=int, default=64)
+    p.set_defaults(fn=cmd_stats)
 
     p = sub.add_parser("coverage", help="schedule-coverage stats")
     p.add_argument("--model", required=True, choices=sorted(MODELS))

@@ -10,6 +10,8 @@ import json
 import os
 import sys
 
+import pytest
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
@@ -78,8 +80,18 @@ def test_main_uses_cached_window_when_probe_wedged(tmp_path, monkeypatch,
     assert ex["window_captured_iso"] == "2026-07-29T20:45:00+00:00"
     assert "wedged (test)" in ex["tpu_probe_at_bench_time"]
     assert out.get("captured_iso") is None  # moved into extras
+    # the cached line must carry the frozen ratio family too (BENCH_r06
+    # always has both families, live and frozen — ISSUE 2 satellite):
+    # denominators from the committed per-round BASELINE_HOST file
+    frozen = bench._frozen_host_rates()
+    assert frozen is not None, "committed frozen-denominator file missing"
+    assert ex["vs_baseline_frozen"] == round(12345.6
+                                             / frozen["cpu_oracle_rate"], 2)
+    assert "vs_best_host_frozen" in ex
+    assert ex["frozen_denominator_file"] == bench.FROZEN_HOST_FILE
 
 
+@pytest.mark.slow
 def test_force_cpu_ignores_window_artifact(tmp_path, monkeypatch, capsys):
     """--force-cpu explicitly asks for a live CPU measurement; the cached
     TPU line must not short-circuit it.  (Runs the real fallback bench at
@@ -100,6 +112,7 @@ def test_force_cpu_ignores_window_artifact(tmp_path, monkeypatch, capsys):
     assert out["extras"]["device_fallback"] == "cpu"
 
 
+@pytest.mark.slow
 def test_run_sweep_structure_fast():
     """The sweep path (default bench run) at miniature scale: structure,
     solved table, and the honest cpp coverage cap."""

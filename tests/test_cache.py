@@ -2,6 +2,8 @@
 counts collapse on violating histories; hash regression for the high-bit
 collision bug (FNV-1a over words degenerates — murmur-style mixer required)."""
 
+import pytest
+
 import jax
 import numpy as np
 
@@ -43,6 +45,7 @@ def test_cache_collapses_iterations_same_verdict():
     assert it_cache * 10 < it_plain
 
 
+@pytest.mark.slow
 def test_cache_verdicts_match_plain_on_easy_corpus():
     corpus = build_corpus(SPEC, (AtomicCasSUT, RacyCasSUT), n=24, n_pids=4,
                           max_ops=12, seed_base=7, seed_prefix="cc")
@@ -87,6 +90,7 @@ def test_numpy_hash_mirror_matches_kernel():
             assert got == expect
 
 
+@pytest.mark.slow
 def test_chunked_driver_compaction_parity():
     """Verdicts from the chunked lane-compacting driver must match the
     oracle on a corpus hard enough to force several compaction rounds and

@@ -14,6 +14,10 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
+
+# heaviest parametrized suite: full lane only (README "Tests", pyproject `slow` marker)
+pytestmark = pytest.mark.slow
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "_distributed_worker.py")
@@ -61,6 +65,14 @@ def test_two_process_jax_distributed_sharded_kernel_parity(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    if any("Multiprocess computations aren't implemented on the CPU "
+           "backend" in log for log in logs):
+        # environment capability gate, same contract as the tpu marker's
+        # clean skip: some jaxlib builds cannot run cross-process
+        # collectives on the CPU backend at all — nothing this test
+        # guards (the sharded-kernel program shape) can be exercised
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives "
+                    "in this environment")
     assert all(p.returncode == 0 for p in procs), "\n---\n".join(logs)
 
     reports = [json.load(open(o)) for o in outs]

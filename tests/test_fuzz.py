@@ -3,6 +3,8 @@ must agree with the exact Python oracle on histories against arbitrary
 seeded transition tables — the property-tested parity suite with the
 property ranging over specifications too (SURVEY.md §4)."""
 
+import pytest
+
 import json
 import random
 
@@ -134,6 +136,7 @@ def test_fuzz_cli(capsys):
     assert rc == 0 and out["ok"] and out["mismatches"] == []
 
 
+@pytest.mark.slow
 def test_fuzz_router_backend():
     """The auto-tpu router as a fuzz target: per-history segdc/plain
     routing (incl. native middle enumeration) must stay oracle-exact on
@@ -148,6 +151,7 @@ def test_fuzz_router_backend():
     assert rep.mismatches == []
 
 
+@pytest.mark.slow
 def test_fuzz_hybrid_backend():
     """Device-majority + host-tail as one backend: the fuzz target uses a
     tiny device budget so random specs push real traffic through the tail

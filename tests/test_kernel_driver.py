@@ -3,6 +3,8 @@ compaction must behave exactly like the host reference path, and the
 double-buffered tail (speculative next-chunk dispatch) must change cost
 only, never verdicts."""
 
+import pytest
+
 import numpy as np
 
 from qsm_tpu.models.cas import AtomicCasSUT, CasSpec, RacyCasSUT
@@ -36,6 +38,7 @@ def test_device_compaction_matches_host_reference():
     assert dev.rounds_run == host.rounds_run
 
 
+@pytest.mark.slow
 def test_device_compaction_rehash_grows_cache_correctly():
     """Force a slot-size change (bucket shrink grows the per-lane cache)
     and pin that post-compaction searches still decide every lane — a
@@ -51,6 +54,7 @@ def test_device_compaction_rehash_grows_cache_correctly():
     assert ((v == want) | ~both).all()
 
 
+@pytest.mark.slow
 def test_double_buffer_parity_and_accounting():
     """DOUBLE_BUFFER=True must produce identical verdicts and identical
     round structure (the speculative chunk IS the next round's work);
@@ -92,6 +96,7 @@ def test_host_sync_accounting_accumulates():
     assert b.rounds_run > 0
 
 
+@pytest.mark.slow
 def test_unroll_bit_identical_to_single_step():
     """UNROLL=K applies K freeze-guarded micro-steps per while trip:
     verdicts AND per-lane iteration counts must be bit-identical to

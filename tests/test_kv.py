@@ -65,6 +65,7 @@ def test_pcomp_agrees_with_direct_oracle():
     assert (d == Verdict.VIOLATION).any(), "sample vacuous: no violations"
 
 
+@pytest.mark.slow
 def test_pcomp_device_parity_at_scale():
     """16 pids × up to 64 ops (the config-#5 scale): pcomp over the device
     kernel equals pcomp over the CPU oracle, after BUDGET_EXCEEDED verdicts

@@ -4,6 +4,8 @@ new buckets directly; the native C++ checker's 128-bit taken mask covers
 the full bucket range natively; segmentation keeps the long-history cost
 decomposed (SURVEY.md §5 long-context row)."""
 
+import pytest
+
 import numpy as np
 
 from qsm_tpu import Verdict, WingGongCPU
@@ -18,6 +20,7 @@ def test_buckets_extend_past_reference_scale():
     assert bucket_for(97) == 128
 
 
+@pytest.mark.slow
 def test_cas_96ops_device_parity():
     from qsm_tpu.ops.jax_kernel import JaxTPU
 
@@ -50,6 +53,7 @@ def test_cas_128ops_native_parity():
     assert (want == int(Verdict.VIOLATION)).any()
 
 
+@pytest.mark.slow
 def test_cas_128ops_device_parity():
     """The device kernel at the 128-op bucket (4 taken-mask words in the
     packed precedence path) — decided verdicts must match the oracle."""

@@ -3,6 +3,8 @@ image — a compile failure must FAIL, not skip), parity with the Python
 oracle incl. pending-op fault histories and budget semantics, fallback
 routing for vector-state specs, and init-state starts (SegDC's route)."""
 
+import pytest
+
 import numpy as np
 
 from qsm_tpu import Verdict, WingGongCPU
@@ -88,6 +90,7 @@ def test_queue_native_kernel_parity():
     assert (got == int(Verdict.LINEARIZABLE)).any()
 
 
+@pytest.mark.slow
 def test_kv_native_kernel_parity():
     """KV histories (wg.cpp kind 2) at full 16-pid/64-op size; note the
     UNdecomposed search — the native DFS handles it where the Python

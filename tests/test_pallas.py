@@ -33,6 +33,7 @@ def cas_corpus():
                               seed_prefix="pal")
 
 
+@pytest.mark.slow
 def test_pallas_parity_vs_oracle(cas_corpus):
     spec, corpus = cas_corpus
     memo = WingGongCPU(memo=True)
@@ -43,6 +44,7 @@ def test_pallas_parity_vs_oracle(cas_corpus):
     assert int((pv == 2).sum()) == 0  # this corpus decides within budget
 
 
+@pytest.mark.slow
 def test_pallas_matches_jax_kernel_verdicts(cas_corpus):
     spec, corpus = cas_corpus
     jx = JaxTPU(spec, budget=4_000, mid_budget=0, rescue_budget=0)
@@ -64,6 +66,7 @@ def test_pallas_budget_is_honest(cas_corpus):
     assert int((pv == 2).sum()) > 0  # some lanes must hit the budget
 
 
+@pytest.mark.slow
 def test_pallas_witness_replays(cas_corpus):
     spec, corpus = cas_corpus
     p = _tight(spec)
@@ -75,6 +78,7 @@ def test_pallas_witness_replays(cas_corpus):
     assert verify_witness(spec, lin, wit)
 
 
+@pytest.mark.slow
 def test_pallas_cache_prunes_without_changing_verdicts(cas_corpus):
     """The per-lane VMEM memo cache is pruning-only: identical verdicts
     with fewer chunk calls (the violating history's exhaustive search is
@@ -163,6 +167,7 @@ def test_pallas_rejects_unsupported_specs():
         PallasTPU(QueueSpec())
 
 
+@pytest.mark.slow
 def test_pallas_pending_ops_route_through_expansion(cas_corpus):
     """Pending-op histories go through the inherited host-side
     complete/prune expansion — verdicts must match the oracle's."""

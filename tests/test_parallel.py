@@ -3,6 +3,7 @@
 dryrun_multichip exercises the same path)."""
 
 import numpy as np
+import pytest
 
 from qsm_tpu import generate_program, run_concurrent
 from qsm_tpu.models import AtomicCasSUT, CasSpec, RacyCasSUT
@@ -30,6 +31,7 @@ def test_sharded_backend_matches_unsharded():
     assert (a == b).all(), list(zip(a.tolist(), b.tolist()))
 
 
+@pytest.mark.slow
 def test_sharded_compaction_parity():
     """Device-side lane compaction under a mesh: a corpus big enough to
     retire lanes across batch buckets must compact on-device (the jitted

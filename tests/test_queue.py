@@ -1,6 +1,8 @@
 """Bounded FIFO queue (config #4, BASELINE.json:10): vector-state spec;
 correct impl passes, the two-phase dequeue duplicates heads and fails."""
 
+import pytest
+
 import numpy as np
 
 from qsm_tpu import (PropertyConfig, Verdict, WingGongCPU, check_one,
@@ -75,6 +77,7 @@ def test_racy_queue_fails_and_shrinks():
     assert any(op.cmd == DEQ for op in cx.program.ops), cx.program
 
 
+@pytest.mark.slow
 def test_queue_backend_parity():
     from conftest import assert_backend_parity
 

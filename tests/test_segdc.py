@@ -4,6 +4,7 @@ end states, pending ops in the final segment, and full verdict parity with
 the oracle on the queue-48 bench corpus."""
 
 import numpy as np
+import pytest
 
 from qsm_tpu import Verdict, WingGongCPU, check_one, overlapping_history
 from qsm_tpu.core.history import sequential_history
@@ -80,6 +81,7 @@ def test_queue48_corpus_parity_zero_undecided():
     assert (got == int(Verdict.LINEARIZABLE)).any()
 
 
+@pytest.mark.slow
 def test_queue48_final_segments_decided_on_device_backend():
     """VERDICT round 2, "Next round" #6 done-criterion: ``segdc-tpu`` parity
     on the queue-48 corpus with SEGMENTS (not just uncut wholes) decided on

@@ -3,6 +3,8 @@ passes, the two-phase pop duplicates the top and fails; verdict parity
 across the Python oracle, the native C++ step kernel (wg.cpp kind 3),
 and the device kernel's vector-state path."""
 
+import pytest
+
 import numpy as np
 
 from qsm_tpu import (PropertyConfig, Verdict, WingGongCPU, check_one,
@@ -53,6 +55,7 @@ def test_racy_stack_fails_and_shrinks():
     assert any(op.cmd == POP for op in cx.program.ops), cx.program
 
 
+@pytest.mark.slow
 def test_stack_backend_parity():
     from conftest import assert_backend_parity
 

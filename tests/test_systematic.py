@@ -16,6 +16,9 @@ from qsm_tpu.sched.systematic import _next_prefix, explore_program
 
 import pytest
 
+# heaviest parametrized suite: full lane only (README "Tests", pyproject `slow` marker)
+pytestmark = pytest.mark.slow
+
 # the crisp 2-pid TOCTOU program: both pids add the same key
 SET_SPEC = SetSpec(n_keys=2)
 SET_PROG = Program(ops=(ProgOp(0, ADD, 0), ProgOp(1, ADD, 0)), n_pids=2)

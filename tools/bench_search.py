@@ -1,0 +1,160 @@
+"""Search-efficiency bench — iterations-per-history on the CAS-32 corpus.
+
+The round-5 windows priced the kernel's node-work multiplier: ~182k
+lockstep iterations per history on the banked device headline while the
+memoised host oracle decided the same corpus exploring 10²–10³ nodes.
+That multiplier is SEARCH (order, memo coverage, decomposition), not step
+throughput, and it is hardware-independent — so this tool measures it on
+the CPU platform, no window required, engine by engine:
+
+* ``oracle`` / ``memo``   — host checkers' nodes/history (the denominator
+  the device's iters/history is judged against);
+* ``hand``                — ``JaxTPU`` exactly as every round ran it
+  (hand-tuned chunk schedule, coarse buckets, TPU-safe-region memo caps);
+* ``planned_kernel``      — the same kernel steered by ``plan_search``
+  (fine buckets, full-size memo tables, geometric schedule), ordering
+  and decomposition OFF: the driver-policy win alone;
+* ``planned_full``        — ``build_backend``'s planned checker with
+  postcondition-aware ordering and quiescent-cut decomposition on.
+
+Every row carries the engine's full ``SearchStats`` and its verdict
+parity against the memoised oracle (the verdict contract: a plan changes
+iteration counts ONLY).  Output: one slim JSON line to stdout, the full
+document to ``BENCH_SEARCH_<tag>.json`` next to bench.py.  The committed
+artifact is the regression anchor for the ≥10× iters-per-history
+acceptance gate (tests/test_search.py pins the live ratio).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_PIDS = 8
+N_OPS = 32
+
+
+def run(n_corpus: int, tag: str, out_path: str | None) -> dict:
+    from qsm_tpu.models import AtomicCasSUT, CasSpec, RacyCasSUT
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+    from qsm_tpu.search import plan_search, profile_corpus
+    from qsm_tpu.search.planner import build_backend
+    from qsm_tpu.utils.corpus import build_corpus
+
+    spec = CasSpec()
+    corpus = build_corpus(spec, (AtomicCasSUT, RacyCasSUT), n=n_corpus,
+                          n_pids=N_PIDS, max_ops=N_OPS, seed_base=1000,
+                          seed_prefix="bench")
+    profile = profile_corpus(corpus)
+    plan = plan_search(spec, profile, platform="cpu")
+
+    rows = []
+    memo_verdicts = None
+
+    def measure(name, backend):
+        nonlocal memo_verdicts
+        t0 = time.perf_counter()
+        v = np.asarray(backend.check_histories(spec, corpus))
+        dt = time.perf_counter() - t0
+        st = backend.search_stats()
+        wrong = None
+        if memo_verdicts is not None:
+            both = (memo_verdicts != 2) & (v != 2)
+            wrong = int(np.sum(both & (memo_verdicts != v)))
+        row = {
+            "engine": name,
+            "histories": len(corpus),
+            "seconds": round(dt, 2),
+            "undecided": int((v == 2).sum()),
+            "wrong_vs_memo": wrong,
+            "iters_per_history": round(st.iters_per_history, 1),
+            "nodes_per_history": round(st.nodes_per_history, 1),
+            "search": st.to_dict(),
+        }
+        rows.append(row)
+        return v
+
+    # host denominators first (memo also pins the parity reference)
+    memo = WingGongCPU(memo=True)
+    memo_verdicts = measure("memo", memo)
+    # the naive reference walks the same corpus un-memoised; CAS-32 stays
+    # tractable (the bench headline timeboxes it — here the whole corpus
+    # is the point, nodes/history must cover every verdict)
+    measure("oracle", WingGongCPU(node_budget=20_000_000))
+
+    measure("hand", JaxTPU(spec))
+
+    kernel_plan = plan_search(spec, profile, platform="cpu")
+    # driver policy alone: strip the two search modes off the plan
+    import dataclasses
+
+    kernel_only = dataclasses.replace(kernel_plan, ordering=False,
+                                      decompose=False,
+                                      name=kernel_plan.name + "-kernel")
+    measure("planned_kernel", JaxTPU(spec, plan=kernel_only))
+
+    measure("planned_full", build_backend(spec, plan))
+
+    by = {r["engine"]: r for r in rows}
+    ratio = (by["hand"]["iters_per_history"]
+             / max(by["planned_full"]["iters_per_history"], 1e-9))
+    doc = {
+        "metric": f"iters_per_history_cas_{N_OPS}ops_x_{N_PIDS}pids",
+        "value": by["planned_full"]["iters_per_history"],
+        "unit": "lockstep iters/history",
+        "hand_iters_per_history": by["hand"]["iters_per_history"],
+        "reduction_vs_hand": round(ratio, 1),
+        "memo_oracle_nodes_per_history": by["memo"]["nodes_per_history"],
+        "oracle_nodes_per_history": by["oracle"]["nodes_per_history"],
+        "corpus": {"n": len(corpus), "pids": N_PIDS, "ops": N_OPS,
+                   "mean_segments": round(profile.mean_segments, 2)},
+        "plan": plan.describe(),
+        "platform": "cpu",
+        "captured_iso": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "rows": rows,
+    }
+    path = out_path or os.path.join(REPO, f"BENCH_SEARCH_{tag}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    slim = {k: doc[k] for k in
+            ("metric", "value", "unit", "hand_iters_per_history",
+             "reduction_vs_hand", "memo_oracle_nodes_per_history",
+             "oracle_nodes_per_history")}
+    slim["wrong_verdicts"] = sum(r["wrong_vs_memo"] or 0 for r in rows)
+    slim["artifact"] = os.path.basename(path)
+    print(json.dumps(slim))
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--corpus", type=int, default=128)
+    ap.add_argument("--tag", default="r06")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    from qsm_tpu.utils.device import force_cpu_platform
+
+    force_cpu_platform()
+    try:
+        run(args.corpus, args.tag, args.out)
+    except Exception as e:  # noqa: BLE001 — diagnostic line, not a traceback
+        print(json.dumps({"metric": "iters_per_history", "value": 0,
+                          "error": f"{type(e).__name__}: {e}"}))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
