@@ -620,8 +620,11 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
                 except (OSError, ValueError):
                     pass
             if not keep_existing:
-                with open(path, "w") as f:
-                    json.dump(sw, f, indent=1)
+                from qsm_tpu.resilience.checkpoint import atomic_write_json
+
+                # tmp+rename: a bench killed mid-write (window closing)
+                # must never leave a truncated sweep artifact behind
+                atomic_write_json(path, sw, indent=1)
             sweep_extras["sweep_file"] = os.path.basename(path)
             if keep_existing:
                 # the referenced artifact is an EARLIER (more complete
@@ -706,24 +709,42 @@ def run_bench(on_tpu: bool, probe_detail: str, profile_dir: str | None,
                 else None),
             "wrong_verdicts_on_sample": mismatches,
             "corpus_gen_sec": round(gen_s, 1),
+            # fault-handling self-description (qsm_tpu/resilience): zeros
+            # on a clean run — a missing key would be a shrug, an
+            # explicit 0 is a claim the run never degraded
+            "resilience": _bench_resilience(backend),
             **sweep_extras,
         },
     }
 
 
+def _bench_resilience(backend) -> dict:
+    """The compact resilience block every bench artifact stamps."""
+    from qsm_tpu.resilience.failover import collect_resilience
+
+    r = collect_resilience(backend)
+    return {"degradations": r.get("degradations", 0),
+            "retries": r.get("retries", 0),
+            "fallback_engine": r.get("fallback_engine")}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--probe-timeout", type=float, default=60.0,
-                    help="seconds to wait for the TPU backend probe")
+    ap.add_argument("--probe-policy", default="bench-probe",
+                    help="named RetryPolicy preset governing the probe "
+                         "ladder (qsm_tpu/resilience/policy.py PRESETS; "
+                         "the watcher's seize passes seize-probe)")
+    ap.add_argument("--probe-timeout", type=float, default=None,
+                    help="override the policy's per-attempt probe bound")
     ap.add_argument("--force-cpu", action="store_true",
                     help="skip the probe and bench on the CPU platform")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the timed device "
                          "passes into DIR")
-    ap.add_argument("--retries", type=int, default=2,
-                    help="extra spaced probe attempts if the first fails")
-    ap.add_argument("--retry-interval", type=float, default=30.0,
-                    help="seconds between probe retries")
+    ap.add_argument("--retries", type=int, default=None,
+                    help="override the policy's extra probe attempts")
+    ap.add_argument("--retry-interval", type=float, default=None,
+                    help="override the policy's spacing between retries")
     ap.add_argument("--no-sweep", action="store_true",
                     help="skip the max-ops-solved-60s sweep")
     ap.add_argument("--sweep-file", default=None, metavar="PATH",
@@ -737,33 +758,37 @@ def main(argv=None) -> int:
                          "window's wall-clock on the host core.")
     args = ap.parse_args(argv)
 
+    from qsm_tpu.resilience.policy import preset
     from qsm_tpu.utils.device import force_cpu_platform, probe_default_backend
 
+    # ONE retry/deadline policy for the whole probe ladder: the named
+    # preset is the source of truth (resilience/policy.py), the explicit
+    # flags are per-run overrides — no hand-rolled constants here anymore
+    policy = preset(args.probe_policy)
+    if args.probe_timeout is not None:
+        policy = policy.with_(timeout_s=args.probe_timeout)
+    if args.retries is not None:
+        policy = policy.with_(attempts=1 + max(0, args.retries))
+    if args.retry_interval is not None:
+        policy = policy.with_(backoff_s=args.retry_interval,
+                              backoff_factor=1.0)
     if args.force_cpu:
         probe_detail = "skipped (--force-cpu)"
         on_tpu = False
     else:
-        probe = probe_default_backend(args.probe_timeout)
-        _append_probe_log(probe)
+        # the tunnel has healed mid-round before; the policy's spaced
+        # re-probes are cheap relative to forfeiting the round's only
+        # real-chip window — every attempt lands in the probe log
+        probe = probe_default_backend(policy=policy,
+                                      on_attempt=_append_probe_log)
         probe_detail = probe.detail
         on_tpu = probe.is_device
-        if not on_tpu and args.retries > 0:
-            # the tunnel has healed mid-round before; a couple of spaced
-            # re-probes at bench time are cheap relative to forfeiting the
-            # round's only real-chip window
-            for _ in range(args.retries):
-                time.sleep(args.retry_interval)
-                probe = probe_default_backend(args.probe_timeout)
-                _append_probe_log(probe)
-                probe_detail = probe.detail
-                on_tpu = probe.is_device
-                if on_tpu:
-                    break
     if not on_tpu and args.require_device:
         print(json.dumps({
             "metric": "device_required", "value": 0, "unit": "",
             "vs_baseline": 0,
-            "error": f"no device after {1 + args.retries} probes",
+            "error": f"no device after {policy.attempts} probes "
+                     f"(policy {policy.name})",
             "extras": {"tpu_probe": probe_detail, "device_fallback": "cpu",
                        "probe_attempts": _probe_attempts_summary()},
         }))
@@ -844,7 +869,7 @@ def _slim_line(result: dict) -> str:
                  "chunk_schedule", "lockstep_iters_r2_ladder",
                  "cache_entries_before", "cache_entries_after",
                  "cpu_oracle_median_s", "corpus_gen_sec",
-                 "frozen_denominator_file",
+                 "frozen_denominator_file", "resilience",
                  # search stats drop LAST among extras: iph/nph are the
                  # decomposition the round is judged on
                  "search_oracle_nph", "search_memo_nph", "search_device")

@@ -12,7 +12,11 @@ constructed —
 * kernel trace hazards (``kernel_passes``): retracing, dtype promotion,
   host transfers in traced loop bodies, the Pallas VMEM envelope;
 * scheduler determinism (``sched_passes``): nondeterminism sources
-  outside the seeded RNG.
+  outside the seeded RNG;
+* resilience (``resilience_passes``): unbounded device calls — bare
+  ``jax.devices()`` outside a watchdog, subprocess waits without a
+  timeout, scattered probe-timeout literals the named
+  :data:`~qsm_tpu.resilience.policy.PRESETS` replaced.
 
 Entry points: :func:`run_lint` (the engine), ``python -m qsm_tpu lint``
 (the CLI gate), tests/test_lint.py (the tier-1 gate) and the
@@ -23,7 +27,8 @@ format are documented in docs/ANALYSIS.md.
 from .findings import (ERROR, INFO, WARNING, Finding, Whitelist,
                        render_json, render_text, sort_findings,
                        split_whitelisted)
-from .engine import (DEFAULT_OPS_FILES, DEFAULT_SCHED_FILES, LintReport,
+from .engine import (DEFAULT_OPS_FILES, DEFAULT_RESILIENCE_FILES,
+                     DEFAULT_SCHED_FILES, LintReport,
                      default_whitelist_path, run_lint)
 
 __all__ = [
@@ -31,4 +36,5 @@ __all__ = [
     "run_lint", "render_text", "render_json", "sort_findings",
     "split_whitelisted", "default_whitelist_path",
     "DEFAULT_OPS_FILES", "DEFAULT_SCHED_FILES",
+    "DEFAULT_RESILIENCE_FILES",
 ]

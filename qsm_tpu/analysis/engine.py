@@ -34,6 +34,17 @@ DEFAULT_OPS_FILES = ("ops/jax_kernel.py", "ops/pallas_kernel.py",
 # the scheduler plane the determinism passes cover
 DEFAULT_SCHED_FILES = ("sched/scheduler.py", "sched/pool.py",
                        "sched/transport.py", "sched/runner.py")
+# every module that touches the device substrate or spawns bounded
+# children — the resilience passes' beat (repo-root-relative: the tools
+# live outside the package)
+DEFAULT_RESILIENCE_FILES = (
+    "qsm_tpu/ops/jax_kernel.py", "qsm_tpu/ops/pallas_kernel.py",
+    "qsm_tpu/ops/segdc.py", "qsm_tpu/ops/rootsplit.py",
+    "qsm_tpu/ops/pcomp.py", "qsm_tpu/utils/device.py",
+    "qsm_tpu/utils/cli.py", "qsm_tpu/native/__init__.py",
+    "bench.py", "tools/probe_watcher.py", "tools/bench_configs.py",
+    "tools/bench_e2e.py", "tools/bench_scale.py",
+    "tools/bench_search.py", "tools/bench_host_baseline.py")
 
 
 def default_whitelist_path() -> str:
@@ -105,10 +116,12 @@ def run_lint(models: Optional[Sequence[str]] = None,
              whitelist: Union[None, str, Whitelist] = None,
              ops_files: Optional[Sequence[str]] = None,
              sched_files: Optional[Sequence[str]] = None,
+             resilience_files: Optional[Sequence[str]] = None,
              seed: int = 0) -> LintReport:
     from ..models.registry import MODELS
     from .kernel_passes import (check_host_transfers, check_pallas_vmem,
                                 check_retracing, check_step_dtypes)
+    from .resilience_passes import check_resilience_file
     from .sched_passes import check_sched_file
     from .spec_passes import check_spec
 
@@ -162,6 +175,16 @@ def run_lint(models: Optional[Sequence[str]] = None,
         path = rel if os.path.isabs(rel) else os.path.join(_PKG_DIR, rel)
         findings += check_sched_file(path, root=REPO_ROOT)
     passes["sched"] = time.perf_counter() - t0
+
+    # --- (d) resilience: unbounded device calls --------------------------
+    t0 = time.perf_counter()
+    for rel in (resilience_files if resilience_files is not None
+                else DEFAULT_RESILIENCE_FILES):
+        # repo-root-relative by convention: the tool modules live
+        # outside the package (bench.py, tools/)
+        path = rel if os.path.isabs(rel) else os.path.join(REPO_ROOT, rel)
+        findings += check_resilience_file(path, root=REPO_ROOT)
+    passes["resilience"] = time.perf_counter() - t0
 
     wl = _resolve_whitelist(whitelist)
     kept, allowed = split_whitelisted(findings, wl)

@@ -84,3 +84,38 @@ class UnorderedSchedulerStub:
         k = int(time.time()) % max(len(self.pool), 1)  # clock-fed pick
         order = sorted(self.pool, key=id)  # address-ordered tiebreak
         return order[k]
+
+
+class UnboundedDeviceProbeStub:
+    """Seeded bug for the resilience passes: a bare ``jax.devices()``
+    (QSM-RES-DEVICES — blocks forever on a wedged tunnel), a subprocess
+    wait without a timeout (QSM-RES-SUBPROC) and a numeric timeout
+    literal handed to the probe (QSM-RES-TIMEOUT-LITERAL) — next to a
+    ``watchdog``-bounded twin the pass must NOT flag.  Never executed;
+    tests point the resilience AST pass at this file and assert the
+    three rules fire exactly once each."""
+
+    def probe_unbounded(self):
+        import jax
+
+        return str(jax.devices()[0])  # <-- bug: can block forever
+
+    def wait_unbounded(self):
+        import subprocess
+        import sys
+
+        return subprocess.run([sys.executable, "-c", "pass"],
+                              capture_output=True)  # <-- bug: no timeout
+
+    def probe_with_literal(self):
+        from ..utils.device import probe_default_backend
+
+        return probe_default_backend(45.0)  # <-- bug: scattered constant
+
+    def probe_bounded(self):
+        """The sanctioned form — must NOT be flagged."""
+        import jax
+
+        from ..resilience.policy import watchdog
+
+        return watchdog(lambda: jax.devices(), 45.0, label="fixture")

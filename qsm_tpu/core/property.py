@@ -112,7 +112,9 @@ class PropertyResult:
     # (SURVEY.md §3.5) — this is the honest measurement of whether checking
     # (vs host-side execution/generation) is the bottleneck being solved
     # (VERDICT.md round 2, "Next round" #8).  Keys: generate, execute,
-    # check, resolve, shrink_execute, shrink_check.
+    # check, resolve, shrink_execute, shrink_check; plus the resilience
+    # plane's fault-handling record when anything degraded —
+    # resilience_degradations / resilience_retries (qsm_tpu/resilience).
     timings: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
@@ -172,10 +174,13 @@ def _resolve(spec: Spec, verdicts: np.ndarray, histories: Sequence[History],
     """Resolve BUDGET_EXCEEDED device verdicts via the CPU oracle.
 
     Skipped when the backend IS the oracle (re-running the identical search
-    with the identical budget can only repeat the verdict).  Verdicts still
+    with the identical budget can only repeat the verdict), including a
+    failover wrapper already degraded onto the oracle.  Verdicts still
     undecided afterwards stay BUDGET_EXCEEDED and are surfaced by the caller.
     """
-    if backend is oracle:
+    if backend is oracle or (getattr(backend, "degraded", False)
+                             and getattr(backend, "fallback", None)
+                             is oracle):
         return verdicts
     out = verdicts.copy()
     todo = [i for i, v in enumerate(out) if v == Verdict.BUDGET_EXCEEDED]
@@ -303,12 +308,26 @@ def prop_concurrent(
         # whichever engines this run actually used (search/stats.py).
         # Engines count cumulatively per instance, so snapshot before and
         # report the delta: timings entries are per-run by contract.
+        from ..resilience.failover import FailoverBackend
         from ..search.stats import collect_search_stats, stats_delta
 
+        # mid-run device loss degrades dispatch to the resolution oracle
+        # instead of crashing the run — one-way, watchdog-bounded,
+        # counted.  The SAME combinator the CLI's --failover uses
+        # (resilience/failover.py): a second private implementation here
+        # would let the two degradation semantics drift apart.  An
+        # already-wrapped backend keeps its own (possibly different)
+        # fallback ladder.
+        if backend is not oracle \
+                and not isinstance(backend, FailoverBackend):
+            backend = FailoverBackend(spec, backend, fallback=oracle)
         st0 = collect_search_stats(backend)
         res = _prop_concurrent_body(
             spec, sut, cfg, backend, oracle, transport, executor,
             timings, _bump)
+        # the delta is computed on the WRAPPER, so the resilience
+        # counters (degradations/retries) ride the same per-run snapshot
+        # discipline as every other search stat
         st = stats_delta(collect_search_stats(backend), st0)
         if st is not None:
             res.timings.update(st.to_timings())

@@ -28,6 +28,37 @@ class Verdict(enum.IntEnum):
     BUDGET_EXCEEDED = 2
 
 
+class BackendUnavailable(RuntimeError):
+    """A backend lost its substrate mid-run (device seized by another
+    process, tunnel wedged, runtime torn down).  The typed signal the
+    resilience plane reacts to: callers degrade to a host fallback
+    instead of crashing (resilience/failover.py, core/property.py)."""
+
+
+def device_error_types() -> tuple:
+    """THE definition of "device loss" — every error class that means
+    the dispatch substrate failed (as opposed to a bug in the caller's
+    arguments, which must keep crashing loudly).  One site, imported by
+    the failover combinator, the hybrid backend, and the property layer,
+    so what degrades and what crashes can never drift apart.
+    """
+    from ..resilience.faults import InjectedFault
+    from ..resilience.policy import WatchdogTimeout
+
+    # deliberately NOT OSError: a FileNotFoundError from memo
+    # persistence (or any caller bug) is not device loss, and silently
+    # degrading on it would hide the bug behind a correct-looking
+    # host-fallback run — only typed substrate failures degrade
+    errs = [BackendUnavailable, WatchdogTimeout, InjectedFault]
+    try:  # the XLA runtime's own failure type (absent on stripped jaxlibs)
+        import jax
+
+        errs.append(jax.errors.JaxRuntimeError)
+    except (ImportError, AttributeError):
+        pass
+    return tuple(errs)
+
+
 class LineariseBackend(Protocol):
     name: str
 

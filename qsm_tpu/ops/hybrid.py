@@ -33,7 +33,7 @@ import numpy as np
 
 from ..core.history import History
 from ..core.spec import Spec
-from .backend import Verdict
+from .backend import Verdict, device_error_types
 
 
 class HybridDevice:
@@ -43,6 +43,13 @@ class HybridDevice:
     tail (the round-4 capture's knee sits near the default 2k).
     ``tail``: any LineariseBackend; default = native C++ oracle when
     available, else the memoised Wing–Gong oracle.
+
+    Resilience: the tail is ALREADY a full exact checker, so mid-run
+    device loss (dispatch raising the XLA runtime error, an injected
+    fault, a seized chip) degrades this backend in place — the whole
+    batch goes to the tail, later batches skip the dead device, and the
+    ``degradations``/``fallback_engine`` counters record it
+    (resilience/failover.py defines the shared taxonomy).
     """
 
     name = "hybrid_device"
@@ -60,11 +67,32 @@ class HybridDevice:
         self.tail = tail
         self.tail_histories = 0   # lanes the host tail decided for us
         self.device_decided = 0
+        self.degraded = False     # device lost mid-run: tail-only now
+        self.degradations = 0
+        self.fallback_engine = ""
+        self.last_error = ""
+
+    def _degrade(self, err: BaseException) -> None:
+        self.degraded = True
+        self.degradations += 1
+        self.fallback_engine = getattr(self.tail, "name",
+                                       type(self.tail).__name__)
+        self.last_error = f"{type(err).__name__}: {err}"[:200]
 
     def check_histories(self, spec: Spec,
                         histories: Sequence[History]) -> np.ndarray:
-        out = np.asarray(self.device.check_histories(spec, histories),
-                         dtype=np.int8)
+        out = np.full(len(histories), int(Verdict.BUDGET_EXCEEDED),
+                      np.int8)
+        if not self.degraded:
+            try:
+                out = np.asarray(
+                    self.device.check_histories(spec, histories),
+                    dtype=np.int8)
+            except device_error_types() as e:
+                # device lost mid-run: every lane becomes a "straggler"
+                # and the exact tail decides it — verdicts unchanged,
+                # only the engine that computed them
+                self._degrade(e)
         und = np.nonzero(out == int(Verdict.BUDGET_EXCEEDED))[0]
         self.device_decided += len(histories) - und.size
         if und.size:
@@ -77,10 +105,27 @@ class HybridDevice:
     def check_witness(self, spec: Spec, history: History):
         """Witness from whichever side decided the history (device
         witnesses verify search-free; host oracles produce their own)."""
-        v = Verdict(int(self.device.check_histories(spec, [history])[0]))
-        if v != Verdict.BUDGET_EXCEEDED:
-            return self.device.check_witness(spec, history)
+        if not self.degraded:
+            try:
+                v = Verdict(int(
+                    self.device.check_histories(spec, [history])[0]))
+                if v != Verdict.BUDGET_EXCEEDED:
+                    return self.device.check_witness(spec, history)
+            except device_error_types() as e:
+                self._degrade(e)
         return self.tail.check_witness(spec, history)
+
+    def resilience(self) -> dict:
+        """Self-describing fault-handling block for bench rows / CLI
+        stats (resilience/failover.py collect_resilience contract)."""
+        return {
+            "degradations": self.degradations,
+            "retries": 0,
+            "fallback_engine": self.fallback_engine or None,
+            "device_histories": self.device_decided,
+            "fallback_histories": self.tail_histories,
+            **({"last_error": self.last_error} if self.last_error else {}),
+        }
 
     def search_stats(self):
         """Device lockstep cost AND host tail nodes, side by side — the
@@ -92,14 +137,16 @@ class HybridDevice:
         st = self.device.search_stats()
         st.engine = self.name
         st.tail_histories = self.tail_histories
+        st.degradations += self.degradations
+        if self.fallback_engine:
+            st.fallback_engine = self.fallback_engine
         st.absorb(collect_search_stats(self.tail))
         return st
 
 
 def _default_tail(spec: Spec):
-    from ..native import CppOracle, native_available
-    from .wing_gong_cpu import WingGongCPU
+    # one ladder definition for the whole stack: the hybrid tail and the
+    # failover plane's degradation target are the SAME host checker
+    from ..resilience.failover import host_fallback
 
-    if native_available():
-        return CppOracle(spec)
-    return WingGongCPU(memo=True)
+    return host_fallback(spec)

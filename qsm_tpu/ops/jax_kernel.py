@@ -813,6 +813,13 @@ class JaxTPU:
                         ) -> np.ndarray:
         assert spec is self.spec, \
             "JaxTPU is compiled per spec; construct one per spec"
+        # fault site: every device dispatch enters here — the resilience
+        # plane simulates hangs/mid-run loss at this boundary so the
+        # failover paths are tier-1 testable without hardware
+        # (resilience/faults.py; no-op unless QSM_TPU_FAULTS is set)
+        from ..resilience.faults import inject
+
+        inject("dispatch")
         if not histories:
             return np.empty(0, np.int8)
         # public-parameter validation: not an assert (python -O strips it)
@@ -880,6 +887,13 @@ class JaxTPU:
         oracle's, the witness replays independently via
         ``verify_witness`` — the kernel is not trusted, its proof is.
         """
+        # fault site: the pending-free path below dispatches via
+        # _run_device without passing through check_histories, so the
+        # witness entry needs its own hook for the degradation paths to
+        # be tier-1 testable (resilience/faults.py; no-op unless set)
+        from ..resilience.faults import inject
+
+        inject("dispatch")
         if history.n_pending or (
                 self._uses_table and not self._args_in_domain(history)):
             # pending or out-of-domain: the witness path can't apply —

@@ -134,6 +134,42 @@ class AutoDevice:
                    else self.WIDTH_CAP)
         return all(_width(seg) <= cap for seg in segs[:-1])
 
+    def search_stats(self):
+        """Both routes' cost record under one engine name.  The segdc
+        combinator shares THE SAME kernel instance as the plain route
+        (one compile cache), so its record — which absorbs the inner
+        kernel — already covers both routes' device work; ``histories``
+        is overridden to the router's own routed total (the kernel's
+        lane count double-books pending expansion, and segdc's seen
+        count covers only its route)."""
+        from ..search.stats import SearchStats, collect_search_stats
+
+        if self.pcomp is not None:
+            st = collect_search_stats(self.pcomp) or SearchStats()
+            st.engine = self.name
+            return st
+        st = self.segdc.search_stats()
+        st.histories = self.routed_plain + self.routed_segdc
+        st.engine = self.name
+        # a failover-wrapped inner kernel surfaces its degradation
+        # counters through the router too (resilience plane)
+        from ..resilience.failover import collect_resilience
+
+        r = collect_resilience(self.plain)
+        st.degradations += r.get("degradations", 0)
+        st.retries += r.get("retries", 0)
+        if not st.fallback_engine and r.get("fallback_engine"):
+            st.fallback_engine = r["fallback_engine"]
+        return st
+
+    def resilience(self) -> dict:
+        """Counters from whichever engine actually dispatches (the
+        shared inner kernel, possibly failover-wrapped)."""
+        from ..resilience.failover import collect_resilience
+
+        inner = self.pcomp if self.pcomp is not None else self.plain
+        return collect_resilience(inner)
+
     def check_histories(self, spec: Spec, histories: Sequence[History]
                         ) -> np.ndarray:
         assert spec is self.spec, "AutoDevice is bound to one spec"

@@ -1,0 +1,39 @@
+"""``qsm_tpu.resilience`` — the plane that keeps runs alive when the
+hardware is not.
+
+Round 5's verdict: 717 probes, 9 device hits, and any hung
+``pallas_call`` or killed bench subprocess loses the whole run.  This
+package is the systematic answer, four pieces that compose:
+
+* ``policy``     — ONE :class:`RetryPolicy` (bounded retries, backoff +
+  jitter, wall-clock deadline) + the named :data:`~policy.PRESETS` every
+  probe/dispatch/seize site uses, and the :func:`~policy.watchdog`
+  bounded-call wrapper for uninterruptible device dispatch.
+* ``failover``   — :class:`~failover.FailoverBackend`: device majority
+  while the device lives, exact host ladder (cpp → memo) the moment it
+  does not; verdicts/witnesses bit-identical to a clean host run,
+  undecided lanes only re-dispatched.
+* ``faults``     — the ``QSM_TPU_FAULTS`` env fault plane (hang / raise
+  / wedge at named sites) so every degradation path above is tier-1
+  testable on the CPU platform, no hardware required.
+* ``checkpoint`` — :func:`~checkpoint.atomic_write_json` (tmp+rename+
+  fsync, THE artifact write primitive) and
+  :class:`~checkpoint.CellJournal` (resumable per-cell scan journals:
+  ``--resume`` re-runs zero completed cells).
+
+Documented in docs/RESILIENCE.md; gated by tests/test_resilience.py and
+the ``QSM-RES-*`` qsmlint rules (analysis/resilience_passes.py).
+"""
+
+from .checkpoint import CellJournal, atomic_write_json, atomic_write_text
+from .failover import FailoverBackend, collect_resilience, host_fallback
+from .faults import FaultPlane, InjectedFault, active_plane, inject
+from .policy import (PRESETS, RetryPolicy, WatchdogTimeout, preset,
+                     watchdog)
+
+__all__ = [
+    "RetryPolicy", "PRESETS", "preset", "watchdog", "WatchdogTimeout",
+    "FailoverBackend", "collect_resilience",
+    "host_fallback", "FaultPlane", "InjectedFault", "active_plane",
+    "inject", "CellJournal", "atomic_write_json", "atomic_write_text",
+]

@@ -1,0 +1,142 @@
+"""Checkpoint/resume for bench scans — partial progress is bankable.
+
+Round 5's healed windows were minutes long and every artifact writer in
+the repo treated its output as all-or-nothing within a cell: a scan
+killed after cell 2 of 6 left cells 1-2 on disk only if the tool
+happened to write incrementally, and a *re-run* started from cell 1
+again, spending the next window on work already banked.  The
+decompose-and-continue idea the segmentation checker applies to
+histories (PAPERS.md: decrease-and-conquer monitoring) applies to the
+run lifecycle too: each cell is a unit of progress that, once measured,
+must never be re-paid.
+
+Two exports:
+
+* :func:`atomic_write_json` / :func:`atomic_write_text` — THE artifact
+  write primitive (tmp in the same directory + fsync + ``os.replace``):
+  a reader never sees a half-written document, a killed writer never
+  destroys the previous version.  Every JSON artifact writer in
+  ``tools/`` and ``bench.py`` routes through these.
+* :class:`CellJournal` — a resumable JSONL artifact (one header line +
+  one row per completed cell, rewritten atomically on every emit).
+  ``resume=True`` preloads completed rows from a compatible prior file
+  so the caller skips their cells; ``skipped`` markers are NOT
+  completions (a time-boxed cut must be re-attempted, matching the
+  probe watcher's ``_tool_rows`` accounting).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Write-whole-then-rename: crash-safe at every instant.  The tmp
+    lives in the target's directory so the rename never crosses a
+    filesystem; fsync before rename so the rename can't land before the
+    data (the power-loss ordering bug tmpfile writers usually keep)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_json(path: str, obj, indent: Optional[int] = None) -> None:
+    atomic_write_text(path, json.dumps(obj, indent=indent) + "\n")
+
+
+class CellJournal:
+    """One header + one JSON line per completed cell, atomic per emit.
+
+    ``header`` identifies the scan; on ``resume=True`` a prior file at
+    ``path`` is adopted iff its header matches on ``match_keys``
+    (default: artifact name and device/CPU-fallback provenance — a
+    CPU-fallback scan must never pre-satisfy a device scan's cells).
+    A mismatched prior file is moved aside to ``<path>.pre-resume``
+    rather than overwritten: the mismatch guard exists to protect
+    banked measurements, so it must never itself destroy them.
+    The header gains a ``resumed_cells`` count so the artifact is
+    self-describing about what this run re-measured vs inherited.
+
+    A truncated trailing line (the writer was killed mid-write under a
+    non-atomic scheme, or the file predates this journal) is dropped,
+    not fatal — the cell it described simply re-runs.
+    """
+
+    def __init__(self, path: str, header: Dict, resume: bool = False,
+                 match_keys: Sequence[str] = ("artifact",
+                                              "device_fallback")):
+        self.path = path
+        self._rows: List[Dict] = []
+        self._done: Dict[str, Dict] = {}
+        self.resumed_cells = 0
+        if resume:
+            prev_header, prev_rows = self._load(path)
+            if prev_header is not None and all(
+                    prev_header.get(k) == header.get(k)
+                    for k in match_keys):
+                for r in prev_rows:
+                    key = r.get("cell")
+                    if key and "skipped" not in r:
+                        self._done[key] = r
+                        self._rows.append(r)
+                self.resumed_cells = len(self._done)
+            elif prev_header is not None:
+                # incompatible prior artifact (e.g. --resume pointed at a
+                # banked DEVICE scan from a CPU-fallback run): adopt
+                # nothing, but PRESERVE it — the constructor's first
+                # flush below would otherwise atomically destroy the one
+                # copy of measurements this run cannot reproduce
+                try:
+                    os.replace(path, f"{path}.pre-resume")
+                except OSError:
+                    pass  # unpreservable (permissions): proceed as before
+        self.header = {**header, "resumed_cells": self.resumed_cells}
+        self._flush()
+
+    @staticmethod
+    def _load(path: str) -> Tuple[Optional[Dict], List[Dict]]:
+        try:
+            with open(path) as f:
+                raw = f.read().splitlines()
+        except OSError:
+            return None, []
+        docs = []
+        for ln in raw:
+            if not ln.strip():
+                continue
+            try:
+                docs.append(json.loads(ln))
+            except ValueError:
+                break  # truncated/garbled: trust nothing at or past it
+        if not docs:
+            return None, []
+        return docs[0], docs[1:]
+
+    # ------------------------------------------------------------------
+    def complete(self, key: str) -> Optional[Dict]:
+        """The banked row for ``key`` (this run or a resumed prior one),
+        or None when the cell still needs running."""
+        return self._done.get(key)
+
+    def emit(self, key: str, row: Dict) -> Dict:
+        """Bank one cell row (stamped with its key) atomically."""
+        row = {"cell": key, **row}
+        self._rows.append(row)
+        if "skipped" not in row:
+            self._done[key] = row
+        self._flush()
+        return row
+
+    def rows(self) -> List[Dict]:
+        """Header + every row, in file order."""
+        return [self.header] + list(self._rows)
+
+    def _flush(self) -> None:
+        atomic_write_text(
+            self.path,
+            "\n".join(json.dumps(x) for x in self.rows()) + "\n")

@@ -1,0 +1,193 @@
+"""Graceful degradation: any device engine, host-backed on device loss.
+
+``FailoverBackend`` wraps a device lineariser (``JaxTPU``,
+``PallasTPU``, ``HybridDevice``, the router — anything with
+``check_histories``) and guarantees the PROPERTY RUN SURVIVES the device
+not surviving: a dispatch that times out, raises the XLA runtime error,
+or hits an injected fault (resilience/faults.py) degrades the backend to
+the configured host fallback — the cpp → memo oracle ladder by default —
+and the run continues with exact verdicts.
+
+Semantics that make this sound rather than hopeful:
+
+* **Undecided lanes only.**  Dispatch happens in slices of
+  ``dispatch_lanes``; verdicts banked from completed slices are
+  PRESERVED and only the not-yet-decided remainder re-dispatches to the
+  fallback.  A window that closes after slice 2 of 6 loses the in-flight
+  slice, nothing more.
+* **One-way degradation.**  A lost device stays lost for this backend
+  instance: later batches go straight to the fallback instead of paying
+  the timeout again per call.
+* **Exact fallbacks only.**  The fallback ladder is the property
+  layer's own resolution oracle family (native C++ when the toolchain
+  is present, else the memoised Wing–Gong oracle), so degraded verdicts
+  and witnesses are bit-identical to a clean host run — pinned by
+  tests/test_resilience.py across model families.
+
+Counters (``degradations``, ``retries``, ``fallback_engine``,
+``device_histories``, ``fallback_histories``) ride ``search_stats()``
+and :func:`collect_resilience` into PropertyResult.timings, bench rows,
+and ``qsm-tpu stats`` — BENCH artifacts are self-describing about fault
+handling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.history import History
+from ..core.spec import Spec
+from ..ops.backend import Verdict, device_error_types
+from .policy import RetryPolicy, preset, watchdog
+
+
+def host_fallback(spec: Spec):
+    """The host checker ladder every degradation lands on: the native
+    C++ oracle when the toolchain builds it (itself falling back to the
+    Python oracle for anything outside native coverage), else the
+    memoised Wing–Gong oracle.  The SAME ladder the property layer's
+    resolution oracle and the hybrid backend's tail use — one
+    definition (ops/hybrid.py imports this one)."""
+    from ..native import CppOracle, native_available
+    from ..ops.wing_gong_cpu import WingGongCPU
+
+    if native_available():
+        return CppOracle(spec)
+    return WingGongCPU(memo=True)
+
+
+class FailoverBackend:
+    """Device majority while the device lives; host ladder the moment it
+    does not.  See the module docstring for the guarantees."""
+
+    # Lanes per guarded dispatch: the unit of bankable progress.  Small
+    # enough that a mid-run loss forfeits little, large enough that the
+    # device still sees batched work (the kernel re-buckets internally).
+    DISPATCH_LANES = 1024
+
+    def __init__(self, spec: Spec, device, fallback=None,
+                 policy: Optional[RetryPolicy] = None,
+                 dispatch_lanes: Optional[int] = None):
+        self.spec = spec
+        self.device = device
+        self.fallback = fallback if fallback is not None \
+            else host_fallback(spec)
+        self.policy = policy or preset("dispatch")
+        self.dispatch_lanes = dispatch_lanes or self.DISPATCH_LANES
+        self.name = f"failover({getattr(device, 'name', type(device).__name__)})"
+        self.degraded = False
+        self.degradations = 0       # device-loss events (0 or 1 per instance)
+        self.retries = 0            # extra dispatch attempts before degrading
+        self.fallback_engine = ""   # set on first degradation
+        self.last_error = ""
+        self.device_histories = 0   # lanes the device decided
+        self.fallback_histories = 0  # lanes the host ladder decided
+
+    # ------------------------------------------------------------------
+    def check_histories(self, spec: Spec,
+                        histories: Sequence[History]) -> np.ndarray:
+        out = np.full(len(histories), int(Verdict.BUDGET_EXCEEDED), np.int8)
+        pending = list(range(len(histories)))
+        while pending and not self.degraded:
+            idx = pending[:self.dispatch_lanes]
+            try:
+                sub = self._guarded_dispatch(spec,
+                                             [histories[i] for i in idx])
+            except device_error_types() as e:
+                self._degrade(e)
+                break  # the in-flight slice stays pending
+            out[np.asarray(idx)] = np.asarray(sub, np.int8)
+            self.device_histories += len(idx)
+            pending = pending[self.dispatch_lanes:]
+        if pending:
+            # undecided lanes ONLY: verdicts banked above are preserved
+            sub = self.fallback.check_histories(
+                spec, [histories[i] for i in pending])
+            out[np.asarray(pending)] = np.asarray(sub, np.int8)
+            self.fallback_histories += len(pending)
+        return out
+
+    def check_witness(self, spec: Spec, history: History):
+        """Witness from whichever side is alive; fallback witnesses are
+        the host oracle's own — bit-identical to a clean host run."""
+        if not self.degraded and hasattr(self.device, "check_witness"):
+            try:
+                return watchdog(
+                    lambda: self.device.check_witness(spec, history),
+                    self.policy.timeout_s, label=f"{self.name}.witness")
+            except device_error_types() as e:
+                self._degrade(e)
+        return self.fallback.check_witness(spec, history)
+
+    # ------------------------------------------------------------------
+    def _guarded_dispatch(self, spec, hists):
+        """One slice through the policy: each attempt bounded by the
+        watchdog, retries spaced/bounded by the policy's ladder.  Raises
+        a device-loss error once the ladder is exhausted."""
+        state = {"attempt": 0}
+
+        def attempt():
+            state["attempt"] += 1
+            if state["attempt"] > 1:
+                self.retries += 1
+            return watchdog(
+                lambda: self.device.check_histories(spec, hists),
+                self.policy.timeout_s, label=f"{self.name}.dispatch")
+
+        return self.policy.run(attempt, retriable=device_error_types())
+
+    def _degrade(self, err: BaseException) -> None:
+        self.degraded = True
+        self.degradations += 1
+        self.fallback_engine = getattr(self.fallback, "name",
+                                       type(self.fallback).__name__)
+        self.last_error = f"{type(err).__name__}: {err}"[:200]
+
+    # ------------------------------------------------------------------
+    def resilience(self) -> dict:
+        """The self-describing counter block bench rows/CLI stats embed
+        (:func:`collect_resilience` finds this by convention)."""
+        return {
+            "degradations": self.degradations,
+            "retries": self.retries,
+            "fallback_engine": self.fallback_engine or None,
+            "device_histories": self.device_histories,
+            "fallback_histories": self.fallback_histories,
+            "policy": self.policy.name,
+            **({"last_error": self.last_error} if self.last_error else {}),
+        }
+
+    def search_stats(self):
+        """The wrapped engine's cost record with the resilience counters
+        folded in; fallback nodes are absorbed ALONGSIDE device iters —
+        the hybrid backend's honesty rule (work moved to the host is
+        only a saving when the host's nodes are shown too)."""
+        from ..search.stats import SearchStats, collect_search_stats
+
+        st = collect_search_stats(self.device) or SearchStats()
+        st.engine = self.name
+        st.degradations += self.degradations
+        st.retries += self.retries
+        if self.fallback_engine:
+            st.fallback_engine = self.fallback_engine
+        if self.fallback_histories:
+            st.tail_histories += self.fallback_histories
+            st.absorb(collect_search_stats(self.fallback))
+        return st
+
+
+def collect_resilience(backend) -> dict:
+    """Resilience counters for ANY backend — zeros when it exposes none,
+    so every bench row can stamp the block unconditionally (an artifact
+    that says ``degradations: 0`` is a claim; a missing key is a
+    shrug).  Probes the conventional wrapper attributes like
+    ``collect_search_stats`` does."""
+    for obj in (backend, getattr(backend, "device", None),
+                getattr(backend, "plain", None),
+                getattr(backend, "inner", None)):
+        fn = getattr(obj, "resilience", None)
+        if callable(fn):
+            return fn()
+    return {"degradations": 0, "retries": 0, "fallback_engine": None}

@@ -1,0 +1,164 @@
+"""Env/config-driven fault plane — every degradation path tier-1 testable.
+
+The failure modes that matter here (wedged tunnel, hung dispatch,
+mid-scan device loss) only occur on hardware the CI never has, so the
+degradation code they exercise would otherwise ship untested — exactly
+the code that must not be wrong when the round's one healed window
+opens.  This module simulates them ON THE CPU PLATFORM, at named fault
+sites the production code calls through :func:`inject`.
+
+Syntax (the ``QSM_TPU_FAULTS`` env var, comma-separated rules)::
+
+    QSM_TPU_FAULTS="hang:dispatch:0.3,raise:seize,wedge:probe"
+    QSM_TPU_FAULTS="raise:dispatch@2"        # fire on the 2nd+ hit
+
+    rule    := action ":" site [":" probability] ["@" nth]
+    action  := "hang"   (sleep QSM_TPU_FAULT_HANG_S, default 3600 s,
+                         then raise — a real hang never returns; the
+                         bounded sleep keeps un-watchdogged tests alive)
+             | "raise"  (raise InjectedFault at the site)
+             | "wedge"  (returned to the caller: site-specific
+                         unavailability — a probe reports the tunnel
+                         wedged instead of raising)
+    nth     := fire on the nth hit of the site AND every later one
+               (a lost device stays lost — "mid-scan crash" semantics)
+
+Probability draws come from ONE ``random.Random`` seeded by
+``QSM_TPU_FAULTS_SEED`` (default 0), so a fault schedule is replayable —
+the same discipline the scheduler plane's ``FaultPlan`` follows for
+message-level faults.  The two planes are deliberately separate: a
+``FaultPlan`` perturbs the SYSTEM UNDER TEST (dropped messages, crashed
+processes) and is part of the property being checked; this plane
+perturbs the CHECKER'S OWN infrastructure (device dispatch, probes,
+window seizes) and must never change a verdict — only where it is
+computed.
+
+Fault sites instrumented today: ``probe`` (utils/device.py),
+``dispatch`` (ops/jax_kernel.py, ops/pallas_kernel.py — i.e. every
+device engine entry), ``seize`` (tools/probe_watcher.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import List, Optional
+
+ENV_VAR = "QSM_TPU_FAULTS"
+SEED_VAR = "QSM_TPU_FAULTS_SEED"
+HANG_VAR = "QSM_TPU_FAULT_HANG_S"
+
+ACTIONS = ("hang", "raise", "wedge")
+
+
+class InjectedFault(RuntimeError):
+    """A fault-plane-injected failure (never raised in production: the
+    plane is off unless ``QSM_TPU_FAULTS`` is set)."""
+
+    def __init__(self, site: str, action: str):
+        super().__init__(f"injected {action} at fault site {site!r} "
+                         f"({ENV_VAR}={os.environ.get(ENV_VAR, '')!r})")
+        self.site = site
+        self.action = action
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    action: str
+    site: str
+    p: float = 1.0
+    nth: Optional[int] = None  # fire on the nth hit and every later one
+
+
+class FaultPlane:
+    """Parsed fault rules + the per-site hit/fired accounting."""
+
+    def __init__(self, rules: List[FaultRule], seed: str = "0",
+                 spec_str: str = ""):
+        self.rules = rules
+        self.spec_str = spec_str
+        self.seed_str = seed
+        self.rng = random.Random(f"qsm_tpu_faults:{seed}")
+        self.hits: dict = {}
+        self.fired: dict = {}
+
+    @classmethod
+    def parse(cls, spec_str: str, seed: str = "0") -> "FaultPlane":
+        rules: List[FaultRule] = []
+        for part in spec_str.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            nth = None
+            if "@" in part:
+                part, n = part.rsplit("@", 1)
+                if not n.isdigit() or int(n) < 1:
+                    raise ValueError(
+                        f"bad fault rule {part!r}@{n!r}: @nth wants a "
+                        "positive integer")
+                nth = int(n)
+            fields = part.split(":")
+            if len(fields) not in (2, 3):
+                raise ValueError(
+                    f"bad fault rule {part!r}: want action:site[:p][@n]")
+            action, site = fields[0], fields[1]
+            if action not in ACTIONS:
+                raise ValueError(f"bad fault action {action!r}: "
+                                 f"one of {ACTIONS}")
+            if not site:
+                raise ValueError(f"bad fault rule {part!r}: empty site")
+            p = float(fields[2]) if len(fields) == 3 else 1.0
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"bad fault probability {p!r} "
+                                 f"in {part!r}")
+            rules.append(FaultRule(action, site, p, nth))
+        return cls(rules, seed=seed, spec_str=spec_str)
+
+    def action_for(self, site: str) -> Optional[str]:
+        """The action to perform at this hit of ``site`` (None = none).
+        Counts the hit either way; first matching rule wins."""
+        n = self.hits[site] = self.hits.get(site, 0) + 1
+        for r in self.rules:
+            if r.site != site:
+                continue
+            if r.nth is not None and n < r.nth:
+                continue
+            if r.p < 1.0 and self.rng.random() >= r.p:
+                continue
+            self.fired[site] = self.fired.get(site, 0) + 1
+            return r.action
+        return None
+
+
+_plane: Optional[FaultPlane] = None
+
+
+def active_plane() -> FaultPlane:
+    """The process-wide plane, re-parsed whenever the env changes (tests
+    flip ``QSM_TPU_FAULTS`` between cases via monkeypatch)."""
+    global _plane
+    spec = os.environ.get(ENV_VAR, "")
+    seed = os.environ.get(SEED_VAR, "0")
+    if (_plane is None or _plane.spec_str != spec
+            or _plane.seed_str != seed):
+        _plane = FaultPlane.parse(spec, seed=seed)
+    return _plane
+
+
+def inject(site: str) -> Optional[str]:
+    """THE fault hook.  Production cost when the plane is off: one env
+    read.  With a matching rule: ``raise`` raises :class:`InjectedFault`;
+    ``hang`` sleeps ``QSM_TPU_FAULT_HANG_S`` (default 3600 — long enough
+    that any watchdog fires first) then raises; ``wedge`` is RETURNED so
+    the site applies its own unavailability semantics."""
+    if not os.environ.get(ENV_VAR):
+        return None
+    act = active_plane().action_for(site)
+    if act == "raise":
+        raise InjectedFault(site, act)
+    if act == "hang":
+        time.sleep(float(os.environ.get(HANG_VAR, "3600")))
+        raise InjectedFault(site, act)
+    return act

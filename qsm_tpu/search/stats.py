@@ -46,6 +46,12 @@ class SearchStats:
     segments_total: int = 0     # segdc: segments across them
     ordering: bool = False      # postcondition-aware ordering active
     plan: str = ""              # planner provenance ("" = hand-tuned)
+    # resilience plane (qsm_tpu/resilience): device-loss accounting —
+    # cost records from a degraded run must SAY they degraded, or a
+    # host-fallback rate silently masquerades as a device rate
+    degradations: int = 0       # device-loss events absorbed
+    retries: int = 0            # extra dispatch attempts before degrading
+    fallback_engine: str = ""   # host engine degraded onto ("" = none)
 
     # -- derived -----------------------------------------------------------
     @property
@@ -68,13 +74,15 @@ class SearchStats:
         for f in ("lockstep_iters", "nodes_explored", "memo_prunes",
                   "memo_inserts", "compactions", "chunk_rounds", "rescued",
                   "deferred", "tail_histories", "segments_split",
-                  "segments_total"):
+                  "segments_total", "degradations", "retries"):
             setattr(self, f, getattr(self, f) + getattr(other, f))
         if count_histories:
             self.histories += other.histories
         self.ordering = self.ordering or other.ordering
         if not self.plan:
             self.plan = other.plan
+        if not self.fallback_engine:
+            self.fallback_engine = other.fallback_engine
         return self
 
     # -- rendering ---------------------------------------------------------
@@ -96,24 +104,39 @@ class SearchStats:
             "segs": self.segments_split,
             "ord": int(self.ordering),
             "plan": self.plan,
+            # resilience counters ride every compact record so bench
+            # rows are self-describing about fault handling (a degraded
+            # rate must never read as a clean device rate)
+            "deg": self.degradations,
+            "fb": self.fallback_engine,
         }
 
     def to_timings(self) -> Dict[str, float]:
         """Numeric projection for ``PropertyResult.timings`` (a flat
-        str → float mapping by contract)."""
-        return {
+        str → float mapping by contract).  Resilience counters appear
+        only when nonzero: the property layer keeps its OWN
+        ``resilience_*`` entries for degradations it performed itself
+        (core/property.py), and the two sources merge additively there —
+        emitting zeros here would clobber that accounting."""
+        out = {
             "search_iters_per_history": round(self.iters_per_history, 1),
             "search_nodes_per_history": round(self.nodes_per_history, 1),
             "search_memo_prunes": float(self.memo_prunes),
             "search_rescued": float(self.rescued),
             "search_histories": float(self.histories),
         }
+        if self.degradations:
+            out["resilience_degradations"] = float(self.degradations)
+        if self.retries:
+            out["resilience_retries"] = float(self.retries)
+        return out
 
 
 _COUNTER_FIELDS = ("histories", "lockstep_iters", "nodes_explored",
                    "memo_prunes", "memo_inserts", "compactions",
                    "chunk_rounds", "rescued", "deferred", "tail_histories",
-                   "segments_split", "segments_total")
+                   "segments_split", "segments_total", "degradations",
+                   "retries")
 
 
 def stats_delta(after: Optional[SearchStats],

@@ -14,6 +14,7 @@ import argparse
 import json
 import sys
 import time
+from typing import Optional
 
 from ..core.property import PropertyConfig, prop_concurrent, replay
 from ..models.registry import MODELS, SutFactory, make
@@ -39,7 +40,7 @@ _BACKENDS = ("auto", "auto-tpu", "cpu", "cpp", "tpu", "hybrid-tpu",
 _VERDICT_NAMES = ("VIOLATION", "LINEARIZABLE", "BUDGET_EXCEEDED")
 
 
-def _ensure_device_reachable(timeout_s: float = 45.0) -> None:
+def _ensure_device_reachable(timeout_s: Optional[float] = None) -> None:
     """Fail fast (never hang) before initializing a device backend.
 
     A wedged chip tunnel blocks the first in-process ``jax.devices()``
@@ -74,8 +75,13 @@ def _ensure_device_reachable(timeout_s: float = 45.0) -> None:
             raise _refuse
     if _cpu_first(os.environ.get("JAX_PLATFORMS", "")):
         raise _refuse
+    from ..resilience.policy import preset
     from .device import probe_default_backend
 
+    # bound from the shared probe preset (resilience/policy.py), env- or
+    # caller-overridable — the CLI gate keeps no timeout literal of its own
+    if timeout_s is None:
+        timeout_s = preset("probe").timeout_s
     timeout_s = float(os.environ.get("QSM_TPU_PROBE_TIMEOUT", timeout_s))
     p = probe_default_backend(timeout_s=timeout_s)
     if not p.is_device:
@@ -244,6 +250,13 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--backend", default="auto",
                    choices=_BACKENDS)  # bench keeps default "cpu": its
     # default denominator semantics must not drift silently
+    p.add_argument("--failover", action="store_true",
+                   help="wrap the backend in a FailoverBackend "
+                        "(resilience/failover.py): on dispatch timeout / "
+                        "device loss mid-run, undecided lanes degrade to "
+                        "the exact host ladder (cpp -> memo) and the run "
+                        "completes with identical verdicts; degradations "
+                        "are reported in the timings log")
     p.add_argument("--transport", default="memory",
                    choices=["memory", "tcp"],
                    help="scheduler-plane message transport (tcp = real "
@@ -281,13 +294,19 @@ def cmd_run(args) -> int:
     try:
         t0 = time.perf_counter()
         backend = _make_backend(args.backend, spec)
+        if getattr(args, "failover", False):
+            from ..resilience.failover import FailoverBackend
+
+            backend = FailoverBackend(spec, backend)
         # pass a host-oracle backend through as the oracle too, so
         # _resolve's backend-is-oracle short-circuit fires (re-running an
         # identical search can only repeat the verdict).  "auto" IS the
         # default resolution oracle, and "cpp"/"cpu" would be rebuilt as
         # an equivalent checker inside prop_concurrent otherwise.
+        # (--failover wraps those too; the wrap changes nothing for a
+        # host backend, so the short-circuit is simply forfeited.)
         oracle = (backend if args.backend in ("cpu", "cpp", "auto")
-                  else None)
+                  and not getattr(args, "failover", False) else None)
         res = prop_concurrent(
             spec, sut, cfg, backend=backend, oracle=oracle,
             sut_factory=(SutFactory(args.model, args.impl)
@@ -430,6 +449,7 @@ def cmd_stats(args) -> int:
     engines only; the planner's levers are the kernel driver's)."""
     import numpy as np
 
+    from ..resilience.failover import FailoverBackend, collect_resilience
     from ..search import (collect_search_stats, plan_search, profile_corpus)
     from .corpus import build_corpus
 
@@ -453,6 +473,9 @@ def cmd_stats(args) -> int:
     else:
         backend = _make_backend(args.backend, spec)
         bname = args.backend
+    if args.failover:
+        backend = FailoverBackend(spec, backend)
+        bname = backend.name
     t0 = time.perf_counter()
     v = backend.check_histories(spec, hists)
     dt = time.perf_counter() - t0
@@ -461,6 +484,9 @@ def cmd_stats(args) -> int:
         "model": args.model, "backend": bname,
         "histories": len(hists), "seconds": round(dt, 3),
         "undecided": int((np.asarray(v) == 2).sum()),
+        # fault-handling block (qsm_tpu/resilience): zeros on a clean
+        # run — the stats artifact is self-describing about degradation
+        "resilience": collect_resilience(backend),
         "profile": {
             "max_ops": profile.max_ops,
             "mean_ops": round(profile.mean_ops, 1),
@@ -644,8 +670,9 @@ def cmd_lint(args) -> int:
             # always the JSON form regardless of what stdout renders;
             # INSIDE the guard: an unwritable --out (disk full, bad
             # path) is analyzer trouble, not findings
-            with open(args.out, "w") as f:
-                f.write(doc + "\n")
+            from ..resilience.checkpoint import atomic_write_text
+
+            atomic_write_text(args.out, doc + "\n")
         if args.json:
             print(doc)
         else:
@@ -1025,6 +1052,9 @@ def main(argv=None) -> int:
                    help="run the plan_search-built device checker instead "
                         "of --backend (needs a reachable device, like "
                         "--backend tpu)")
+    p.add_argument("--failover", action="store_true",
+                   help="wrap the backend in a FailoverBackend and report "
+                        "its degradation counters (resilience plane)")
     p.add_argument("--pids", type=int, default=None)
     p.add_argument("--ops", type=int, default=None)
     p.add_argument("--corpus", type=int, default=64)

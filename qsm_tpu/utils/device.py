@@ -61,7 +61,8 @@ class Probe:
         return self.ok and self.platform not in ("cpu", "none")
 
 
-def probe_default_backend(timeout_s: float = 45.0) -> Probe:
+def probe_default_backend(timeout_s: Optional[float] = None,
+                          policy=None, on_attempt=None) -> Probe:
     """Probe the environment's default JAX backend from a subprocess.
 
     The subprocess inherits the default platform selection (axon plugin) —
@@ -71,50 +72,94 @@ def probe_default_backend(timeout_s: float = 45.0) -> Probe:
     (ADVICE.md round 2): operator chip-tuning flags must stay, or the probe
     would validate a different XLA configuration than the in-process
     backend actually initializes with.
-    Bounded: a wedged tunnel yields ``ok=False`` after ``timeout_s`` seconds
-    instead of hanging forever.
+
+    Bounding and retries come from ONE place — a
+    :class:`~qsm_tpu.resilience.policy.RetryPolicy` (default: the
+    ``probe`` preset; bench.py passes ``bench-probe``, the watcher its
+    seize presets).  A wedged tunnel yields ``ok=False`` after the
+    policy's per-attempt timeout instead of hanging forever; multi-attempt
+    policies re-probe on a non-device answer, spaced by the policy's
+    backoff, and return the LAST probe.  ``timeout_s`` (back-compat)
+    overrides the policy's per-attempt bound.  ``on_attempt`` is called
+    with every individual :class:`Probe` (bench.py's probe log).
     """
+    from ..resilience.policy import preset
+
+    if policy is None:
+        policy = preset("probe")
+    if timeout_s is not None:
+        policy = policy.with_(timeout_s=float(timeout_s))
+
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     flags = _strip_host_platform_flag(env.get("XLA_FLAGS", ""))
     if flags:
         env["XLA_FLAGS"] = flags
     else:
         env.pop("XLA_FLAGS", None)
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", _PROBE_SNIPPET],
-            capture_output=True, text=True, timeout=timeout_s, env=env)
-    except subprocess.TimeoutExpired:
-        return Probe(False, "none",
-                     f"backend init exceeded {timeout_s:.0f}s "
-                     "(chip tunnel wedged?)")
-    except OSError as e:  # e.g. fork failure
-        return Probe(False, "none", f"probe subprocess failed: {e!r}")
-    if r.returncode != 0:
-        tail = (r.stderr or r.stdout).strip().splitlines()[-5:]
-        return Probe(False, "none", " | ".join(tail)[-400:])
-    parts = r.stdout.split(maxsplit=2)
-    if len(parts) < 3:
-        return Probe(False, "none", f"unexpected probe output {r.stdout!r}")
-    return Probe(True, parts[0], r.stdout.strip())
+
+    def one_probe() -> Probe:
+        # fault site (resilience/faults.py): a "wedge" simulates the
+        # tunnel down without hardware; hang/raise are caught below so
+        # the probe keeps its never-raises contract
+        from ..resilience.faults import InjectedFault, inject
+
+        try:
+            if inject("probe") == "wedge":
+                return Probe(False, "none",
+                             f"backend init exceeded "
+                             f"{policy.timeout_s:.0f}s "
+                             "(fault-injected wedge)")
+        except InjectedFault as e:
+            return Probe(False, "none", f"fault-injected: {e}")
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SNIPPET],
+                capture_output=True, text=True, timeout=policy.timeout_s,
+                env=env)
+        except subprocess.TimeoutExpired:
+            return Probe(False, "none",
+                         f"backend init exceeded {policy.timeout_s:.0f}s "
+                         "(chip tunnel wedged?)")
+        except OSError as e:  # e.g. fork failure
+            return Probe(False, "none", f"probe subprocess failed: {e!r}")
+        if r.returncode != 0:
+            tail = (r.stderr or r.stdout).strip().splitlines()[-5:]
+            return Probe(False, "none", " | ".join(tail)[-400:])
+        parts = r.stdout.split(maxsplit=2)
+        if len(parts) < 3:
+            return Probe(False, "none",
+                         f"unexpected probe output {r.stdout!r}")
+        return Probe(True, parts[0], r.stdout.strip())
+
+    def attempt() -> Probe:
+        p = one_probe()
+        if on_attempt is not None:
+            on_attempt(p)
+        return p
+
+    return policy.run(attempt, should_retry=lambda p: not p.is_device)
 
 
 def probe_or_force_cpu(force_cpu: bool = False,
-                       probe_timeout_s: float = 45.0):
+                       probe_timeout_s: Optional[float] = None,
+                       policy=None):
     """The artifact-tool preamble, in ONE place (bench.py,
     tools/bench_configs.py, tools/bench_e2e.py all need the identical
     sequence — diverging copies would label fallbacks differently):
     bounded-probe the real chip unless ``force_cpu``; pin this process to
-    the CPU platform when the chip is absent.  Returns
-    ``(on_tpu, probe_detail, header)`` where ``header`` is the provenance
-    dict artifacts embed (device / device_fallback / tpu_probe / iso).
+    the CPU platform when the chip is absent.  Probe bounding/retries ride
+    the shared :class:`RetryPolicy` plumbing of
+    :func:`probe_default_backend` (``policy``/``probe_timeout_s``).
+    Returns ``(on_tpu, probe_detail, header)`` where ``header`` is the
+    provenance dict artifacts embed (device / device_fallback /
+    tpu_probe / iso).
     """
     import datetime
 
     if force_cpu:
         on_tpu, detail = False, "skipped (--force-cpu)"
     else:
-        p = probe_default_backend(probe_timeout_s)
+        p = probe_default_backend(probe_timeout_s, policy=policy)
         on_tpu, detail = p.is_device, p.detail
     if not on_tpu:
         force_cpu_platform()

@@ -134,8 +134,11 @@ def save_regression(path: str, model: str, impl: str, spec: Spec,
         "history": [[o.pid, o.cmd, o.arg, o.resp, o.invoke_time,
                      o.response_time] for o in cx.history.ops],
     }
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=1)
+    # tmp+rename (resilience/checkpoint.py): a regression file is the
+    # only copy of a found bug — a crash mid-write must not corrupt it
+    from ..resilience.checkpoint import atomic_write_json
+
+    atomic_write_json(path, doc, indent=1)
 
 
 def history_from_rows(rows) -> History:

@@ -67,7 +67,7 @@ def test_main_uses_cached_window_when_probe_wedged(tmp_path, monkeypatch,
 
     monkeypatch.setattr(
         device, "probe_default_backend",
-        lambda timeout_s=45.0: device.Probe(False, "none", "wedged (test)"))
+        lambda *a, **kw: device.Probe(False, "none", "wedged (test)"))
     # stub module entry too (bench imports the name from the module)
     monkeypatch.setitem(sys.modules, "qsm_tpu.utils.device", device)
 

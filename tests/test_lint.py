@@ -15,6 +15,7 @@ import pytest
 import qsm_tpu.analysis.fixtures as fixtures
 from qsm_tpu.analysis import (ERROR, Finding, Whitelist, run_lint)
 from qsm_tpu.analysis.engine import (DEFAULT_OPS_FILES,
+                                     DEFAULT_RESILIENCE_FILES,
                                      DEFAULT_SCHED_FILES,
                                      _retrace_corpora)
 from qsm_tpu.analysis.kernel_passes import (VMEM_BUDGET_BYTES,
@@ -36,10 +37,15 @@ def report():
 
 def test_in_tree_corpus_is_clean(report):
     """All eight families + the five engine modules + the scheduler
-    plane: zero non-whitelisted error findings."""
+    plane + the device/tool modules: zero non-whitelisted error
+    findings."""
     assert sorted(MODELS) == report.models  # really covered everything
     assert len(DEFAULT_OPS_FILES) == 5      # the five lineariser engines
     assert len(DEFAULT_SCHED_FILES) == 4
+    # every engine module is also resilience-scanned, plus the device
+    # plumbing and the artifact tools (bench.py, tools/)
+    assert len(DEFAULT_RESILIENCE_FILES) >= 12
+    assert "resilience" in report.passes
     assert report.ok, "\n".join(
         f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
 
@@ -106,6 +112,38 @@ def test_unseeded_random_construction_is_flagged(tmp_path):
     findings = check_sched_file(str(p))
     assert [f.rule_id for f in findings] == ["QSM-DET-RANDOM"]
     assert "UNSEEDED" in findings[0].message
+
+
+def test_unbounded_device_probe_is_caught():
+    """The resilience pass's bulb check: the bare jax.devices(), the
+    timeoutless subprocess wait and the probe-timeout literal each fire
+    their rule; the watchdog-bounded twin in the same fixture class must
+    NOT be flagged (a pass that cries wolf on the sanctioned form gets
+    whitelisted into uselessness)."""
+    from qsm_tpu.analysis.resilience_passes import check_resilience_file
+
+    findings = check_resilience_file(fixtures.__file__)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule_id, []).append(f)
+    assert len(by_rule.pop("QSM-RES-DEVICES")) == 1   # bounded twin clean
+    assert len(by_rule.pop("QSM-RES-SUBPROC")) == 1
+    lit = by_rule.pop("QSM-RES-TIMEOUT-LITERAL")
+    assert len(lit) == 1 and lit[0].severity == "warning"
+    assert not by_rule  # nothing else fires on the fixture module
+
+
+def test_subprocess_with_timeout_is_clean(tmp_path):
+    """True-negative pin: the repo's own bounded-subprocess idiom
+    (probe/compile calls always pass timeout=) must not be flagged."""
+    from qsm_tpu.analysis.resilience_passes import check_resilience_file
+
+    p = tmp_path / "stub.py"
+    p.write_text("import subprocess, sys\n"
+                 "def probe(t):\n"
+                 "    return subprocess.run([sys.executable, '-c', "
+                 "'pass'], capture_output=True, timeout=t)\n")
+    assert check_resilience_file(str(p)) == []
 
 
 def test_dtype_pass_flags_float_state():

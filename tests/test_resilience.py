@@ -1,0 +1,432 @@
+"""The resilience-plane tier-1 gate (ISSUE 3 acceptance): a device
+engine that hangs or dies mid-run degrades to the host fallback with
+verdicts and witnesses bit-identical to a clean host run across four
+model families; a bench scan killed after N cells resumes with
+``--resume`` re-running zero completed cells; the retry/deadline policy
+and the fault plane behave exactly as documented — all on the CPU
+platform, no hardware."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from qsm_tpu.models.registry import MODELS
+from qsm_tpu.ops.backend import Verdict, device_error_types
+from qsm_tpu.ops.jax_kernel import JaxTPU
+from qsm_tpu.resilience import faults as faults_mod
+from qsm_tpu.resilience.checkpoint import (CellJournal, atomic_write_json,
+                                           atomic_write_text)
+from qsm_tpu.resilience.failover import (FailoverBackend,
+                                         collect_resilience,
+                                         host_fallback)
+from qsm_tpu.resilience.faults import FaultPlane, InjectedFault
+from qsm_tpu.resilience.policy import (PRESETS, RetryPolicy,
+                                       WatchdogTimeout, preset, watchdog)
+from qsm_tpu.utils.corpus import build_corpus
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+# The acceptance families: every one has an atomic and a racy impl, so
+# the degraded corpus carries both LINEARIZABLE and VIOLATION verdicts.
+FAMILIES = ("register", "cas", "queue", "kv")
+
+
+@pytest.fixture
+def faultenv(monkeypatch):
+    """Install a fault-plane schedule and force a fresh parse — the
+    process-global plane carries per-site hit counts, and an @nth rule
+    in one test must not inherit another test's hits."""
+
+    def set_faults(spec: str, seed: str = "0", hang_s=None):
+        monkeypatch.setenv(faults_mod.ENV_VAR, spec)
+        monkeypatch.setenv(faults_mod.SEED_VAR, seed)
+        if hang_s is not None:
+            monkeypatch.setenv(faults_mod.HANG_VAR, str(hang_s))
+        monkeypatch.setattr(faults_mod, "_plane", None)
+
+    yield set_faults
+    monkeypatch.setattr(faults_mod, "_plane", None)
+
+
+def _corpus(name, n=6, pids=2, ops=8):
+    entry = MODELS[name]
+    spec = entry.make_spec()
+    impls = (entry.impls["atomic"], entry.impls["racy"])
+    return spec, build_corpus(spec, impls, n=n, n_pids=pids, max_ops=ops,
+                              seed_prefix=f"resil_{name}")
+
+
+# =====================================================================
+# RetryPolicy / watchdog — ONE policy for the whole stack
+# =====================================================================
+
+def test_policy_retries_then_returns_first_success():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept = []
+    pol = RetryPolicy(attempts=4, backoff_s=1.0, backoff_factor=2.0)
+    assert pol.run(flaky, sleep=slept.append) == "ok"
+    assert len(calls) == 3
+    assert slept == [1.0, 2.0]  # exponential spacing, stops on success
+
+
+def test_policy_exhausted_ladder_raises_last_error():
+    pol = RetryPolicy(attempts=2, backoff_s=0.0)
+    with pytest.raises(OSError, match="always"):
+        pol.run(lambda: (_ for _ in ()).throw(OSError("always")),
+                sleep=lambda d: None)
+
+
+def test_policy_should_retry_returns_last_rejected_value():
+    vals = iter([1, 2, 3])
+    pol = RetryPolicy(attempts=3, backoff_s=0.0)
+    out = pol.run(lambda: next(vals), should_retry=lambda v: v < 10,
+                  sleep=lambda d: None)
+    assert out == 3  # ladder exhausted: the caller sees the final state
+
+
+def test_policy_deadline_stops_ladder_before_attempts():
+    calls = []
+    pol = RetryPolicy(attempts=10, backoff_s=100.0, deadline_s=1.0)
+    with pytest.raises(OSError):
+        # first retry would start at t+100s > deadline: one attempt only
+        pol.run(lambda: calls.append(1) or
+                (_ for _ in ()).throw(OSError("x")),
+                sleep=lambda d: None)
+    assert len(calls) == 1
+
+
+def test_policy_jitter_is_bounded_and_seeded():
+    import random
+
+    pol = RetryPolicy(attempts=4, backoff_s=10.0, backoff_factor=1.0,
+                      jitter_frac=0.5)
+    d1 = list(pol.delays(random.Random(7)))
+    d2 = list(pol.delays(random.Random(7)))
+    assert d1 == d2  # replayable
+    assert all(5.0 <= d <= 15.0 for d in d1)
+
+
+def test_presets_exist_and_unknown_name_is_a_clean_error():
+    for name in ("probe", "watcher-probe", "window-reprobe",
+                 "bench-probe", "seize-probe", "dispatch"):
+        assert PRESETS[name].name == name
+    assert preset("bench-probe").attempts == 3
+    with pytest.raises(KeyError, match="bench-probe"):
+        preset("nope")
+    # derived overrides keep provenance in the name
+    assert preset("probe").with_(timeout_s=1.0).name == "probe*"
+
+
+def test_watchdog_abandons_hung_call_and_relays_errors():
+    import time as _time
+
+    assert watchdog(lambda: 42, None) == 42          # inline, no thread
+    assert watchdog(lambda: 42, 5.0) == 42
+    with pytest.raises(WatchdogTimeout, match="abandoned"):
+        watchdog(lambda: _time.sleep(3.0), 0.05, label="t")
+    with pytest.raises(ValueError, match="mine"):
+        watchdog(lambda: (_ for _ in ()).throw(ValueError("mine")), 5.0)
+
+
+# =====================================================================
+# Fault plane — QSM_TPU_FAULTS
+# =====================================================================
+
+def test_fault_rule_parsing_and_errors():
+    plane = FaultPlane.parse("hang:dispatch:0.3,raise:seize,wedge:probe")
+    assert [(r.action, r.site, r.p) for r in plane.rules] == [
+        ("hang", "dispatch", 0.3), ("raise", "seize", 1.0),
+        ("wedge", "probe", 1.0)]
+    assert FaultPlane.parse("raise:dispatch@2").rules[0].nth == 2
+    for bad in ("explode:dispatch", "raise:", "raise:x:2.0",
+                "raise:dispatch@0", "raise:dispatch@x", "justasite"):
+        with pytest.raises(ValueError):
+            FaultPlane.parse(bad)
+
+
+def test_fault_nth_fires_on_nth_hit_and_every_later_one():
+    plane = FaultPlane.parse("raise:dispatch@3")
+    assert [plane.action_for("dispatch") for _ in range(5)] == \
+        [None, None, "raise", "raise", "raise"]  # a lost device stays lost
+
+
+def test_fault_probability_draws_are_seed_replayable():
+    a = FaultPlane.parse("raise:dispatch:0.5", seed="11")
+    b = FaultPlane.parse("raise:dispatch:0.5", seed="11")
+    fires = [a.action_for("dispatch") for _ in range(32)]
+    assert fires == [b.action_for("dispatch") for _ in range(32)]
+    assert None in fires and "raise" in fires  # actually probabilistic
+
+
+def test_inject_is_a_noop_when_plane_is_off(faultenv, monkeypatch):
+    monkeypatch.delenv(faults_mod.ENV_VAR, raising=False)
+    assert faults_mod.inject("dispatch") is None
+
+
+def test_inject_raise_wedge_and_bounded_hang(faultenv):
+    faultenv("raise:seize,wedge:probe,hang:dispatch", hang_s=0.01)
+    with pytest.raises(InjectedFault, match="seize"):
+        faults_mod.inject("seize")
+    assert faults_mod.inject("probe") == "wedge"
+    with pytest.raises(InjectedFault, match="dispatch"):
+        faults_mod.inject("dispatch")  # hang_s elapses, then raises
+
+
+def test_probe_wedge_fault_yields_not_ok_without_hardware(faultenv):
+    from qsm_tpu.utils.device import probe_default_backend
+
+    faultenv("wedge:probe")
+    p = probe_default_backend(policy=preset("probe"))
+    assert not p.is_device and "wedge" in p.detail
+
+
+# =====================================================================
+# The acceptance core: degraded runs bit-identical to a clean host run
+# =====================================================================
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_dead_device_degrades_bit_identical(family, faultenv):
+    """Every dispatch raises (device dead on arrival): verdicts across
+    atomic+racy corpora equal a clean host-ladder run, bit for bit."""
+    spec, hists = _corpus(family)
+    clean = host_fallback(spec).check_histories(spec, hists)
+
+    faultenv("raise:dispatch")
+    fo = FailoverBackend(spec, JaxTPU(spec),
+                         policy=preset("dispatch").with_(
+                             attempts=1, backoff_s=0.0))
+    got = fo.check_histories(spec, hists)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(clean))
+    r = fo.resilience()
+    assert r["degradations"] == 1
+    assert r["fallback_histories"] == len(hists)
+    assert r["device_histories"] == 0
+    assert r["fallback_engine"]
+    # the corpora genuinely exercise both verdicts
+    assert {int(Verdict.LINEARIZABLE)} <= set(np.asarray(clean).tolist())
+
+
+def test_midrun_loss_banks_device_verdicts_and_degrades_rest(faultenv):
+    """The device dies on the SECOND dispatch slice: slice-1 verdicts
+    are preserved from the device, the undecided remainder re-dispatches
+    to the host ladder, and the merged result equals a clean host run."""
+    spec, hists = _corpus("cas", n=8)
+    clean = host_fallback(spec).check_histories(spec, hists)
+
+    faultenv("raise:dispatch@2")
+    fo = FailoverBackend(spec, JaxTPU(spec), dispatch_lanes=3,
+                         policy=preset("dispatch").with_(
+                             attempts=2, backoff_s=0.0))
+    got = fo.check_histories(spec, hists)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(clean))
+    r = fo.resilience()
+    assert r["degradations"] == 1
+    assert r["device_histories"] == 3      # slice 1 banked
+    assert r["fallback_histories"] == 5    # slices 2+3 degraded
+    assert r["retries"] == 1               # the policy retried once first
+    # the cost record carries the same story into bench rows
+    st = fo.search_stats()
+    assert st.degradations == 1 and st.fallback_engine
+
+
+def test_hung_dispatch_is_abandoned_and_degrades(faultenv):
+    """A HANGING dispatch (the round-1 wedged-tunnel mode): the watchdog
+    abandons the call and the run completes on the host ladder with
+    identical verdicts."""
+    spec, hists = _corpus("cas")
+    clean = host_fallback(spec).check_histories(spec, hists)
+
+    faultenv("hang:dispatch", hang_s=5)
+    fo = FailoverBackend(spec, JaxTPU(spec),
+                         policy=preset("dispatch").with_(
+                             attempts=1, timeout_s=0.1, backoff_s=0.0))
+    got = fo.check_histories(spec, hists)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(clean))
+    assert fo.degraded and "abandoned" in fo.last_error
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_degraded_witness_is_bit_identical(family, faultenv):
+    """Witnesses after degradation are the host oracle's own — the
+    (verdict, linearization) pair equals a clean host run's exactly."""
+    spec, hists = _corpus(family)
+    ref = host_fallback(spec)
+
+    faultenv("raise:dispatch")
+    fo = FailoverBackend(spec, JaxTPU(spec),
+                         policy=preset("dispatch").with_(
+                             attempts=1, backoff_s=0.0))
+    for h in hists[:3]:
+        assert fo.check_witness(spec, h) == ref.check_witness(spec, h)
+    assert fo.degraded
+
+
+def test_hybrid_backend_degrades_in_place(faultenv):
+    """The hybrid engine's own degradation hook: device loss sends the
+    whole batch to the exact tail; verdicts equal a clean host run and
+    the resilience block records the event."""
+    from qsm_tpu.ops.hybrid import HybridDevice
+
+    spec, hists = _corpus("queue")
+    clean = host_fallback(spec).check_histories(spec, hists)
+
+    faultenv("raise:dispatch")
+    hy = HybridDevice(spec)
+    got = hy.check_histories(spec, hists)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(clean))
+    r = hy.resilience()
+    assert r["degradations"] == 1 and r["fallback_engine"]
+    assert hy.search_stats().degradations == 1
+
+
+def test_property_run_survives_midrun_device_loss(faultenv):
+    """The property layer itself: a backend that dies mid-run degrades
+    dispatch to the resolution oracle — the run completes, ok semantics
+    are unchanged, and timings record the degradation."""
+    from qsm_tpu.core.property import PropertyConfig, prop_concurrent
+
+    entry = MODELS["cas"]
+    spec = entry.make_spec()
+    cfg = PropertyConfig(n_trials=6, n_pids=2, max_ops=8, seed=5)
+
+    faultenv("raise:dispatch")
+    res = prop_concurrent(spec, entry.impls["atomic"](spec), cfg,
+                          backend=JaxTPU(spec))
+    assert res.ok, res.counterexample
+    assert res.timings.get("resilience_degradations", 0) >= 1
+
+
+def test_collect_resilience_zeros_for_plain_backends():
+    """Bench rows stamp the block unconditionally: an engine with no
+    resilience hook reports explicit zeros (a claim), not a missing key
+    (a shrug)."""
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+
+    r = collect_resilience(WingGongCPU())
+    assert r == {"degradations": 0, "retries": 0, "fallback_engine": None}
+
+
+def test_injected_fault_is_in_the_device_error_taxonomy():
+    errs = device_error_types()
+    assert InjectedFault in errs and WatchdogTimeout in errs
+
+
+# =====================================================================
+# Checkpoint/resume — partial progress is bankable
+# =====================================================================
+
+def test_atomic_write_leaves_no_tmp_and_replaces_whole(tmp_path):
+    p = tmp_path / "a.json"
+    atomic_write_json(str(p), {"x": 1})
+    atomic_write_json(str(p), {"x": 2}, indent=1)
+    assert json.loads(p.read_text()) == {"x": 2}
+    assert [f.name for f in tmp_path.iterdir()] == ["a.json"]
+
+
+def test_cell_journal_banks_resumes_and_counts(tmp_path):
+    path = str(tmp_path / "scan.jsonl")
+    j1 = CellJournal(path, {"artifact": "s", "device_fallback": "cpu"})
+    j1.emit("b256", {"rate": 1.0})
+    j1.emit("b512", {"rate": 2.0})
+    j1.emit("b1024", {"skipped": "time box exhausted"})
+
+    j2 = CellJournal(path, {"artifact": "s", "device_fallback": "cpu"},
+                     resume=True)
+    assert j2.complete("b256") == {"cell": "b256", "rate": 1.0}
+    assert j2.complete("b512")["rate"] == 2.0
+    assert j2.complete("b1024") is None   # skipped markers re-run
+    assert j2.resumed_cells == 2
+    assert j2.header["resumed_cells"] == 2
+
+
+def test_cell_journal_rejects_mismatched_provenance(tmp_path):
+    """A CPU-fallback scan must never pre-satisfy a device scan's
+    cells — and the mismatch guard must not DESTROY the incompatible
+    artifact either (it exists to protect banked measurements): the
+    prior file moves aside to <path>.pre-resume."""
+    path = str(tmp_path / "scan.jsonl")
+    j1 = CellJournal(path, {"artifact": "s", "device_fallback": "cpu"})
+    j1.emit("b256", {"rate": 1.0})
+    j2 = CellJournal(path, {"artifact": "s", "device_fallback": None},
+                     resume=True)
+    assert j2.resumed_cells == 0 and j2.complete("b256") is None
+    saved = [json.loads(ln)
+             for ln in open(path + ".pre-resume").read().splitlines()]
+    assert saved[1]["rate"] == 1.0  # the incompatible bank survives
+
+
+def test_cell_journal_drops_truncated_trailing_line(tmp_path):
+    """A mid-write kill under a pre-journal scheme leaves half a row;
+    resume adopts everything before it and simply re-runs that cell."""
+    path = tmp_path / "scan.jsonl"
+    path.write_text(
+        json.dumps({"artifact": "s", "device_fallback": "cpu"}) + "\n"
+        + json.dumps({"cell": "b256", "rate": 1.0}) + "\n"
+        + '{"cell": "b512", "ra')  # killed mid-write
+    j = CellJournal(str(path), {"artifact": "s",
+                                "device_fallback": "cpu"}, resume=True)
+    assert j.resumed_cells == 1
+    assert j.complete("b256") is not None
+    assert j.complete("b512") is None
+    # and the rewrite healed the file: every line parses now
+    for ln in path.read_text().splitlines():
+        json.loads(ln)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test", os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_scan_killed_after_n_cells_resumes_with_zero_reruns(
+        tmp_path, monkeypatch, capsys):
+    """THE acceptance scenario: a bench_configs scan killed after 3 of 7
+    cells banks those 3; the ``--resume`` re-run measures ONLY the other
+    4 and inherits the banked rows bit-identically."""
+    bc = _load_tool("bench_configs")
+    out = str(tmp_path / "BENCH_CONFIGS.json")
+    measured = []
+
+    def fake_bench_config(model, on_tpu, n_corpus):
+        if len(measured) == 3:
+            raise KeyboardInterrupt  # the window closes / kill -INT
+        measured.append(model)
+        return {"model": model, "rate": float(len(measured))}
+
+    monkeypatch.setattr(bc, "bench_config", fake_bench_config)
+    with pytest.raises(KeyboardInterrupt):
+        bc.main(["--out", out, "--force-cpu"])
+    banked = [json.loads(ln) for ln in open(out)]
+    assert len(banked) == 1 + 3  # header + the 3 cells paid for
+
+    # --- the next window: --resume re-runs ZERO completed cells -------
+    measured2 = []
+    monkeypatch.setattr(
+        bc, "bench_config",
+        lambda model, on_tpu, n_corpus:
+            measured2.append(model) or {"model": model, "rate": -1.0})
+    assert bc.main(["--out", out, "--force-cpu", "--resume"]) == 0
+    assert not set(measured) & set(measured2)   # zero re-runs
+    assert len(measured2) == 7 - 3
+    rows = [json.loads(ln) for ln in open(out)]
+    assert rows[0]["resumed_cells"] == 3
+    assert len(rows) == 1 + 7
+    by_model = {r["cell"]: r for r in rows[1:]}
+    for i, m in enumerate(measured):
+        assert by_model[m]["rate"] == float(i + 1)  # inherited, not -1

@@ -73,8 +73,14 @@ def test_seize_all_banked_is_silent(w, tmp_path, monkeypatch):
     cycle)."""
     (tmp_path / "BENCH_TPU_WINDOW.json").write_text(
         json.dumps({"extras": {"device_batch": 4096, "unroll": 8}}))
-    (tmp_path / "BENCH_CONFIGS_TPU_WINDOW.json").write_text("{}")
-    (tmp_path / "BENCH_E2E_TPU_WINDOW.json").write_text("{}")
+    # FULL-row artifacts: completeness is row-count-based now (a
+    # header-only bank from a timed-out window gets chased resumably)
+    (tmp_path / "BENCH_CONFIGS_TPU_WINDOW.json").write_text(
+        "\n".join(["{}"] + [json.dumps({"cell": f"m{i}", "rate": 1.0})
+                            for i in range(w.CONFIGS_MIN_ROWS)]) + "\n")
+    (tmp_path / "BENCH_E2E_TPU_WINDOW.json").write_text(
+        "\n".join(["{}"] + [json.dumps({"cell": f"r{i}", "ok": True})
+                            for i in range(w.E2E_MIN_ROWS)]) + "\n")
     scale = [{"h": 1, "device_fallback": None}] + [
         {"batch": b, "rate_h_per_s": 1.0, "wrong": 0}
         for b in (4096, 16384, 65536, 262144)] + [
@@ -109,7 +115,7 @@ def test_fresh_headline_still_chases_missing_upgrades(w, tmp_path,
     chased = []
     monkeypatch.setattr(
         w, "_run_tool",
-        lambda script, out, timeout, label, min_rows=0, extra_args=():
+        lambda script, out, timeout, label, min_rows=0, extra_args=(), resumable=False:
             chased.append(label))
     monkeypatch.setattr(
         w, "_run_window_bench",
@@ -137,7 +143,7 @@ def test_stale_headline_is_rebenched(w, tmp_path, monkeypatch):
     ran = []
     monkeypatch.setattr(
         w, "_run_tool",
-        lambda script, out, timeout, label, min_rows=0, extra_args=():
+        lambda script, out, timeout, label, min_rows=0, extra_args=(), resumable=False:
             ran.append(label))
     monkeypatch.setattr(
         w, "_run_window_bench",
@@ -163,7 +169,7 @@ def test_scale_decision_triggers_headline_rescale(w, tmp_path,
     ran = []
     monkeypatch.setattr(
         w, "_run_tool",
-        lambda script, out, timeout, label, min_rows=0, extra_args=():
+        lambda script, out, timeout, label, min_rows=0, extra_args=(), resumable=False:
             ran.append(label))
     monkeypatch.setattr(
         w, "_run_window_bench",
@@ -188,7 +194,7 @@ def test_scale_unroll_decision_triggers_headline_rescale(w, tmp_path,
     ran = []
     monkeypatch.setattr(
         w, "_run_tool",
-        lambda script, out, timeout, label, min_rows=0, extra_args=():
+        lambda script, out, timeout, label, min_rows=0, extra_args=(), resumable=False:
             ran.append(label))
     monkeypatch.setattr(
         w, "_run_window_bench",
@@ -210,7 +216,7 @@ def test_run_tool_timeout_promotion_is_monotonic(w, tmp_path,
 
     monkeypatch.setattr(
         w, "probe_default_backend",
-        lambda t=30: type("P", (), {"is_device": True, "detail": "tpu"})())
+        lambda *a, **kw: type("P", (), {"is_device": True, "detail": "tpu"})())
 
     def fake_run(cmd, **kw):
         # the tool writes a header-only tmp, then "hangs" past timeout
@@ -235,7 +241,7 @@ def test_run_tool_timeout_promotes_bigger_partial(w, tmp_path,
 
     monkeypatch.setattr(
         w, "probe_default_backend",
-        lambda t=30: type("P", (), {"is_device": True, "detail": "tpu"})())
+        lambda *a, **kw: type("P", (), {"is_device": True, "detail": "tpu"})())
 
     def fake_run(cmd, **kw):
         tmp = cmd[cmd.index("--out") + 1]
@@ -373,3 +379,106 @@ def test_scale_completeness_is_content_based(w, tmp_path):
     rows[0]["device_fallback"] = "cpu"
     p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
     assert w._scale_complete(str(p)) is False
+
+
+def test_scale_complete_distrusts_truncated_artifact(w, tmp_path):
+    """A scan killed mid-write under a pre-journal scheme leaves half a
+    JSON line at the tail; completeness must read False — a window that
+    re-runs a complete-looking-but-corrupt scan loses minutes, a window
+    that trusts one loses the whole diagnostic set."""
+    p = tmp_path / "BENCH_SCALE_TPU_WINDOW.json"
+    rows = [{"artifact": "s", "device_fallback": None}] + [
+        {"batch": b, "rate_h_per_s": 1.0, "wrong": 0}
+        for b in (4096, 16384, 65536, 262144)] + [
+        {"variant": "unroll1", "rate_h_per_s": 1.0},
+        {"variant": "pallas", "rate_h_per_s": 1.0},
+        {"variant": "budget2k", "rate_h_per_s": 1.0}]
+    whole = "\n".join(json.dumps(r) for r in rows) + "\n"
+    p.write_text(whole)
+    assert w._scale_complete(str(p)) is True  # the uncut control
+
+    p.write_text(whole + '{"variant": "budget2k", "rate_h_')  # mid-write
+    assert w._scale_complete(str(p)) is False
+
+    p.write_text("")  # zero-byte artifact (killed before the header)
+    assert w._scale_complete(str(p)) is False
+    assert w._scale_complete(str(tmp_path / "absent.json")) is False
+
+
+def test_tool_rows_counts_only_parseable_measured_rows(w, tmp_path):
+    """_tool_rows against a mid-write tail: the garbled line is not a
+    row, the intact measured rows before it still count (promotion and
+    min_rows gating both ride this number), and a header-only or
+    missing artifact counts zero."""
+    p = tmp_path / "art.json"
+    p.write_text(
+        json.dumps({"artifact": "x", "device_fallback": None}) + "\n"
+        + json.dumps({"batch": 4096, "rate_h_per_s": 1.0}) + "\n"
+        + json.dumps({"batch": 16384, "skipped": "time box"}) + "\n"
+        + '{"batch": 65536, "rate_h')  # killed mid-write
+    assert w._tool_rows(str(p)) == 1
+
+    p.write_text(json.dumps({"artifact": "x"}) + "\n")
+    assert w._tool_rows(str(p)) == 0  # header only
+    assert w._tool_rows(str(tmp_path / "absent.json")) == 0
+
+
+def test_run_tool_resume_seeds_tmp_and_passes_resume_flag(w, tmp_path,
+                                                          monkeypatch):
+    """The resumable path end to end: the banked partial is copied to
+    the tool's tmp output, --resume rides the command line, and the
+    finished scan (more rows than the bank) is promoted."""
+    out = tmp_path / "BENCH_SCALE_TPU_WINDOW.json"
+    bank = [{"artifact": "s", "device_fallback": None},
+            {"cell": "b4096", "batch": 4096, "rate_h_per_s": 1.0}]
+    out.write_text("\n".join(json.dumps(r) for r in bank) + "\n")
+
+    monkeypatch.setattr(
+        w, "probe_default_backend",
+        lambda *a, **kw: type("P", (), {"is_device": True,
+                                        "detail": "tpu"})())
+    seen = {}
+
+    def fake_run(cmd, **kw):
+        tmp = cmd[cmd.index("--out") + 1]
+        seen["resume"] = "--resume" in cmd
+        # the tool saw the seeded bank (CellJournal would adopt it)...
+        seen["seeded_rows"] = len(open(tmp).read().splitlines())
+        # ...and finishes the scan
+        rows = bank + [{"cell": "b16384", "batch": 16384,
+                        "rate_h_per_s": 2.0}]
+        with open(tmp, "w") as f:
+            f.write("\n".join(json.dumps(r) for r in rows) + "\n")
+        return type("R", (), {"returncode": 0, "stdout": "", "stderr": ""})()
+
+    monkeypatch.setattr(w.subprocess, "run", fake_run)
+    w._run_tool("bench_scale.py", str(out), 60.0, "window_scale",
+                min_rows=2, resumable=True)
+    assert seen == {"resume": True, "seeded_rows": 2}
+    kept = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert len(kept) == 3  # promoted: the finished scan
+    ev = [e for e in _events(w) if e.get("event") == "window_scale"]
+    assert ev and ev[-1]["ok"] is True
+
+
+def test_partial_e2e_and_configs_banks_are_chased_resumably(
+        w, tmp_path, monkeypatch):
+    """A header-only (or few-row) artifact promoted from a timed-out
+    window is NOT complete: the next window must re-run the tool with
+    --resume semantics so the banked cells are adopted and only the
+    missing ones are measured."""
+    (tmp_path / "BENCH_TPU_WINDOW.json").write_text(
+        json.dumps({"extras": {"device_batch": 4096, "unroll": 8}}))
+    (tmp_path / "BENCH_E2E_TPU_WINDOW.json").write_text(
+        "{}\n" + json.dumps({"cell": "memo:atomic:tb1", "ok": True})
+        + "\n")
+    (tmp_path / "BENCH_CONFIGS_TPU_WINDOW.json").write_text("{}\n")
+    calls = []
+    monkeypatch.setattr(
+        w, "_run_tool",
+        lambda script, out, timeout, label, min_rows=0, extra_args=(),
+        resumable=False: calls.append((label, min_rows, resumable)))
+    monkeypatch.setattr(w, "_run_window_bench", lambda *a, **k: True)
+    w._seize_window(600.0)
+    assert ("window_e2e", w.E2E_MIN_ROWS, True) in calls
+    assert ("window_configs", w.CONFIGS_MIN_ROWS, True) in calls

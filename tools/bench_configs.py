@@ -118,26 +118,34 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="/root/repo/BENCH_CONFIGS_r05.json")
     ap.add_argument("--force-cpu", action="store_true")
-    ap.add_argument("--probe-timeout", type=float, default=45.0)
+    ap.add_argument("--probe-timeout", type=float, default=None,
+                    help="override the probe preset's per-attempt bound "
+                         "(resilience/policy.py)")
     ap.add_argument("--corpus", type=int, default=None,
                     help="override corpus size (default 128 cpu / 256 tpu)")
+    ap.add_argument("--resume", action="store_true",
+                    help="adopt completed per-model rows from an "
+                         "existing --out journal instead of re-measuring")
     args = ap.parse_args(argv)
 
+    from qsm_tpu.resilience.checkpoint import CellJournal
     from qsm_tpu.utils.device import probe_or_force_cpu
 
     on_tpu, _detail, header = probe_or_force_cpu(args.force_cpu,
                                                  args.probe_timeout)
     n_corpus = args.corpus or (256 if on_tpu else 128)
-    # incremental writes so a window that closes mid-matrix still banks
-    # the configs already measured
-    with open(args.out, "w") as f:
-        f.write(json.dumps({"artifact": "bench_configs", **header}) + "\n")
+    # per-model journal (resilience/checkpoint.py): rows land atomically
+    # so a window that closes mid-matrix still banks the configs already
+    # measured, and --resume re-runs zero of them
+    journal = CellJournal(args.out, {"artifact": "bench_configs",
+                                     **header}, resume=args.resume)
     for model in ("register", "ticket", "cas", "queue", "kv",
                   "set", "stack"):
-        rec = bench_config(model, on_tpu, n_corpus)
+        rec = journal.complete(model)
+        if rec is None:
+            rec = journal.emit(model, bench_config(model, on_tpu,
+                                                   n_corpus))
         print(json.dumps(rec), flush=True)
-        with open(args.out, "a") as f:
-            f.write(json.dumps(rec) + "\n")
     return 0
 
 

@@ -26,6 +26,7 @@ def run_one(label: str, backend_name: str, make_backend, sut_name: str,
             n_trials: int, trial_batch: int = 1) -> dict:
     from qsm_tpu.core.property import PropertyConfig, prop_concurrent
     from qsm_tpu.models.registry import make
+    from qsm_tpu.resilience.failover import collect_resilience
 
     spec, sut = make("cas", sut_name)
     backend = make_backend(spec)
@@ -36,6 +37,7 @@ def run_one(label: str, backend_name: str, make_backend, sut_name: str,
     dt = time.perf_counter() - t0
     timings = {key: round(v, 3) for key, v in sorted(res.timings.items())}
     accounted = sum(res.timings.values())
+    rz = collect_resilience(backend)
     return {
         "run": label, "backend": backend_name, "sut": sut_name,
         "ok": res.ok, "trials_run": res.trials_run,
@@ -49,6 +51,18 @@ def run_one(label: str, backend_name: str, make_backend, sut_name: str,
                         for key, v in sorted(res.timings.items())},
         "shrink_steps": (res.counterexample.shrink_steps
                          if res.counterexample else 0),
+        # fault-handling self-description (qsm_tpu/resilience).  The
+        # timings keys already fold the backend's own counters together
+        # with property-layer degrade-to-oracle events (additive merge in
+        # prop_concurrent), so they are the complete per-run count;
+        # collect_resilience supplies the engine label and the zeros.
+        "resilience": {
+            "degradations": int(res.timings.get(
+                "resilience_degradations", rz.get("degradations", 0))),
+            "retries": int(res.timings.get(
+                "resilience_retries", rz.get("retries", 0))),
+            "fallback_engine": rz.get("fallback_engine"),
+        },
     }
 
 
@@ -56,8 +70,14 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="/root/repo/BENCH_E2E_r05.json")
     ap.add_argument("--force-cpu", action="store_true")
-    ap.add_argument("--probe-timeout", type=float, default=45.0)
+    ap.add_argument("--probe-timeout", type=float, default=None,
+                    help="override the probe preset's per-attempt bound "
+                         "(resilience/policy.py)")
     ap.add_argument("--trials", type=int, default=150)
+    ap.add_argument("--resume", action="store_true",
+                    help="adopt completed rows from an existing --out "
+                         "journal (same artifact + device provenance) "
+                         "instead of re-measuring them")
     args = ap.parse_args(argv)
 
     from qsm_tpu.utils.device import probe_or_force_cpu
@@ -67,15 +87,17 @@ def main(argv=None) -> int:
 
     from qsm_tpu.ops.jax_kernel import JaxTPU
     from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+    from qsm_tpu.resilience.checkpoint import CellJournal
 
-    # incremental writes: a window that closes mid-run still banks the
-    # rows already measured (round-4's window_e2e died twice leaving
-    # nothing — the all-at-the-end write was the reason)
-    with open(args.out, "w") as f:
-        f.write(json.dumps({
-            "artifact": "bench_e2e",
-            "config": "cas 32ops x 8pids, 4 schedules", **header,
-        }) + "\n")
+    # per-cell journal (resilience/checkpoint.py): every row lands
+    # atomically the moment it is measured — a window that closes mid-run
+    # still banks the rows already measured (round-4's window_e2e died
+    # twice leaving nothing; the all-at-the-end write was the reason) —
+    # and --resume re-runs ZERO completed rows in the next window
+    journal = CellJournal(args.out, {
+        "artifact": "bench_e2e",
+        "config": "cas 32ops x 8pids, 4 schedules", **header,
+    }, resume=args.resume)
     def _hybrid(s):
         from qsm_tpu.ops.hybrid import HybridDevice
 
@@ -142,21 +164,23 @@ def main(argv=None) -> int:
         for sut_name in ("atomic", "racy"):
             for tb in ((1,) if bname not in ("device", "hybrid")
                        else (1, 64)):
-                rec = run_one(f"cas-{sut_name}", bname, mk, sut_name,
-                              args.trials, trial_batch=tb)
-                rec["trial_batch"] = tb
-                if bname in ("device", "hybrid"):
-                    # settings stamp: two artifacts with different
-                    # effective UNROLL must be distinguishable
-                    rec["unroll"] = (adopted_unroll if adopted_unroll
-                                     is not None
-                                     else ("auto" if on_tpu else 1))
-                    rec["unroll_from_scale"] = adopted_unroll
-                    if adopt_error:
-                        rec["unroll_adopt_error"] = adopt_error
+                key = f"{bname}:{sut_name}:tb{tb}"
+                rec = journal.complete(key)
+                if rec is None:
+                    rec = run_one(f"cas-{sut_name}", bname, mk, sut_name,
+                                  args.trials, trial_batch=tb)
+                    rec["trial_batch"] = tb
+                    if bname in ("device", "hybrid"):
+                        # settings stamp: two artifacts with different
+                        # effective UNROLL must be distinguishable
+                        rec["unroll"] = (adopted_unroll if adopted_unroll
+                                         is not None
+                                         else ("auto" if on_tpu else 1))
+                        rec["unroll_from_scale"] = adopted_unroll
+                        if adopt_error:
+                            rec["unroll_adopt_error"] = adopt_error
+                    rec = journal.emit(key, rec)
                 print(json.dumps(rec), flush=True)
-                with open(args.out, "a") as f:
-                    f.write(json.dumps(rec) + "\n")
     return 0
 
 

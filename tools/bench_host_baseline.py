@@ -97,8 +97,9 @@ def main(argv=None) -> int:
         "cpp_oracle_rate": cpp_rate,
         "corpus_unique": len(corpus),
     }
-    with open(args.out, "w") as f:
-        json.dump(result, f, indent=1)
+    from qsm_tpu.resilience.checkpoint import atomic_write_json
+
+    atomic_write_json(args.out, result, indent=1)
     print(json.dumps(result))
     return 0
 

@@ -64,11 +64,12 @@ CPU_CONTROL_BATCH = 256
 
 
 def run_scale(on_tpu: bool, out_path: str, header: dict,
-              time_box_s: float = TIME_BOX_S) -> list:
+              time_box_s: float = TIME_BOX_S, resume: bool = False) -> list:
     from bench import build_corpus
     from qsm_tpu.models import CasSpec
     from qsm_tpu.ops.jax_kernel import JaxTPU
     from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+    from qsm_tpu.resilience.checkpoint import CellJournal
     from qsm_tpu.utils.device import compile_cache_entries
 
     spec = CasSpec()
@@ -94,13 +95,17 @@ def run_scale(on_tpu: bool, out_path: str, header: dict,
     except Exception:  # noqa: BLE001 — optional fast path
         pass
 
-    lines = [{"artifact": "bench_scale", "corpus_unique": len(corpus),
-              "cpp_rate_h_per_s": cpp_rate,
-              "compile_cache_entries_at_start": compile_cache_entries(),
-              **header}]
-    with open(out_path, "w") as f:
-        f.write(json.dumps(lines[0]) + "\n")
-        f.flush()
+    # Per-cell journal (resilience/checkpoint.py): every row lands
+    # atomically (tmp+rename) the moment its cell finishes, and --resume
+    # preloads cells a killed/timed-out earlier run already measured —
+    # a window that closes after cell 2 of 6 banks 2 cells and the next
+    # window starts at cell 3.  The header's resumed_cells count keeps
+    # the artifact honest about what was inherited vs re-measured.
+    journal = CellJournal(out_path, {
+        "artifact": "bench_scale", "corpus_unique": len(corpus),
+        "cpp_rate_h_per_s": cpp_rate,
+        "compile_cache_entries_at_start": compile_cache_entries(),
+        **header}, resume=resume)
 
     def _timed_cell(row, batch, make_backend, counters):
         """The shared cell scaffold: tile the corpus to ``batch`` lanes,
@@ -224,11 +229,14 @@ def run_scale(on_tpu: bool, out_path: str, header: dict,
             "rescued": "rescued",
         })
 
-    def emit(row):
-        lines.append(row)
-        f = open(out_path, "a")
-        f.write(json.dumps(row) + "\n")
-        f.close()
+    def cell(key, make_row):
+        """One journaled cell: adopt the banked row on resume (zero
+        re-run — the time box spends only on cells still unmeasured),
+        else measure and bank atomically."""
+        prev = journal.complete(key)
+        if prev is not None:
+            return prev
+        return journal.emit(key, make_row())
 
     t_start = time.perf_counter()
     widths = DEVICE_BATCHES if on_tpu else CPU_BATCHES
@@ -237,31 +245,38 @@ def run_scale(on_tpu: bool, out_path: str, header: dict,
     # --- decision cells first (VERDICT r4 task #1) -----------------------
     # 1. unroll8 control at the headline width: the row every later width
     #    and the adopted headline compare against.
-    emit(measure(control))
+    cell(f"b{control}", lambda: measure(control))
     # 2. unroll1 at the SAME width: the on-chip unroll A/B the round-4
     #    windows never measured.  Runs second because it is the single
     #    cheapest cell that decides a kernel setting.
-    if time.perf_counter() - t_start <= time_box_s:
-        emit(measure(control, variant="unroll1", unroll=1))
+    if (journal.complete(f"b{control}:unroll1") is not None
+            or time.perf_counter() - t_start <= time_box_s):
+        cell(f"b{control}:unroll1",
+             lambda: measure(control, variant="unroll1", unroll=1))
     else:
-        emit({"batch": control, "variant": "unroll1",
-              "skipped": "time box exhausted"})
+        journal.emit(f"b{control}:unroll1",
+                     {"batch": control, "variant": "unroll1",
+                      "skipped": "time box exhausted"})
     # 3. the Pallas-vs-XLA-loop A/B at the control width (device only:
     #    interpret mode on the fallback would measure the interpreter).
     if on_tpu:
-        if time.perf_counter() - t_start <= time_box_s:
-            emit(measure_pallas(control))
+        if (journal.complete(f"b{control}:pallas") is not None
+                or time.perf_counter() - t_start <= time_box_s):
+            cell(f"b{control}:pallas", lambda: measure_pallas(control))
         else:
-            emit({"batch": control, "variant": "pallas",
-                  "skipped": "time box exhausted"})
+            journal.emit(f"b{control}:pallas",
+                         {"batch": control, "variant": "pallas",
+                          "skipped": "time box exhausted"})
     # 4. the width ladder (control width already measured above).
     for batch in widths:
         if batch == control:
             continue
-        if time.perf_counter() - t_start > time_box_s:
-            emit({"batch": batch, "skipped": "time box exhausted"})
+        if (journal.complete(f"b{batch}") is None
+                and time.perf_counter() - t_start > time_box_s):
+            journal.emit(f"b{batch}",
+                         {"batch": batch, "skipped": "time box exhausted"})
             continue
-        emit(measure(batch))
+        cell(f"b{batch}", lambda batch=batch: measure(batch))
 
     # Diagnostic variants at the widest healthy width — they separate the
     # two cost hypotheses the banked window can't distinguish (per-TRIP
@@ -272,7 +287,7 @@ def run_scale(on_tpu: bool, out_path: str, header: dict,
     #            BUDGET_EXCEEDED instead of burning tail trips; the
     #            decided-lane rate shows what the tail costs the batch.
     # best_scale_batch ignores variant rows by construction.
-    good = [r for r in lines[1:]
+    good = [r for r in journal.rows()[1:]
             if r.get("wrong") == 0 and "error" not in r
             and "skipped" not in r and "variant" not in r
             and r.get("rate_h_per_s")]
@@ -280,7 +295,8 @@ def run_scale(on_tpu: bool, out_path: str, header: dict,
         # marked, not silently absent — and the watcher's min_rows gate
         # counts rows, so the marker alone does not fake completeness;
         # a future window re-runs the scan and gets the diagnostics
-        emit({"variant": "diagnostics", "skipped": "time box exhausted"})
+        journal.emit("diagnostics", {"variant": "diagnostics",
+                                     "skipped": "time box exhausted"})
     if good and time.perf_counter() - t_start <= time_box_s:
         bstar = max(good, key=lambda r: r["rate_h_per_s"])["batch"]
         # matched-width unroll A/B at the ADOPTED width (ADVICE.md round
@@ -291,12 +307,16 @@ def run_scale(on_tpu: bool, out_path: str, header: dict,
         # comparing at the FIRST unroll1 row's width (the control), so
         # this extra cell is diagnostic, not adoption-changing.
         if bstar != control:
-            emit(measure(bstar, variant="unroll1", unroll=1))
-        emit(measure(bstar, variant="oneshot", schedule=(65536,)))
-        if time.perf_counter() - t_start <= time_box_s:
-            b2k = measure(bstar, variant="budget2k",
-                          backend_kw=dict(mid_budget=0, rescue_budget=0))
-            emit(b2k)
+            cell(f"b{bstar}:unroll1",
+                 lambda: measure(bstar, variant="unroll1", unroll=1))
+        cell(f"b{bstar}:oneshot",
+             lambda: measure(bstar, variant="oneshot", schedule=(65536,)))
+        if (journal.complete(f"b{bstar}:budget2k") is not None
+                or time.perf_counter() - t_start <= time_box_s):
+            b2k = cell(f"b{bstar}:budget2k",
+                       lambda: measure(bstar, variant="budget2k",
+                                       backend_kw=dict(mid_budget=0,
+                                                       rescue_budget=0)))
             # Derived, not separately measured: the hybrid execution plan
             # (device decides the easy majority under the 2k budget, the
             # BUDGET_EXCEEDED tail goes to the native host checker — the
@@ -304,32 +324,43 @@ def run_scale(on_tpu: bool, out_path: str, header: dict,
             if (cpp_rate and "error" not in b2k
                     and b2k.get("wrong") == 0):
                 wall = b2k["wall_s"] + b2k["undecided"] / cpp_rate
-                emit({"batch": bstar, "variant": "hybrid_derived",
-                      "wall_s": round(wall, 3),
-                      "rate_h_per_s": round(bstar / wall, 1),
-                      "from": "budget2k.wall_s + undecided/cpp_rate",
-                      "undecided": 0, "wrong": 0})
+                cell(f"b{bstar}:hybrid_derived", lambda: {
+                    "batch": bstar, "variant": "hybrid_derived",
+                    "wall_s": round(wall, 3),
+                    "rate_h_per_s": round(bstar / wall, 1),
+                    "from": "budget2k.wall_s + undecided/cpp_rate",
+                    "undecided": 0, "wrong": 0})
         else:
-            emit({"variant": "budget2k", "skipped": "time box exhausted"})
-    return lines
+            journal.emit(f"b{bstar}:budget2k",
+                         {"variant": "budget2k",
+                          "skipped": "time box exhausted"})
+    return journal.rows()
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="/root/repo/BENCH_SCALE_r05.json")
     ap.add_argument("--force-cpu", action="store_true")
-    ap.add_argument("--probe-timeout", type=float, default=45.0)
+    ap.add_argument("--probe-timeout", type=float, default=None,
+                    help="override the probe preset's per-attempt bound "
+                         "(resilience/policy.py)")
     ap.add_argument("--time-box", type=float, default=TIME_BOX_S,
                     help="stop starting new cells beyond this many "
                          "seconds of measuring (the watcher passes a "
                          "window-sized box)")
+    ap.add_argument("--resume", action="store_true",
+                    help="adopt completed cells from an existing --out "
+                         "journal (same artifact + device provenance) "
+                         "instead of re-measuring them — a scan killed "
+                         "after N cells re-runs zero of them")
     args = ap.parse_args(argv)
 
     from qsm_tpu.utils.device import probe_or_force_cpu
 
     on_tpu, _detail, header = probe_or_force_cpu(args.force_cpu,
                                                  args.probe_timeout)
-    lines = run_scale(on_tpu, args.out, header, time_box_s=args.time_box)
+    lines = run_scale(on_tpu, args.out, header, time_box_s=args.time_box,
+                      resume=args.resume)
     for ln in lines:
         print(json.dumps(ln))
     return 0

@@ -125,8 +125,9 @@ def run(n_corpus: int, tag: str, out_path: str | None) -> dict:
         "rows": rows,
     }
     path = out_path or os.path.join(REPO, f"BENCH_SEARCH_{tag}.json")
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=1)
+    from qsm_tpu.resilience.checkpoint import atomic_write_json
+
+    atomic_write_json(path, doc, indent=1)
     slim = {k: doc[k] for k in
             ("metric", "value", "unit", "hand_iters_per_history",
              "reduction_vs_hand", "memo_oracle_nodes_per_history",

@@ -33,6 +33,10 @@ import time
 
 sys.path.insert(0, "/root/repo")
 
+from qsm_tpu.resilience.checkpoint import (atomic_write_json,  # noqa: E402
+                                           atomic_write_text)
+from qsm_tpu.resilience.faults import InjectedFault, inject  # noqa: E402
+from qsm_tpu.resilience.policy import preset  # noqa: E402
 from qsm_tpu.utils.device import probe_default_backend  # noqa: E402
 
 REPO = "/root/repo"
@@ -45,6 +49,16 @@ WINDOW_ARTIFACT = os.path.join(REPO, "BENCH_TPU_WINDOW.json")
 # end, so writing these non-ignored paths is sufficient even if no human
 # is watching when the window opens).
 ROUND_TAG = "r05"
+
+# Full-matrix measured-row counts for the resumable window tools: e2e is
+# memo(2 suts) + device(2 suts x 2 trial_batches) + hybrid(ditto) — the
+# optional cpp rows are host-measurable off-window and not gated on;
+# configs is one row per registry model family.  Completeness gates and
+# _run_tool min_rows both use these so a promoted PARTIAL never
+# suppresses the resumable re-run that finishes the scan.
+E2E_MIN_ROWS = 10
+CONFIGS_MIN_ROWS = 7
+
 COMMITTED_COPIES = {
     WINDOW_ARTIFACT: os.path.join(REPO, f"BENCH_TPU_{ROUND_TAG}.json"),
     os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"):
@@ -148,8 +162,9 @@ def _bank_committed_copy(runtime_path: str) -> None:
     try:
         with open(runtime_path) as f:
             data = f.read()
-        with open(dst, "w") as f:
-            f.write(data)
+        # tmp+rename: the committed twin is what the round's evidence
+        # rests on — a watcher killed mid-copy must not truncate it
+        atomic_write_text(dst, data)
     except OSError:
         pass  # the runtime artifact still exists; copy is best-effort
 
@@ -170,10 +185,13 @@ def _run_window_bench(bench_timeout: float, extra_args, label: str,
     Returns True on a captured device line."""
     t0 = time.time()
     try:
+        # probe bounds/retries by NAME: the seize-probe preset in
+        # resilience/policy.py is the single source of the old
+        # "--probe-timeout 60 --retries 4 --retry-interval 10" literals
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"),
-             "--probe-timeout", "60", "--retries", "4",
-             "--retry-interval", "10", "--require-device", *extra_args],
+             "--probe-policy", "seize-probe", "--require-device",
+             *extra_args],
             capture_output=True, text=True, timeout=bench_timeout, cwd=REPO)
     except subprocess.TimeoutExpired:
         _log(event=label, ok=False,
@@ -205,8 +223,7 @@ def _run_window_bench(bench_timeout: float, extra_args, label: str,
     if on_device and bank:
         result["captured_iso"] = datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds")
-        with open(WINDOW_ARTIFACT, "w") as f:
-            json.dump(result, f)
+        atomic_write_json(WINDOW_ARTIFACT, result)
         _bank_committed_copy(WINDOW_ARTIFACT)
         try:  # per-capture history (ADVICE.md round 4): append, never clobber
             with open(CAPTURES_LOG, "a") as f:
@@ -276,7 +293,8 @@ def _tool_rows(path: str) -> int:
 
 
 def _run_tool(script: str, out_path: str, timeout: float, label: str,
-              min_rows: int = 0, extra_args=()) -> None:
+              min_rows: int = 0, extra_args=(),
+              resumable: bool = False) -> None:
     """Bank one auxiliary artifact (bench_configs / bench_e2e /
     bench_scale) from the open window.  Device-capture discipline mirrors
     _run_window_bench: a previously banked REAL-device artifact is never
@@ -286,20 +304,32 @@ def _run_tool(script: str, out_path: str, timeout: float, label: str,
     window costs one bounded probe instead of a full CPU-fallback
     workload.  ``min_rows``: a banked artifact with fewer data rows (a
     promoted partial from a closed window) does NOT suppress a re-run —
-    the next window finishes the scan."""
+    the next window finishes the scan.  ``resumable``: seed the tool's
+    temp output from the banked artifact and pass ``--resume`` so cells
+    measured in an earlier window are NOT re-paid — the scan picks up at
+    the first unbanked cell (resilience/checkpoint.py CellJournal); the
+    monotonic more-rows-wins promotion below then holds trivially."""
     if os.path.exists(out_path) and _tool_rows(out_path) >= min_rows:
         _log(event=label, ok=True, detail="already banked; kept")
         return
-    p = probe_default_backend(30)
+    p = probe_default_backend(policy=preset("window-reprobe"))
     if not p.is_device:
         _log(event=label, ok=False, detail=f"window closed: {p.detail}")
         return
     t0 = time.time()
     tmp = f"{out_path}.{os.getpid()}.tmp"
+    resume_args = ()
+    if resumable and os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                atomic_write_text(tmp, f.read())
+            resume_args = ("--resume",)
+        except OSError:
+            pass  # no seed: the tool starts the scan from cell 1
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", script),
-             "--probe-timeout", "45", "--out", tmp, *extra_args],
+             "--out", tmp, *resume_args, *extra_args],
             capture_output=True, text=True, timeout=timeout, cwd=REPO)
     except subprocess.TimeoutExpired:
         # tools that write incrementally (bench_scale) may have banked
@@ -376,6 +406,13 @@ def _seize_window(bench_timeout: float) -> bool:
       5. per-config matrix;
       6. the max-ops sweep LAST (longest by far; outlived round-4's
          48-min window)."""
+    try:
+        # fault site (resilience/faults.py): seize-abort paths are
+        # tier-1 testable without a chip; no-op in production
+        inject("seize")
+    except InjectedFault as e:
+        _log(event="window_seize", ok=False, detail=f"fault-injected: {e}")
+        return False
     scale_path = os.path.join(REPO, "BENCH_SCALE_TPU_WINDOW.json")
     scale_done = _scale_complete(scale_path)
 
@@ -403,10 +440,17 @@ def _seize_window(bench_timeout: float) -> bool:
                  or cur.get("unroll") == adopted_unroll))
         return age <= 3 * 3600.0, current
 
-    e2e_done = os.path.exists(
-        os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json"))
-    configs_done = os.path.exists(
-        os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"))
+    # row-count completeness, NOT existence: a partial promoted from a
+    # timed-out window must not suppress the resumable re-run that
+    # finishes it (resume adopts banked cells, so convergence is cheap).
+    # e2e full matrix = memo(2) + device(4) + hybrid(4) rows (the cpp
+    # rows are host-measurable off-window and not gated on); configs =
+    # one row per model family.
+    e2e_done = _tool_rows(
+        os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json")) >= E2E_MIN_ROWS
+    configs_done = _tool_rows(
+        os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json")) \
+        >= CONFIGS_MIN_ROWS
     # a profile directory is "captured" only once a completed trace file
     # exists inside it — jax.profiler creates the directory at trace
     # START, so a run killed mid-trace must not suppress retries
@@ -447,7 +491,7 @@ def _seize_window(bench_timeout: float) -> bool:
         # partial rows are promoted either way (incremental writes)
         _run_tool("bench_scale.py", scale_path, bench_timeout,
                   "window_scale", min_rows=1 << 30,
-                  extra_args=("--time-box", "600"))
+                  extra_args=("--time-box", "600"), resumable=True)
         fresh, settings_current = headline_state()  # scan may re-decide
 
     # --- 2. short headline at the adopted configuration ------------------
@@ -469,7 +513,8 @@ def _seize_window(bench_timeout: float) -> bool:
     else:
         _run_tool("bench_e2e.py",
                   os.path.join(REPO, "BENCH_E2E_TPU_WINDOW.json"),
-                  bench_timeout / 2, "window_e2e")
+                  bench_timeout / 2, "window_e2e",
+                  min_rows=E2E_MIN_ROWS, resumable=True)
     # --- 4. a PROFILED run, never banked (tracer overhead must not
     # deflate the headline artifact) — the first real-TPU trace ----------
     if profile_done:
@@ -481,7 +526,8 @@ def _seize_window(bench_timeout: float) -> bool:
     # --- 5. per-config matrix -------------------------------------------
     _run_tool("bench_configs.py",
               os.path.join(REPO, "BENCH_CONFIGS_TPU_WINDOW.json"),
-              bench_timeout, "window_configs")
+              bench_timeout, "window_configs",
+              min_rows=CONFIGS_MIN_ROWS, resumable=True)
     # --- 6. the max-ops sweep: longest by far, strictly last ------------
     if sweep_done:
         _log(event="window_bench_full", ok=True,
@@ -494,7 +540,9 @@ def _seize_window(bench_timeout: float) -> bool:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=180.0)
-    ap.add_argument("--timeout", type=float, default=45.0)
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="override the watcher-probe preset's per-probe "
+                         "bound (resilience/policy.py)")
     ap.add_argument("--bench-timeout", type=float, default=1800.0)
     ap.add_argument("--once", action="store_true")
     ap.add_argument("--no-bench", action="store_true",
@@ -507,7 +555,8 @@ def main() -> int:
         _preflight_lint()
     while True:
         t0 = time.time()
-        p = probe_default_backend(args.timeout)
+        p = probe_default_backend(args.timeout,
+                                  policy=preset("watcher-probe"))
         _log(ok=p.ok, is_device=p.is_device, platform=p.platform,
              detail=p.detail[:300])
         if p.is_device and not args.no_bench:
