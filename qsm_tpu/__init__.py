@@ -16,6 +16,9 @@ Layers (mirroring SURVEY.md §1, redesigned TPU-first):
 * ``qsm_tpu.analysis`` — ``qsmlint``: static spec/kernel/determinism
   analysis that catches window-burning defects before any TPU window
   opens (docs/ANALYSIS.md)
+* ``qsm_tpu.serve``    — the serving plane: long-lived check server
+  with warm engines, cross-request micro-batching, a persistent
+  verdict cache and bounded admission (docs/SERVING.md)
 * ``qsm_tpu.utils``    — config, structured logging, CLI
 """
 

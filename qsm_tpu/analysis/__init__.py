@@ -16,7 +16,10 @@ constructed —
 * resilience (``resilience_passes``): unbounded device calls — bare
   ``jax.devices()`` outside a watchdog, subprocess waits without a
   timeout, scattered probe-timeout literals the named
-  :data:`~qsm_tpu.resilience.policy.PRESETS` replaced.
+  :data:`~qsm_tpu.resilience.policy.PRESETS` replaced;
+* serve (``serve_passes``): the serving plane's structural hazards —
+  accept/recv loops without a deadline or shutdown check, unbounded
+  queue growth in admission paths.
 
 Entry points: :func:`run_lint` (the engine), ``python -m qsm_tpu lint``
 (the CLI gate), tests/test_lint.py (the tier-1 gate) and the
@@ -28,7 +31,7 @@ from .findings import (ERROR, INFO, WARNING, Finding, Whitelist,
                        render_json, render_text, sort_findings,
                        split_whitelisted)
 from .engine import (DEFAULT_OPS_FILES, DEFAULT_RESILIENCE_FILES,
-                     DEFAULT_SCHED_FILES, LintReport,
+                     DEFAULT_SCHED_FILES, DEFAULT_SERVE_FILES, LintReport,
                      default_whitelist_path, run_lint)
 
 __all__ = [
@@ -36,5 +39,5 @@ __all__ = [
     "run_lint", "render_text", "render_json", "sort_findings",
     "split_whitelisted", "default_whitelist_path",
     "DEFAULT_OPS_FILES", "DEFAULT_SCHED_FILES",
-    "DEFAULT_RESILIENCE_FILES",
+    "DEFAULT_RESILIENCE_FILES", "DEFAULT_SERVE_FILES",
 ]

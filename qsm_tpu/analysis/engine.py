@@ -44,7 +44,15 @@ DEFAULT_RESILIENCE_FILES = (
     "qsm_tpu/utils/cli.py", "qsm_tpu/native/__init__.py",
     "bench.py", "tools/probe_watcher.py", "tools/bench_configs.py",
     "tools/bench_e2e.py", "tools/bench_scale.py",
-    "tools/bench_search.py", "tools/bench_host_baseline.py")
+    "tools/bench_search.py", "tools/bench_host_baseline.py",
+    "tools/bench_serve.py", "tools/soak_prune.py")
+# the serving plane the serve passes cover (repo-root-relative): every
+# module that accepts connections, buffers lanes, or drives the server
+DEFAULT_SERVE_FILES = (
+    "qsm_tpu/serve/server.py", "qsm_tpu/serve/batcher.py",
+    "qsm_tpu/serve/admission.py", "qsm_tpu/serve/cache.py",
+    "qsm_tpu/serve/client.py", "qsm_tpu/serve/protocol.py",
+    "tools/bench_serve.py")
 
 
 def default_whitelist_path() -> str:
@@ -117,12 +125,14 @@ def run_lint(models: Optional[Sequence[str]] = None,
              ops_files: Optional[Sequence[str]] = None,
              sched_files: Optional[Sequence[str]] = None,
              resilience_files: Optional[Sequence[str]] = None,
+             serve_files: Optional[Sequence[str]] = None,
              seed: int = 0) -> LintReport:
     from ..models.registry import MODELS
     from .kernel_passes import (check_host_transfers, check_pallas_vmem,
                                 check_retracing, check_step_dtypes)
     from .resilience_passes import check_resilience_file
     from .sched_passes import check_sched_file
+    from .serve_passes import check_serve_file
     from .spec_passes import check_spec
 
     t_start = time.perf_counter()
@@ -185,6 +195,14 @@ def run_lint(models: Optional[Sequence[str]] = None,
         path = rel if os.path.isabs(rel) else os.path.join(REPO_ROOT, rel)
         findings += check_resilience_file(path, root=REPO_ROOT)
     passes["resilience"] = time.perf_counter() - t0
+
+    # --- (e) serve: unbounded accept loops / queues ----------------------
+    t0 = time.perf_counter()
+    for rel in (serve_files if serve_files is not None
+                else DEFAULT_SERVE_FILES):
+        path = rel if os.path.isabs(rel) else os.path.join(REPO_ROOT, rel)
+        findings += check_serve_file(path, root=REPO_ROOT)
+    passes["serve"] = time.perf_counter() - t0
 
     wl = _resolve_whitelist(whitelist)
     kept, allowed = split_whitelisted(findings, wl)

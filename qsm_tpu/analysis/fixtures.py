@@ -119,3 +119,48 @@ class UnboundedDeviceProbeStub:
         from ..resilience.policy import watchdog
 
         return watchdog(lambda: jax.devices(), 45.0, label="fixture")
+
+
+class UnboundedServeAcceptStub:
+    """Seeded bug for the serve passes (family e): a ``while True``
+    accept loop with no deadline or shutdown check (QSM-SERVE-ACCEPT —
+    a wedged peer or a stop request leaves the thread blocked forever)
+    and an unbounded admission queue (QSM-SERVE-UNBOUNDED) — next to
+    the two sanctioned twins the passes must NOT flag (a stop-flag-
+    gated loop, and a settimeout-bounded poll over a bounded queue).
+    Never executed; tests point the serve AST pass at this file and
+    assert each rule fires exactly once."""
+
+    def __init__(self):
+        import threading
+
+        self._stop = threading.Event()
+
+    def serve_forever_unbounded(self, sock):
+        import queue
+
+        backlog = queue.Queue()        # <-- bug: unbounded admission queue
+        while True:                    # <-- bug: no deadline/shutdown check
+            conn, _ = sock.accept()
+            backlog.put(conn)
+
+    def serve_stop_gated(self, sock):
+        """Sanctioned: the loop test IS the shutdown check."""
+        import queue
+
+        backlog = queue.Queue(maxsize=64)   # ok: bounded
+        while not self._stop.is_set():
+            conn, _ = sock.accept()
+            backlog.put(conn, block=False)
+
+    def serve_deadline_polled(self, sock):
+        """Sanctioned: settimeout bounds every accept; the loop polls."""
+        sock.settimeout(0.5)
+        while True:
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                if self._stop.is_set():
+                    return
+                continue
+            conn.close()

@@ -35,7 +35,10 @@ computed.
 
 Fault sites instrumented today: ``probe`` (utils/device.py),
 ``dispatch`` (ops/jax_kernel.py, ops/pallas_kernel.py — i.e. every
-device engine entry), ``seize`` (tools/probe_watcher.py).
+device engine entry), ``seize`` (tools/probe_watcher.py), ``serve``
+(serve/server.py micro-batch dispatch — a hang/raise there exercises
+the check server's degrade-to-host-ladder path on the CPU platform,
+tests/test_serve.py).
 """
 
 from __future__ import annotations

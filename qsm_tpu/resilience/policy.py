@@ -195,6 +195,14 @@ PRESETS = {
     "dispatch": RetryPolicy(name="dispatch", attempts=2, timeout_s=300.0,
                             backoff_s=0.5, backoff_factor=2.0,
                             jitter_frac=0.1, deadline_s=600.0),
+    # the serving plane (qsm_tpu/serve): timeout_s bounds ONE micro-batch
+    # dispatch on the warm engine (server.py watchdogs it — a hang at the
+    # `serve` fault site degrades the batch to the exact host ladder, not
+    # the server); deadline_s is the default per-request deadline the
+    # admission layer enforces — a request past it is answered SHED,
+    # never late and never wrong (docs/SERVING.md).
+    "serve": RetryPolicy(name="serve", attempts=1, timeout_s=60.0,
+                         deadline_s=30.0),
 }
 
 

@@ -1,0 +1,38 @@
+"""``qsm_tpu.serve`` — linearizability checking as a long-lived service.
+
+The ROADMAP north star is a system that "serves heavy traffic … via
+sharding, batching, async, caching"; every prior entry point was a
+one-shot process.  This package is the serving plane over the existing
+ones — admission → micro-batch → dispatch → cache (docs/SERVING.md):
+
+* ``protocol``  — JSON-lines wire format (the repo's one external
+  history-row encoding) over TCP/UNIX sockets;
+* ``server``    — :class:`CheckServer`: warm planner-built engines per
+  spec behind ``resilience.FailoverBackend``;
+* ``batcher``   — cross-request adaptive micro-batching into
+  compile-bucket-padded lanes, with per-batch ``why`` provenance;
+* ``cache``     — fingerprint-keyed verdict/witness LRU with an atomic
+  persistent bank (kill/restart serves banked verdicts in O(1));
+* ``admission`` — bounded in-flight lanes, preset-driven per-request
+  deadlines, explicit ``SHED`` load shedding;
+* ``client``    — :class:`CheckClient` (``qsm-tpu submit`` / bench).
+
+CLI: ``qsm-tpu serve`` / ``qsm-tpu submit`` (utils/cli.py); bench:
+tools/bench_serve.py (artifact ``BENCH_SERVE_r07.json``); static gate:
+the QSM-SERVE pass family (analysis/serve_passes.py).
+"""
+
+from .admission import AdmissionController
+from .batcher import Lane, MicroBatcher
+from .cache import CacheEntry, VerdictCache, fingerprint_key
+from .client import CheckClient
+from .protocol import (VERDICT_NAMES, history_to_rows, parse_address,
+                       rows_to_history)
+from .server import CheckServer
+
+__all__ = [
+    "AdmissionController", "CacheEntry", "CheckClient", "CheckServer",
+    "Lane", "MicroBatcher", "VERDICT_NAMES", "VerdictCache",
+    "fingerprint_key", "history_to_rows", "parse_address",
+    "rows_to_history",
+]

@@ -1,0 +1,83 @@
+"""Admission control — bounded load, explicit shedding.
+
+The serving plane's failure mode under overload must be a fast, honest
+``SHED`` response, never silent latency collapse (a queue that grows
+without bound converts overload into unbounded tail latency and then
+into wrong-looking timeouts for EVERY client).  One controller instance
+gates the whole server:
+
+* **Bounded in-flight lanes** — ``try_admit(n)`` reserves ``n`` history
+  lanes against ``queue_depth`` or refuses atomically (no partial
+  admission: a request is whole or shed).  The QSM-SERVE-UNBOUNDED lint
+  pass (analysis/serve_passes.py) gates the code-level twin of this
+  rule — no unbounded queue constructions in the serve plane.
+* **Per-request deadline** — defaulted from the ``serve``
+  :data:`~qsm_tpu.resilience.policy.PRESETS` entry (ONE timeout table
+  for the whole stack); a request past its deadline is answered
+  ``SHED``, and its still-in-flight lanes complete into the verdict
+  cache rather than being wasted.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..resilience.policy import RetryPolicy, preset
+
+
+class AdmissionController:
+    """Lane accounting + shed counters (one site the server and the
+    ``stats`` op both read)."""
+
+    def __init__(self, queue_depth: int = 1024,
+                 policy: Optional[RetryPolicy] = None):
+        self.queue_depth = queue_depth
+        self.policy = policy or preset("serve")
+        self._lock = threading.Lock()
+        self.in_flight = 0
+        self.peak_in_flight = 0
+        self.admitted_lanes = 0
+        self.completed_lanes = 0
+        self.shed_queue = 0     # requests refused at admission (full)
+        self.shed_deadline = 0  # requests answered SHED past deadline
+
+    # ------------------------------------------------------------------
+    def deadline_for(self, deadline_s: Optional[float]) -> float:
+        """Absolute monotonic deadline for a request; ``None`` takes the
+        preset's default."""
+        d = self.policy.deadline_s if deadline_s is None else deadline_s
+        return time.monotonic() + max(0.0, float(d))
+
+    def try_admit(self, n_lanes: int) -> bool:
+        with self._lock:
+            if self.in_flight + n_lanes > self.queue_depth:
+                self.shed_queue += 1
+                return False
+            self.in_flight += n_lanes
+            self.peak_in_flight = max(self.peak_in_flight, self.in_flight)
+            self.admitted_lanes += n_lanes
+            return True
+
+    def release(self, n_lanes: int = 1) -> None:
+        with self._lock:
+            self.in_flight -= n_lanes
+            self.completed_lanes += n_lanes
+
+    def shed_late(self) -> None:
+        """Count a deadline shed (the lanes release on completion)."""
+        with self._lock:
+            self.shed_deadline += 1
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"queue_depth": self.queue_depth,
+                    "in_flight": self.in_flight,
+                    "peak_in_flight": self.peak_in_flight,
+                    "admitted_lanes": self.admitted_lanes,
+                    "completed_lanes": self.completed_lanes,
+                    "shed_queue": self.shed_queue,
+                    "shed_deadline": self.shed_deadline,
+                    "policy": self.policy.name}

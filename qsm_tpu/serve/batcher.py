@@ -1,0 +1,165 @@
+"""Cross-request adaptive micro-batching — N clients, one dispatch.
+
+The whole device story of this repo is "decide many histories in ONE
+backend call" (BASELINE.json:9); per-request dispatch throws that away
+the moment checking becomes a service.  The batcher coalesces history
+lanes arriving from concurrent connections into one padded batch per
+(spec, flush window), exactly the compile-bucket discipline
+``core/property.py`` uses for trial groups: the batch is padded to a
+FIXED lane width with empty (instantly-SUCCESS) histories so every
+dispatch hits the same compiled executable, and ops are padded to the
+shared ``OP_BUCKETS`` inside the engine as always.
+
+Flush policy (first match wins, per spec group):
+
+* ``full``     — the group reached ``max_lanes``: dispatch now;
+* ``deadline`` — the earliest request deadline in the group is within
+  one flush window: dispatch early rather than shed late;
+* ``interval`` — the oldest lane has waited ``flush_s``: latency floor
+  for lonely clients;
+* ``close``    — server shutdown drains every group.
+
+Every batch carries a ``why`` provenance stamp (batch id, lane count,
+width, occupancy, flush reason) that rides the responses of every
+request in the batch and aggregates into ``qsm-tpu stats`` — the same
+self-describing-artifact discipline as the planner's ``why`` and the
+resilience counters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..core.history import History
+
+
+@dataclasses.dataclass
+class Lane:
+    """One history awaiting a verdict (the unit the batcher coalesces)."""
+
+    key: str                 # verdict-cache fingerprint key
+    history: History
+    deadline: float          # absolute monotonic deadline of its request
+    resolve: Callable        # resolve(verdict:int, batch_stamp:dict)
+
+
+class _Group:
+    __slots__ = ("lanes", "first_ts")
+
+    def __init__(self):
+        self.lanes: List[Lane] = []
+        self.first_ts = time.monotonic()
+
+
+class MicroBatcher:
+    """Coalesce lanes per spec group; dispatch on a single worker thread
+    (which also serializes engine access — engines are not required to
+    be thread-safe)."""
+
+    def __init__(self, dispatch: Callable[[str, List[Lane], dict], None],
+                 max_lanes: int = 64, flush_s: float = 0.02,
+                 queue_depth: int = 4096):
+        self._dispatch = dispatch
+        self.max_lanes = max_lanes
+        self.flush_s = flush_s
+        # bounded by contract (QSM-SERVE-UNBOUNDED): admission gates
+        # in-flight lanes above this, so a full queue means misconfig,
+        # and submit() fails fast instead of growing memory
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.batches = 0
+        self.lanes_dispatched = 0
+        self.width_dispatched = 0  # Σ padded widths (occupancy denominator)
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="qsm-serve-batcher")
+        self._thread.start()
+
+    def stop(self, drain_timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(drain_timeout_s)
+
+    def submit(self, group_key: str, lane: Lane) -> bool:
+        """Enqueue one lane; False when the (bounded) queue is full —
+        the caller sheds the request."""
+        try:
+            self._q.put((group_key, lane), block=False)
+            return True
+        except queue.Full:
+            return False
+
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        groups: Dict[str, _Group] = {}
+        # drain everything before exiting: lanes admitted pre-stop must
+        # resolve (their requests hold admission slots)
+        while not (self._stop.is_set() and self._q.empty() and not groups):
+            try:
+                group_key, lane = self._q.get(timeout=self.flush_s / 2
+                                              if self.flush_s > 0 else 0.01)
+            except queue.Empty:
+                pass
+            else:
+                groups.setdefault(group_key, _Group()).lanes.append(lane)
+            now = time.monotonic()
+            for key in list(groups):
+                g = groups[key]
+                reason = self._flush_reason(g, now)
+                if reason is not None:
+                    del groups[key]
+                    self._flush(key, g.lanes, reason)
+        for key, g in list(groups.items()):
+            self._flush(key, g.lanes, "close")
+
+    def _flush_reason(self, g: _Group, now: float) -> Optional[str]:
+        if len(g.lanes) >= self.max_lanes:
+            return "full"
+        if self._stop.is_set():
+            return "close"
+        if g.lanes and min(l.deadline for l in g.lanes) - now <= self.flush_s:
+            return "deadline"
+        if now - g.first_ts >= self.flush_s:
+            return "interval"
+        return None
+
+    def _flush(self, group_key: str, lanes: List[Lane], reason: str) -> None:
+        # width is FIXED at max_lanes so every dispatch hits the same
+        # compiled executable (core/property.py's padding lesson); a
+        # group can never exceed it (lanes arrive one per loop turn),
+        # but never drop a lane even if that invariant breaks
+        width = max(self.max_lanes, len(lanes))
+        self.batches += 1
+        self.lanes_dispatched += len(lanes)
+        self.width_dispatched += width
+        why = {"batch": self.batches, "lanes": len(lanes), "width": width,
+               "occupancy": round(len(lanes) / width, 3), "flush": reason}
+        try:
+            self._dispatch(group_key, lanes, why)
+        except Exception as e:  # noqa: BLE001 — the loop thread must survive
+            # an undispatchable batch resolves BUDGET_EXCEEDED (honest
+            # "not decided", never a guess) so its requests don't hang
+            # to their deadlines and the batcher keeps serving
+            for lane in lanes:
+                try:
+                    lane.resolve(2, {**why, "error":
+                                     f"{type(e).__name__}: {e}"[:200]})
+                except Exception:  # noqa: BLE001 — resolver must not re-kill
+                    pass
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {"batches": self.batches,
+                "lanes": self.lanes_dispatched,
+                "mean_occupancy": round(
+                    self.lanes_dispatched / self.width_dispatched, 3)
+                if self.width_dispatched else 0.0,
+                "max_lanes": self.max_lanes,
+                "flush_s": self.flush_s}

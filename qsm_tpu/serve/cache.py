@@ -1,0 +1,197 @@
+"""Verdict cache — duplicate submissions answered in O(1), survivably.
+
+Online monitoring re-submits identical histories constantly (the same
+trace window re-checked after a retry, N replicas reporting the same
+interleaving), and a verdict is a pure function of (spec, history) —
+the scheduler plane's fingerprint discipline (sched/systematic.py)
+already treats a history's canonical identity as THE dedup key.  This
+module banks (verdict, witness) under that identity:
+
+* **Key** — :func:`fingerprint_key`: sha256 over the canonical JSON of
+  ``(spec.name, spec_kwargs, history.fingerprint())``.  The history
+  fingerprint is core/history.py's one canonical identity site, so an
+  Op field added later changes cache keys together with every other
+  identity comparison in the repo.
+* **Memory** — bounded LRU (``max_entries``); hit moves to MRU.
+* **Disk** — a ``CellJournal``-style JSONL bank (header + one row per
+  entry) rewritten through ``resilience.checkpoint.atomic_write_text``
+  on every put (ONE flush per dispatch batch via :meth:`put_many` — a
+  flush is an O(entries) rewrite, so it is paid per batch, not per
+  lane): a server killed mid-bank leaves a complete previous
+  generation, never a torn file, and a restart serves every banked
+  verdict (and witness) without re-searching (tests/test_serve.py pins
+  kill-restart-serve).
+* **Honesty** — only DECIDED verdicts (VIOLATION / LINEARIZABLE) are
+  banked.  A BUDGET_EXCEEDED is an engine-relative statement, not a
+  property of the history; banking it would freeze "undecided" past
+  engine upgrades.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import List, Optional
+
+from ..core.history import History
+
+_ARTIFACT = "qsm_tpu_verdict_cache"
+_VERSION = 1
+
+
+def fingerprint_key(spec, history: History) -> str:
+    """Canonical cache identity of (spec instance, observable history)."""
+    doc = [spec.name, spec.spec_kwargs(), list(history.fingerprint())]
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True, default=list).encode()).hexdigest()
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    verdict: int
+    witness: Optional[List[tuple]] = None  # [(op_index, resp), ...]
+    hits: int = 0
+
+
+class VerdictCache:
+    """Bounded LRU with an atomic persistent JSONL bank (see module
+    docstring).  Thread-safe: the server's connection threads and the
+    batcher's dispatch thread share one instance."""
+
+    def __init__(self, max_entries: int = 4096, path: Optional[str] = None,
+                 persist_every: int = 1):
+        self.max_entries = max_entries
+        self.path = path
+        self.persist_every = max(1, persist_every)
+        self._od: "OrderedDict[str, CacheEntry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self._puts_since_flush = 0
+        if path:
+            self._load(path)
+
+    # ------------------------------------------------------------------
+    def get(self, key: str) -> Optional[CacheEntry]:
+        with self._lock:
+            e = self._od.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            self._od.move_to_end(key)
+            e.hits += 1
+            self.hits += 1
+            return e
+
+    def put(self, key: str, verdict: int,
+            witness: Optional[List[tuple]] = None) -> None:
+        with self._lock:
+            if not self._put_locked(key, verdict, witness):
+                return
+            self._puts_since_flush += 1
+            if self.path and self._puts_since_flush >= self.persist_every:
+                self._flush_locked()
+
+    def put_many(self, items) -> None:
+        """Bank ``(key, verdict, witness)`` triples with ONE atomic
+        flush for the whole batch: a flush is an O(entries) full-bank
+        rewrite, so a 64-lane dispatch must pay it once, not 64 times
+        (and every ``get`` on every connection thread blocks on the
+        lock meanwhile)."""
+        with self._lock:
+            wrote = False
+            for key, verdict, witness in items:
+                wrote = self._put_locked(key, verdict, witness) or wrote
+            if wrote and self.path:
+                self._flush_locked()
+
+    def _put_locked(self, key: str, verdict: int,
+                    witness: Optional[List[tuple]]) -> bool:
+        if verdict not in (0, 1):
+            return False  # never bank BUDGET_EXCEEDED (module docstring)
+        e = self._od.get(key)
+        if e is not None:
+            # keep a banked witness when the refresh has none (a
+            # verdict-only re-check must not degrade the bank)
+            if witness is not None:
+                e.witness = list(witness)
+            e.verdict = verdict
+            self._od.move_to_end(key)
+        else:
+            self._od[key] = CacheEntry(
+                verdict=verdict,
+                witness=list(witness) if witness is not None else None)
+            while len(self._od) > self.max_entries:
+                self._od.popitem(last=False)
+        return True
+
+    def flush(self) -> None:
+        with self._lock:
+            if self.path:
+                self._flush_locked()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._od)
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {"entries": len(self._od), "hits": self.hits,
+                    "misses": self.misses,
+                    "hit_rate": round(self.hits / total, 3) if total else 0.0,
+                    "path": self.path}
+
+    # ------------------------------------------------------------------
+    def _flush_locked(self) -> None:
+        from ..resilience.checkpoint import atomic_write_text
+
+        header = {"artifact": _ARTIFACT, "version": _VERSION,
+                  "entries": len(self._od)}
+        rows = [json.dumps({"key": k, "verdict": e.verdict,
+                            "witness": ([list(p) for p in e.witness]
+                                        if e.witness is not None else None)})
+                for k, e in self._od.items()]
+        atomic_write_text(self.path,
+                          "\n".join([json.dumps(header)] + rows) + "\n")
+        self._puts_since_flush = 0
+
+    def _load(self, path: str) -> None:
+        """Adopt a prior bank; CellJournal's tolerance rules — a garbled
+        or truncated tail is dropped (those entries simply re-check), an
+        alien header adopts nothing but is preserved aside."""
+        try:
+            with open(path) as f:
+                raw = f.read().splitlines()
+        except OSError:
+            return
+        docs = []
+        for ln in raw:
+            if not ln.strip():
+                continue
+            try:
+                docs.append(json.loads(ln))
+            except ValueError:
+                break  # truncated/garbled: trust nothing at or past it
+        if not docs:
+            return
+        if docs[0].get("artifact") != _ARTIFACT:
+            try:  # not ours: preserve, never adopt or clobber
+                os.replace(path, f"{path}.pre-resume")
+            except OSError:
+                pass
+            return
+        for row in docs[1:]:
+            key, verdict = row.get("key"), row.get("verdict")
+            if not key or verdict not in (0, 1):
+                continue
+            w = row.get("witness")
+            self._od[key] = CacheEntry(
+                verdict=verdict,
+                witness=[tuple(p) for p in w] if w is not None else None)
+        while len(self._od) > self.max_entries:
+            self._od.popitem(last=False)

@@ -1,0 +1,80 @@
+"""``CheckClient`` — the serving plane's caller side.
+
+One instance = one connection; requests on a connection are answered in
+order.  Concurrency is per-connection (each concurrent caller opens its
+own client — the micro-batcher coalesces ACROSS connections), which is
+the shape tools/bench_serve.py drives.
+
+Used by ``qsm-tpu submit`` / ``qsm-tpu stats --serve``, the bench tool
+and tests/test_serve.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+from typing import List, Optional, Sequence, Union
+
+from ..core.history import History
+from .protocol import (LineChannel, connect, history_to_rows, send_doc)
+
+_ids = itertools.count()
+
+
+class CheckClient:
+    """JSON-lines client for a running :class:`~qsm_tpu.serve.server.
+    CheckServer` (address: ``host:port`` or a UNIX socket path)."""
+
+    def __init__(self, address: str, timeout_s: float = 60.0):
+        self.address = address
+        self.timeout_s = timeout_s
+        self._sock = connect(address, timeout_s=timeout_s)
+        self._chan = LineChannel(self._sock)
+
+    # ------------------------------------------------------------------
+    def check(self, model: str,
+              histories: Sequence[Union[History, Sequence[Sequence[int]]]],
+              *, spec_kwargs: Optional[dict] = None, witness: bool = False,
+              deadline_s: Optional[float] = None,
+              req_id: Optional[str] = None) -> dict:
+        """Submit one corpus; returns the response document (``ok`` with
+        per-history verdict names, or ``shed``/``error``)."""
+        rows: List[list] = [
+            history_to_rows(h) if isinstance(h, History) else list(h)
+            for h in histories]
+        req = {"op": "check", "id": req_id or f"q{next(_ids)}",
+               "model": model, "histories": rows}
+        if spec_kwargs:
+            req["spec_kwargs"] = spec_kwargs
+        if witness:
+            req["witness"] = True
+        if deadline_s is not None:
+            req["deadline_s"] = deadline_s
+        return self._round_trip(req)
+
+    def stats(self) -> dict:
+        return self._round_trip({"op": "stats"})
+
+    def shutdown(self) -> dict:
+        return self._round_trip({"op": "shutdown"})
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    def _round_trip(self, req: dict) -> dict:
+        send_doc(self._sock, req)
+        line = self._chan.read_line(timeout_s=self.timeout_s)
+        if line is None:
+            raise ConnectionError(
+                f"server at {self.address} closed the connection")
+        return json.loads(line)
+
+    def __enter__(self) -> "CheckClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,132 @@
+"""JSON-lines wire protocol of the check server (docs/SERVING.md).
+
+One request or response per line, UTF-8 JSON, over a local TCP or UNIX
+socket.  Histories ride the repo's ONE external encoding — the
+``[pid, cmd, arg, resp, invoke_time, response_time]`` rows that
+regression files and the ``check`` CLI already use
+(utils/report.py::history_from_rows is the shared decoder), so anything
+that can feed ``qsm-tpu check`` can submit to the server unchanged.
+
+Requests::
+
+    {"op": "check", "id": "c0-3", "model": "cas", "histories": [[...]],
+     "spec_kwargs": {}, "witness": false, "deadline_s": 30.0}
+    {"op": "stats"}
+    {"op": "shutdown"}
+
+Responses (same order as requests on a connection)::
+
+    {"id": "c0-3", "ok": true, "verdicts": ["LINEARIZABLE", ...],
+     "cached": [true, false, ...], "witnesses": [...]?,
+     "batches": [{...why stamp...}], "seconds": 0.012}
+    {"id": "c0-3", "ok": false, "shed": true, "reason": "deadline"}
+
+A ``shed`` response is the load-shedding contract (admission.py): the
+server refuses work it cannot finish inside the request's deadline —
+explicitly, never by silent latency collapse, and NEVER by a wrong or
+partial verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+from typing import Callable, List, Optional, Sequence
+
+from ..core.history import History
+
+# index == Verdict value (ops/backend.py); the ONE rendering site —
+# utils/cli.py imports this tuple for every subcommand's output
+VERDICT_NAMES = ("VIOLATION", "LINEARIZABLE", "BUDGET_EXCEEDED")
+
+# recv granularity and the poll slice used while honoring deadlines /
+# shutdown flags (a LineChannel read is bounded by BOTH)
+_RECV_BYTES = 65536
+_POLL_S = 0.5
+# send bound: LineChannel leaves its short poll timeout on the socket,
+# which sendall would otherwise inherit — a large witness response to a
+# client that stalls >0.5 s mid-drain would abort the connection.  A
+# send gets its own generous bound instead (a peer that cannot drain in
+# this long is wedged, and a bounded drop beats a leaked thread).
+SEND_TIMEOUT_S = 30.0
+
+
+def history_to_rows(h: History) -> List[list]:
+    """Inverse of utils/report.py::history_from_rows (pending ops keep
+    their sentinel resp/response_time; the decoder canonicalizes)."""
+    return [[o.pid, o.cmd, o.arg, o.resp, o.invoke_time, o.response_time]
+            for o in h.ops]
+
+
+def rows_to_history(rows: Sequence[Sequence[int]]) -> History:
+    from ..utils.report import history_from_rows
+
+    return history_from_rows(rows)
+
+
+def send_doc(sock: socket.socket, doc: dict) -> None:
+    sock.settimeout(SEND_TIMEOUT_S)
+    sock.sendall((json.dumps(doc) + "\n").encode())
+
+
+class LineChannel:
+    """Buffered newline-framed reader over a socket.
+
+    Every read is bounded: ``timeout_s`` is a wall-clock deadline and
+    ``stop`` an optional shutdown predicate polled every ``_POLL_S`` —
+    the discipline the QSM-SERVE-ACCEPT lint pass gates (an unbounded
+    recv loop holds a server thread forever when a peer wedges).
+    Returns ``None`` on EOF / closed socket; raises :class:`TimeoutError`
+    past the deadline.
+    """
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._buf = b""
+
+    def read_line(self, timeout_s: Optional[float] = None,
+                  stop: Optional[Callable[[], bool]] = None
+                  ) -> Optional[str]:
+        t0 = time.monotonic()
+        while b"\n" not in self._buf:
+            if stop is not None and stop():
+                return None
+            slice_s = _POLL_S
+            if timeout_s is not None:
+                remaining = timeout_s - (time.monotonic() - t0)
+                if remaining <= 0:
+                    raise TimeoutError("read_line deadline exceeded")
+                slice_s = min(slice_s, remaining)
+            self.sock.settimeout(slice_s)
+            try:
+                chunk = self.sock.recv(_RECV_BYTES)
+            except socket.timeout:
+                continue
+            except OSError:
+                return None
+            if not chunk:
+                return None  # peer closed
+            self._buf += chunk
+        line, _, self._buf = self._buf.partition(b"\n")
+        return line.decode()
+
+
+def parse_address(address: str):
+    """``host:port`` → ``("tcp", (host, port))``; anything else is a
+    UNIX socket path → ``("unix", path)``."""
+    if ":" in address:
+        host, _, port = address.rpartition(":")
+        if port.isdigit():
+            return "tcp", (host or "127.0.0.1", int(port))
+    return "unix", address
+
+
+def connect(address: str, timeout_s: float = 10.0) -> socket.socket:
+    kind, target = parse_address(address)
+    if kind == "tcp":
+        return socket.create_connection(target, timeout=timeout_s)
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout_s)
+    s.connect(target)
+    return s

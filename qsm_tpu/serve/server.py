@@ -1,0 +1,565 @@
+"""``CheckServer`` — long-lived, warm, batched linearizability checking.
+
+Every one-shot entry point (CLI run/check, the bench tools) pays engine
+construction, compile-bucket warmup and planner profiling per
+invocation, and identical histories re-check from scratch.  The server
+is the inference-stack shape the ROADMAP's serving north star names —
+admission → micro-batch → dispatch → cache — over the existing planes:
+
+* **Warm engine set** — one engine per spec, built once via the search
+  planner (``search/planner.py plan_search`` supplies the plan and its
+  ``why`` provenance) and wrapped in ``resilience.FailoverBackend``: a
+  wedged device degrades the SERVER to the exact host ladder, not the
+  request.  The default ``auto`` engine is the host cpp→memo ladder —
+  today's honest fast path (README) — kept warm and shared.
+* **Micro-batching** — ``batcher.MicroBatcher`` coalesces lanes from
+  concurrent connections into one padded dispatch per spec (N clients
+  share one backend call instead of N).
+* **Verdict cache** — ``cache.VerdictCache`` answers duplicate
+  submissions (and their witnesses) in O(1) from an atomic persistent
+  bank that survives server kill/restart.
+* **Admission** — ``admission.AdmissionController`` bounds in-flight
+  lanes and enforces per-request deadlines from the ``serve`` policy
+  preset; overload and lateness are answered ``SHED``, never wrong.
+* **Fault plane** — the batch dispatch runs through the ``serve``
+  fault site (``QSM_TPU_FAULTS=hang:serve`` / ``raise:serve``) under a
+  watchdog, so degraded-server behavior is CPU-testable like every
+  other degradation path (tests/test_serve.py).
+
+Wire protocol: serve/protocol.py (JSON lines over TCP or UNIX socket).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.history import History
+from ..ops.backend import Verdict, device_error_types
+from ..resilience.failover import (FailoverBackend, collect_resilience,
+                                   host_fallback)
+from ..resilience.faults import inject
+from ..resilience.policy import RetryPolicy, preset, watchdog
+from ..search.stats import collect_search_stats, stats_delta
+from .admission import AdmissionController
+from .batcher import Lane, MicroBatcher
+from .cache import VerdictCache, fingerprint_key
+from .protocol import (VERDICT_NAMES, LineChannel, rows_to_history,
+                       send_doc)
+
+
+class _EngineEntry:
+    """One warm spec: engine + witness oracle + planner provenance."""
+
+    __slots__ = ("spec", "engine", "oracle", "plan_why", "emergency")
+
+    def __init__(self, spec, engine, oracle, plan_why):
+        self.spec = spec
+        self.engine = engine
+        self.oracle = oracle
+        self.plan_why = plan_why
+        self.emergency = None  # built on first serve-site fault
+
+
+class _PendingRequest:
+    """Per-request lane accounting: connection thread waits, cache hits
+    and batch dispatches resolve."""
+
+    def __init__(self, n: int):
+        self._lock = threading.Lock()
+        self._remaining = n
+        self.verdicts: List[Optional[int]] = [None] * n
+        self.cached: List[bool] = [False] * n
+        self.witnesses: List[Optional[list]] = [None] * n
+        self.lane_submitted: List[bool] = [False] * n  # batcher owns it
+        self.batches: List[dict] = []
+        self.dead = False  # shed: late resolutions are cache-only
+        self._done = threading.Event()
+        if n == 0:
+            self._done.set()
+
+    def resolve(self, i: int, verdict: int, cached: bool = False,
+                witness: Optional[list] = None,
+                batch: Optional[dict] = None) -> None:
+        with self._lock:
+            if self.verdicts[i] is not None:
+                return
+            self.verdicts[i] = int(verdict)
+            self.cached[i] = cached
+            self.witnesses[i] = witness
+            if batch is not None and batch.get("batch") not in {
+                    b.get("batch") for b in self.batches}:
+                self.batches.append(batch)
+            self._remaining -= 1
+            if self._remaining == 0:
+                self._done.set()
+
+    def wait(self, timeout_s: float) -> bool:
+        return self._done.wait(max(0.0, timeout_s))
+
+
+class CheckServer:
+    """See module docstring.  ``start()`` binds and returns; the accept
+    loop, connection readers and the batcher run on daemon threads."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 unix_path: Optional[str] = None, *,
+                 engine: str = "auto",
+                 max_lanes: int = 64, flush_s: float = 0.02,
+                 queue_depth: int = 1024,
+                 cache_path: Optional[str] = None,
+                 cache_entries: int = 4096,
+                 policy: Optional[RetryPolicy] = None,
+                 allow_shutdown: bool = True,
+                 engine_factory=None):
+        if engine not in ("auto", "planned"):
+            raise ValueError(f"unknown serve engine {engine!r}; "
+                             "one of ('auto', 'planned')")
+        self.host, self.port, self.unix_path = host, port, unix_path
+        self.engine_kind = engine
+        self.policy = policy or preset("serve")
+        self.max_lanes = max_lanes
+        self.allow_shutdown = allow_shutdown
+        self._engine_factory = engine_factory
+        self.cache = VerdictCache(max_entries=cache_entries,
+                                  path=cache_path)
+        self.admission = AdmissionController(queue_depth=queue_depth,
+                                             policy=self.policy)
+        self.batcher = MicroBatcher(self._dispatch, max_lanes=max_lanes,
+                                    flush_s=flush_s,
+                                    queue_depth=max(queue_depth * 2, 64))
+        self._engines: Dict[str, _EngineEntry] = {}
+        self._engines_lock = threading.Lock()
+        self._engine_builds: Dict[str, threading.Lock] = {}
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._t0 = time.monotonic()
+        self.requests = 0
+        self.histories = 0
+        self.serve_faults = 0       # serve-site degradations (batch level)
+        self.budget_resolved = 0    # engine BUDGET_EXCEEDED → oracle-exact
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def address(self) -> str:
+        if self.unix_path:
+            return self.unix_path
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "CheckServer":
+        if self.unix_path:
+            try:
+                os.unlink(self.unix_path)
+            except OSError:
+                pass
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(self.unix_path)
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((self.host, self.port))
+            self.port = self._sock.getsockname()[1]
+        self._sock.listen(64)
+        self._sock.settimeout(0.2)  # accept loop stays shutdown-checkable
+        self.batcher.start()
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="qsm-serve-accept")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.batcher.stop()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self.unix_path:
+            try:
+                os.unlink(self.unix_path)
+            except OSError:
+                pass
+        self.cache.flush()
+
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        """Block until the server stops (shutdown request / stop());
+        True when it did."""
+        return self._stop.wait(timeout_s)
+
+    # -- engines -------------------------------------------------------
+    def warm(self, model: str, spec_kwargs: Optional[dict] = None) -> None:
+        """Build (and warm-dispatch) the engine for a spec up front so
+        the first request pays nothing."""
+        entry = self._engine_for(model, spec_kwargs or {})
+        pad = [History([])] * self.max_lanes
+        entry.engine.check_histories(entry.spec, pad)
+
+    def _spec_key(self, model: str, spec_kwargs: dict) -> str:
+        return json.dumps([model, spec_kwargs or {}], sort_keys=True)
+
+    def _engine_for(self, model: str, spec_kwargs: dict) -> _EngineEntry:
+        key = self._spec_key(model, spec_kwargs)
+        with self._engines_lock:
+            entry = self._engines.get(key)
+            if entry is not None:
+                return entry
+            build_lock = self._engine_builds.setdefault(
+                key, threading.Lock())
+        # construction happens OUTSIDE the global map lock (a planned
+        # device build can take tens of seconds; warm specs' lookups and
+        # the batcher's dispatch must not block behind it) but under a
+        # per-key lock so each spec still gets exactly ONE engine — the
+        # resilience/search counters aggregate per instance
+        with build_lock:
+            with self._engines_lock:
+                entry = self._engines.get(key)
+                if entry is not None:
+                    return entry
+            entry = self._build_engine(model, spec_kwargs)
+            with self._engines_lock:
+                self._engines[key] = entry
+            return entry
+
+    def _build_engine(self, model: str, spec_kwargs: dict) -> _EngineEntry:
+        from ..models.registry import make
+        from ..ops.wing_gong_cpu import WingGongCPU
+        from ..search.planner import plan_search
+
+        spec, _ = make(model, "atomic", spec_kwargs or None)
+        if self._engine_factory is not None:
+            inner, plan_why = self._engine_factory(spec), ["injected"]
+        elif self.engine_kind == "planned":
+            # the planner-built device checker; same reachability
+            # contract as --backend tpu (the CLI gates before start)
+            from ..search.planner import build_backend
+
+            plan = plan_search(spec, platform=None)
+            inner, plan_why = build_backend(spec, plan), list(plan.why)
+        else:
+            # today's fast path: the exact host ladder (native C++
+            # when the toolchain builds, else the memoised oracle),
+            # warm and shared.  The plan is still computed for its
+            # provenance — the response's `why` says what a device
+            # plan WOULD do for this spec.
+            plan = plan_search(spec, platform="cpu")
+            inner, plan_why = host_fallback(spec), list(plan.why)
+        engine = FailoverBackend(spec, inner)
+        oracle = WingGongCPU(memo=True)
+        return _EngineEntry(spec, engine, oracle, plan_why)
+
+    # -- accept / connection plumbing ----------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # socket closed by stop()
+            # daemon, never joined, and NOT retained: a long-lived
+            # server accepting one connection per stats poll would
+            # otherwise grow an unbounded thread list — the same
+            # accumulation hazard the QSM-SERVE-UNBOUNDED lint exists
+            # for, at the object level
+            threading.Thread(target=self._serve_connection,
+                             args=(conn,), daemon=True,
+                             name="qsm-serve-conn").start()
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        chan = LineChannel(conn)
+        try:
+            while not self._stop.is_set():
+                line = chan.read_line(stop=self._stop.is_set)
+                if line is None:
+                    return
+                try:
+                    req = json.loads(line)
+                except ValueError:
+                    send_doc(conn, {"ok": False, "error": "bad json"})
+                    continue
+                self._handle(conn, req)
+                if req.get("op") == "shutdown" and self.allow_shutdown:
+                    return
+        except OSError:
+            pass  # peer went away mid-response
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, conn: socket.socket, req: dict) -> None:
+        op = req.get("op", "check")
+        if op == "stats":
+            send_doc(conn, {"ok": True, "stats": self.stats()})
+        elif op == "shutdown":
+            if self.allow_shutdown:
+                send_doc(conn, {"ok": True, "stopping": True})
+                self.stop()
+            else:
+                send_doc(conn, {"ok": False,
+                                "error": "shutdown disabled"})
+        elif op == "check":
+            try:
+                self._handle_check(conn, req)
+            except OSError:
+                raise  # the peer went away: let the connection close
+            except Exception as e:  # noqa: BLE001 — a malformed request
+                # (bad rows, bad spec_kwargs, a failing engine build)
+                # must answer an error, not kill the connection thread;
+                # no admission slots are held here (_handle_check admits
+                # only after validation and releases on its own errors)
+                send_doc(conn, {"id": req.get("id"), "ok": False,
+                                "error": f"{type(e).__name__}: {e}"})
+        else:
+            send_doc(conn, {"ok": False, "error": f"unknown op {op!r}"})
+
+    # -- the check path ------------------------------------------------
+    def _handle_check(self, conn: socket.socket, req: dict) -> None:
+        from ..models.registry import MODELS
+
+        t_req = time.perf_counter()
+        model = req.get("model")
+        if model not in MODELS:
+            send_doc(conn, {"id": req.get("id"), "ok": False,
+                            "error": f"unknown model {model!r}; one of "
+                                     f"{sorted(MODELS)}"})
+            return
+        rows_list = req.get("histories")
+        if rows_list is None and "history" in req:
+            rows_list = [req["history"]]
+        if not isinstance(rows_list, list) or not rows_list:
+            send_doc(conn, {"id": req.get("id"), "ok": False,
+                            "error": "request needs a non-empty "
+                                     "'histories' (or 'history') array"})
+            return
+        hists = [rows_to_history(rows) for rows in rows_list]
+        spec_kwargs = req.get("spec_kwargs") or {}
+        want_witness = bool(req.get("witness"))
+        deadline = self.admission.deadline_for(req.get("deadline_s"))
+        self.requests += 1
+
+        # engine construction/validation BEFORE admission: bad
+        # spec_kwargs (or a failing device build) must never reserve
+        # lanes it cannot use
+        entry = self._engine_for(model, spec_kwargs)
+        spec_key = self._spec_key(model, spec_kwargs)
+        if not self.admission.try_admit(len(hists)):
+            send_doc(conn, self._shed(req, "queue full"))
+            return
+        pending = _PendingRequest(len(hists))
+        self.histories += len(hists)
+        # exactly-once release per admitted lane, whatever path resolves
+        # it (cache hit, witness search, batch dispatch, mid-request
+        # shed, or an unexpected exception below) — a leaked slot would
+        # permanently shrink queue_depth until the server sheds all
+        # traffic
+        released = [False] * len(hists)
+        rel_lock = threading.Lock()
+
+        def release_lane(i: int) -> None:
+            with rel_lock:
+                if released[i]:
+                    return
+                released[i] = True
+            self.admission.release(1)
+
+        try:
+            self._check_admitted(conn, req, entry, spec_key, hists,
+                                 pending, deadline, want_witness,
+                                 release_lane, t_req, model)
+        except Exception as e:
+            # the request dies, its slots must not: lanes the batcher
+            # owns release via their resolvers; everything else here
+            pending.dead = True
+            for j in range(len(hists)):
+                if not pending.lane_submitted[j]:
+                    release_lane(j)
+            send_doc(conn, {"id": req.get("id"), "ok": False,
+                            "error": f"{type(e).__name__}: {e}"})
+
+    def _check_admitted(self, conn, req, entry, spec_key, hists, pending,
+                        deadline, want_witness, release_lane, t_req,
+                        model) -> None:
+        for i, h in enumerate(hists):
+            key = fingerprint_key(entry.spec, h)
+            e = self.cache.get(key)
+            if e is not None and not (want_witness and e.witness is None
+                                      and e.verdict
+                                      == int(Verdict.LINEARIZABLE)):
+                # O(1) banked verdict (and witness when asked for one —
+                # a hit missing a needed witness falls through to the
+                # one-search witness path below)
+                pending.resolve(i, e.verdict, cached=True,
+                                witness=e.witness)
+                release_lane(i)
+            elif want_witness:
+                # ONE host-oracle search serves verdict AND witness
+                # (the replay/check CLI rule); bounded by the request
+                # deadline between items
+                if time.monotonic() >= deadline:
+                    pending.dead = True
+                    self.admission.shed_late()
+                    self._release_unsubmitted(pending, release_lane)
+                    send_doc(conn, self._shed(req, "deadline"))
+                    return
+                v, w = entry.oracle.check_witness(entry.spec, h)
+                self.cache.put(key, int(v), w)
+                pending.resolve(i, int(v), witness=w)
+                release_lane(i)
+            else:
+                lane = Lane(key=key, history=h, deadline=deadline,
+                            resolve=self._lane_resolver(pending, i,
+                                                        release_lane))
+                pending.lane_submitted[i] = True
+                if not self.batcher.submit(spec_key, lane):
+                    pending.lane_submitted[i] = False
+                    pending.dead = True
+                    self._release_unsubmitted(pending, release_lane)
+                    send_doc(conn, self._shed(req, "batcher full"))
+                    return
+        if not pending.wait(deadline - time.monotonic()):
+            # the deadline fired with lanes still in flight: SHED —
+            # never a partial or late answer.  In-flight lanes complete
+            # into the cache (their admission slots release there).
+            pending.dead = True
+            self.admission.shed_late()
+            send_doc(conn, self._shed(req, "deadline"))
+            return
+        verdicts = [int(v) for v in pending.verdicts]
+        doc = {
+            "id": req.get("id"), "ok": True,
+            "model": model,
+            "verdicts": [VERDICT_NAMES[v] for v in verdicts],
+            "cached": list(pending.cached),
+            "violations": sum(v == int(Verdict.VIOLATION)
+                              for v in verdicts),
+            "undecided": sum(v == int(Verdict.BUDGET_EXCEEDED)
+                             for v in verdicts),
+            "batches": list(pending.batches),
+            "plan_why": entry.plan_why,
+            "resilience": collect_resilience(entry.engine),
+            "seconds": round(time.perf_counter() - t_req, 4),
+        }
+        if want_witness:
+            doc["witnesses"] = [
+                [list(p) for p in w] if w is not None else None
+                for w in pending.witnesses]
+        send_doc(conn, doc)
+
+    @staticmethod
+    def _lane_resolver(pending: _PendingRequest, i: int, release_lane):
+        def _resolve(verdict: int, batch: dict) -> None:
+            pending.resolve(i, verdict, batch=batch)
+            release_lane(i)
+
+        return _resolve
+
+    @staticmethod
+    def _release_unsubmitted(pending: _PendingRequest,
+                             release_lane) -> None:
+        """Mid-request shed: slots of lanes the batcher does NOT own
+        (submitted lanes release via their resolvers on dispatch)."""
+        for j in range(len(pending.verdicts)):
+            if not pending.lane_submitted[j]:
+                release_lane(j)
+                pending.resolve(j, int(Verdict.BUDGET_EXCEEDED))
+
+    @staticmethod
+    def _shed(req: dict, reason: str) -> dict:
+        return {"id": req.get("id"), "ok": False, "shed": True,
+                "reason": reason}
+
+    # -- batch dispatch (the `serve` fault site) -----------------------
+    def _dispatch(self, spec_key: str, lanes: List[Lane],
+                  why: dict) -> None:
+        model, spec_kwargs = json.loads(spec_key)
+        entry = self._engine_for(model, spec_kwargs)
+        hists = [lane.history for lane in lanes]
+        from ..core.history import bucket_for
+
+        width = why["width"]
+        padded = hists + [History([])] * (width - len(hists))
+        st0 = collect_search_stats(entry.engine)
+
+        def work():
+            # the CPU-testable request-dispatch fault site
+            # (resilience/faults.py): QSM_TPU_FAULTS=hang:serve wedges
+            # here and the watchdog abandons it; raise:serve raises
+            inject("serve")
+            return entry.engine.check_histories(entry.spec, padded)
+
+        try:
+            verdicts = np.asarray(
+                watchdog(work, self.policy.timeout_s,
+                         label="serve.dispatch"))[:len(hists)]
+        except device_error_types() as e:
+            # server-level degradation: the warm engine (failover
+            # ladder included) is gone for this batch — re-dispatch on
+            # a dedicated emergency host ladder so the SERVER stays up
+            # with exact verdicts, and count it
+            self.serve_faults += 1
+            if entry.emergency is None:
+                entry.emergency = host_fallback(entry.spec)
+            verdicts = np.asarray(entry.emergency.check_histories(
+                entry.spec, padded))[:len(hists)]
+            why = {**why, "degraded": f"{type(e).__name__}"}
+        # engine-relative BUDGET_EXCEEDED resolves via the witness
+        # oracle (the property layer's rule) unless the engine IS that
+        # ladder — re-running an identical search only repeats itself
+        todo = [i for i, v in enumerate(verdicts)
+                if v == int(Verdict.BUDGET_EXCEEDED)]
+        if todo and self.engine_kind != "auto":
+            sub = entry.oracle.check_histories(
+                entry.spec, [hists[i] for i in todo])
+            for i, v in zip(todo, sub):
+                verdicts[i] = int(v)
+                self.budget_resolved += 1
+        st = stats_delta(collect_search_stats(entry.engine), st0)
+        why = {**why, "model": model,
+               "bucket": bucket_for(max((len(h) for h in hists),
+                                        default=1))}
+        if st is not None:
+            why["search"] = st.to_compact()
+        # one bank flush for the whole batch (put_many), then resolve
+        self.cache.put_many((lane.key, int(v), None)
+                            for lane, v in zip(lanes, verdicts))
+        for lane, v in zip(lanes, verdicts):
+            lane.resolve(int(v), why)
+
+    # -- observability -------------------------------------------------
+    def stats(self) -> dict:
+        """The aggregate the ``stats`` op (and ``qsm-tpu stats --serve``)
+        returns: every counter a capacity decision needs, self-describing
+        about batching, caching, shedding and degradation."""
+        engines = {}
+        for key, entry in list(self._engines.items()):
+            st = collect_search_stats(entry.engine)
+            engines[key] = {
+                "engine": getattr(entry.engine, "name",
+                                  type(entry.engine).__name__),
+                "resilience": collect_resilience(entry.engine),
+                "search": st.to_compact() if st is not None else None,
+            }
+        return {
+            "address": self.address,
+            "uptime_s": round(time.monotonic() - self._t0, 1),
+            "engine_kind": self.engine_kind,
+            "requests": self.requests,
+            "histories": self.histories,
+            "serve_faults": self.serve_faults,
+            "budget_resolved": self.budget_resolved,
+            "admission": self.admission.snapshot(),
+            "batcher": self.batcher.snapshot(),
+            "cache": self.cache.stats(),
+            "engines": engines,
+        }

@@ -5,7 +5,9 @@ The reference's knobs are QuickCheck ``Args`` (maxSuccess, replay seed, size)
 ``run`` (property check), ``replay`` (reproduce a persisted failure),
 ``bench`` (checker throughput), ``stats`` (search-cost accounting —
 qsm_tpu/search), ``coverage`` (schedule diversity), ``lint`` (the
-qsmlint static analyzer — docs/ANALYSIS.md).
+qsmlint static analyzer — docs/ANALYSIS.md), ``serve``/``submit`` (the
+long-lived check server and its client — qsm_tpu/serve,
+docs/SERVING.md).
 """
 
 from __future__ import annotations
@@ -36,8 +38,10 @@ _BACKENDS = ("auto", "auto-tpu", "cpu", "cpp", "tpu", "hybrid-tpu",
              "pallas-tpu", "pcomp", "pcomp-cpp", "pcomp-tpu", "segdc",
              "segdc-cpp", "segdc-tpu", "rootsplit", "rootsplit-tpu")
 
-# index == Verdict value (ops/backend.py); ONE site for the rendering
-_VERDICT_NAMES = ("VIOLATION", "LINEARIZABLE", "BUDGET_EXCEEDED")
+# index == Verdict value (ops/backend.py); ONE site for the rendering —
+# the serving plane's wire protocol owns it (serve/protocol.py) so the
+# server, the client, and every subcommand render identically
+from ..serve.protocol import VERDICT_NAMES as _VERDICT_NAMES
 
 
 def _ensure_device_reachable(timeout_s: Optional[float] = None) -> None:
@@ -440,13 +444,102 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Run the check server (qsm_tpu/serve): warm engines, cross-request
+    micro-batching, verdict cache, bounded admission — docs/SERVING.md.
+    Prints ONE JSON line with the bound address, then serves until a
+    ``shutdown`` request (or SIGINT)."""
+    from ..serve.server import CheckServer
+
+    if args.engine == "planned":
+        # the planner-built device engine initializes jax backends at
+        # first request: gate exactly like --backend tpu
+        _ensure_device_reachable()
+    server = CheckServer(
+        host=args.host, port=args.port, unix_path=args.unix,
+        engine=args.engine, max_lanes=args.max_lanes,
+        flush_s=args.flush_ms / 1000.0, queue_depth=args.queue_depth,
+        cache_path=args.cache, cache_entries=args.cache_entries)
+    warm = [m.strip() for m in args.warm.split(",")] if args.warm else []
+    warm = [m for m in warm if m]
+    unknown = sorted(set(warm) - set(MODELS))
+    if unknown:
+        # a typo'd --warm must fail loudly, not silently serve cold —
+        # warmup is the amortization the flag exists for
+        raise SystemExit(f"--warm: unknown models {unknown}; "
+                         f"one of {sorted(MODELS)}")
+    server.start()
+    try:
+        for model in warm:
+            server.warm(model)
+        print(json.dumps({"serving": server.address,
+                          "engine": args.engine,
+                          "max_lanes": args.max_lanes,
+                          "flush_ms": args.flush_ms,
+                          "queue_depth": args.queue_depth,
+                          "cache": args.cache}), flush=True)
+        server.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+def cmd_submit(args) -> int:
+    """Submit an external trace file (the ``check`` CLI's JSON format —
+    a ``history`` or ``histories`` rows array) to a running check
+    server.  Exit codes mirror ``check``'s batch form: 0 all
+    linearizable, 1 some violation, 2 undecided, 3 shed/error."""
+    from ..serve.client import CheckClient
+
+    with open(args.trace) as f:
+        doc = json.load(f)
+    model = args.model or doc.get("model")
+    if not model:
+        raise SystemExit("trace has no 'model'; pass --model")
+    rows = doc.get("histories")
+    if rows is None:
+        if "history" not in doc:
+            raise SystemExit(
+                "trace needs a 'history' (or 'histories') array of "
+                "[pid, cmd, arg, resp, invoke_time, response_time] rows")
+        rows = [doc["history"]]
+    client = CheckClient(args.addr, timeout_s=args.timeout)
+    try:
+        res = client.check(model, rows,
+                           spec_kwargs=doc.get("spec_kwargs") or None,
+                           witness=args.witness,
+                           deadline_s=args.deadline)
+    finally:
+        client.close()
+    print(json.dumps(res))
+    if not res.get("ok"):
+        return 3
+    if res.get("violations"):
+        return 1
+    return 2 if res.get("undecided") else 0
+
+
 def cmd_stats(args) -> int:
     """Search-cost accounting for one backend on one corpus: the
     iterations-per-history / nodes-per-history decomposition of the
     ``vs_best_host`` gap as ONE JSON document (search/stats.py).  Also
     prints the corpus profile and the plan ``plan_search`` would pick for
     it — ``--planned`` actually runs the planned backend (device
-    engines only; the planner's levers are the kernel driver's)."""
+    engines only; the planner's levers are the kernel driver's).
+    ``--serve ADDR`` instead prints a RUNNING check server's aggregate
+    stats (requests, batch occupancy, cache hit rate, shed counts, and
+    the per-engine SearchStats/resilience blocks every response rides)."""
+    if getattr(args, "serve", None):
+        from ..serve.client import CheckClient
+
+        client = CheckClient(args.serve)
+        try:
+            print(json.dumps(client.stats().get("stats", {})))
+        finally:
+            client.close()
+        return 0
     import numpy as np
 
     from ..resilience.failover import FailoverBackend, collect_resilience
@@ -971,6 +1064,58 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_list)
 
     p = sub.add_parser(
+        "serve",
+        help="run the check server (warm engines, micro-batching, "
+             "verdict cache, bounded admission — docs/SERVING.md)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="TCP port (0 = ephemeral; the bound address is "
+                        "printed as one JSON line)")
+    p.add_argument("--unix", default=None,
+                   help="serve on this UNIX socket path instead of TCP")
+    p.add_argument("--engine", default="auto",
+                   choices=["auto", "planned"],
+                   help="auto = the warm host cpp->memo ladder (today's "
+                        "fast path); planned = the plan_search-built "
+                        "device checker (needs a reachable device)")
+    p.add_argument("--max-lanes", type=int, default=64,
+                   help="micro-batch width: lanes coalesced per dispatch")
+    p.add_argument("--flush-ms", type=float, default=20.0,
+                   help="micro-batch flush interval (latency floor for "
+                        "a lone client)")
+    p.add_argument("--queue-depth", type=int, default=1024,
+                   help="admission bound on in-flight history lanes; "
+                        "past it requests are SHED, never queued "
+                        "unboundedly")
+    p.add_argument("--cache", default=None,
+                   help="persistent verdict-cache bank path (JSONL, "
+                        "atomic; survives kill/restart)")
+    p.add_argument("--cache-entries", type=int, default=4096)
+    p.add_argument("--warm", default=None,
+                   help="comma list of models to pre-build engines for")
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "submit",
+        help="submit a trace file (the `check` format) to a running "
+             "check server")
+    p.add_argument("--addr", required=True,
+                   help="server address: host:port or a UNIX socket path")
+    p.add_argument("--trace", required=True)
+    p.add_argument("--model", default=None, choices=sorted(MODELS),
+                   help="overrides the trace's own 'model' field")
+    p.add_argument("--witness", action="store_true",
+                   help="include verified linearization orders (served "
+                        "from the cache bank on duplicates)")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="per-request deadline seconds (default: the "
+                        "'serve' policy preset); past it the server "
+                        "answers SHED")
+    p.add_argument("--timeout", type=float, default=60.0,
+                   help="client-side response bound")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser(
         "lint",
         help="static spec/kernel/determinism analysis (CPU-only; exit 1 "
              "on non-whitelisted error findings)")
@@ -1055,6 +1200,11 @@ def main(argv=None) -> int:
     p.add_argument("--failover", action="store_true",
                    help="wrap the backend in a FailoverBackend and report "
                         "its degradation counters (resilience plane)")
+    p.add_argument("--serve", default=None, metavar="ADDR",
+                   help="print a running check server's aggregate stats "
+                        "(requests, batch occupancy, cache hit rate, "
+                        "sheds, per-engine search/resilience counters) "
+                        "instead of running a corpus")
     p.add_argument("--pids", type=int, default=None)
     p.add_argument("--ops", type=int, default=None)
     p.add_argument("--corpus", type=int, default=64)

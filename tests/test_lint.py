@@ -17,6 +17,7 @@ from qsm_tpu.analysis import (ERROR, Finding, Whitelist, run_lint)
 from qsm_tpu.analysis.engine import (DEFAULT_OPS_FILES,
                                      DEFAULT_RESILIENCE_FILES,
                                      DEFAULT_SCHED_FILES,
+                                     DEFAULT_SERVE_FILES,
                                      _retrace_corpora)
 from qsm_tpu.analysis.kernel_passes import (VMEM_BUDGET_BYTES,
                                             check_retracing,
@@ -46,6 +47,10 @@ def test_in_tree_corpus_is_clean(report):
     # plumbing and the artifact tools (bench.py, tools/)
     assert len(DEFAULT_RESILIENCE_FILES) >= 12
     assert "resilience" in report.passes
+    # the serving plane (family e): every connection-accepting /
+    # lane-buffering module plus the serve bench tool
+    assert len(DEFAULT_SERVE_FILES) == 7
+    assert "serve" in report.passes
     assert report.ok, "\n".join(
         f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
 
@@ -131,6 +136,68 @@ def test_unbounded_device_probe_is_caught():
     lit = by_rule.pop("QSM-RES-TIMEOUT-LITERAL")
     assert len(lit) == 1 and lit[0].severity == "warning"
     assert not by_rule  # nothing else fires on the fixture module
+
+
+def test_unbounded_serve_loop_is_caught():
+    """The serve pass's bulb check (family e): the while-True accept
+    loop with no deadline/shutdown check and the unbounded admission
+    queue each fire their rule exactly once; the stop-flag-gated and
+    settimeout-polled twins in the same fixture class must NOT be
+    flagged."""
+    from qsm_tpu.analysis.serve_passes import check_serve_file
+
+    findings = check_serve_file(fixtures.__file__)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule_id, []).append(f)
+    accept = by_rule.pop("QSM-SERVE-ACCEPT")
+    assert len(accept) == 1 and accept[0].severity == ERROR
+    assert "serve_forever_unbounded" in accept[0].location
+    unbounded = by_rule.pop("QSM-SERVE-UNBOUNDED")
+    assert len(unbounded) == 1
+    assert "serve_forever_unbounded" in unbounded[0].location
+    assert not by_rule  # nothing else fires on the fixture module
+
+
+def test_bounded_serve_idioms_are_clean(tmp_path):
+    """True-negative pin: the serving plane's own idioms — stop-flag
+    loop tests, settimeout-bounded polls, maxsize'd queues — must not
+    be flagged (a pass that cries wolf on the sanctioned forms gets
+    whitelisted into uselessness)."""
+    from qsm_tpu.analysis.serve_passes import check_serve_file
+
+    p = tmp_path / "stub.py"
+    p.write_text(
+        "import queue\n"
+        "class S:\n"
+        "    def loop(self, sock):\n"
+        "        q = queue.Queue(maxsize=8)\n"
+        "        sock.settimeout(0.2)\n"
+        "        while True:\n"
+        "            try:\n"
+        "                q.put(sock.accept(), block=False)\n"
+        "            except OSError:\n"
+        "                continue\n"
+        "    def gated(self, sock, stop):\n"
+        "        while not stop.is_set():\n"
+        "            sock.recv(4096)\n")
+    assert check_serve_file(str(p)) == []
+
+
+def test_queue_maxsize_zero_is_flagged_as_unbounded(tmp_path):
+    """The stdlib spells 'infinite' as Queue(maxsize=0) (negatives
+    too): an explicit-zero bound is exactly the unbounded hazard, not a
+    bound — the pass must not wave it through."""
+    from qsm_tpu.analysis.serve_passes import check_serve_file
+
+    p = tmp_path / "stub.py"
+    p.write_text("import queue\n"
+                 "a = queue.Queue(maxsize=0)\n"
+                 "b = queue.Queue(0)\n"
+                 "c = queue.Queue(maxsize=-1)\n"
+                 "d = queue.Queue(maxsize=8)   # ok: a real bound\n")
+    findings = check_serve_file(str(p))
+    assert [f.rule_id for f in findings] == ["QSM-SERVE-UNBOUNDED"] * 3
 
 
 def test_subprocess_with_timeout_is_clean(tmp_path):

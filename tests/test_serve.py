@@ -1,0 +1,452 @@
+"""Serving plane (qsm_tpu/serve) — the tier-1 gate for ISSUE 5.
+
+What is pinned, in order of importance:
+
+* served verdicts and witnesses are BIT-IDENTICAL to the direct host
+  path across ≥4 model families (the server changes where checking
+  happens, never what it answers);
+* a cache hit returns a banked witness that still replays through the
+  search-free ``verify_witness`` audit;
+* a server killed mid-bank and restarted serves the persisted cache
+  (atomic bank: a torn tail is dropped, banked entries survive);
+* deadline-exceeded and queue-full requests get an explicit ``SHED``,
+  never a wrong or partial verdict;
+* the ``serve`` fault site (hang/raise at request-dispatch) degrades
+  the batch to the exact host ladder — the server survives with
+  unchanged verdicts, CPU-only;
+* the fast serve smoke (in-process server, 2 concurrent clients, tiny
+  corpus) rides the default ``-m "not slow"`` lane.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from qsm_tpu.models.registry import MODELS
+from qsm_tpu.ops.backend import Verdict, verify_witness
+from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+from qsm_tpu.resilience.policy import preset
+from qsm_tpu.serve import (CheckClient, CheckServer, Lane, MicroBatcher,
+                           VERDICT_NAMES, VerdictCache)
+from qsm_tpu.utils.corpus import build_corpus
+
+# small everywhere: the serving plane moves checking, it does not need
+# big searches to prove that
+FAMILIES = ("register", "cas", "queue", "kv")
+
+
+def _corpus(family, n=10, pids=3, ops=8, prefix="serve"):
+    entry = MODELS[family]
+    spec = entry.make_spec()
+    hists = build_corpus(
+        spec, (entry.impls["atomic"], entry.impls["racy"]), n=n,
+        n_pids=pids, max_ops=ops, seed_prefix=f"{prefix}_{family}")
+    return spec, hists
+
+
+def _names(verdicts):
+    return [VERDICT_NAMES[int(v)] for v in verdicts]
+
+
+@pytest.fixture()
+def server(tmp_path):
+    srv = CheckServer(flush_s=0.005, max_lanes=16,
+                      cache_path=str(tmp_path / "bank.jsonl")).start()
+    yield srv
+    srv.stop()
+
+
+# --- verdict/witness parity with the direct path --------------------------
+
+def test_served_verdicts_bit_identical_across_families(server):
+    """The acceptance pin: across register/cas/queue/kv, the served path
+    answers exactly what the direct host checker answers — the engines
+    prop_concurrent dispatches to are the engines the server keeps warm."""
+    with CheckClient(server.address) as client:
+        for family in FAMILIES:
+            spec, hists = _corpus(family)
+            direct = WingGongCPU(memo=True).check_histories(spec, hists)
+            res = client.check(family, hists)
+            assert res["ok"], res
+            assert res["verdicts"] == _names(direct), family
+            # the parity sample must not be vacuous
+            assert "LINEARIZABLE" in res["verdicts"], family
+
+
+def test_served_witnesses_bit_identical(server):
+    """Witness requests ride the one-search rule (verdict AND witness
+    from the same host-oracle search): served witnesses equal the
+    direct oracle's and replay search-free."""
+    spec, hists = _corpus("cas", n=6)
+    oracle = WingGongCPU(memo=True)
+    with CheckClient(server.address) as client:
+        res = client.check("cas", hists, witness=True)
+    assert res["ok"]
+    for h, v, w in zip(hists, res["verdicts"], res["witnesses"]):
+        dv, dw = oracle.check_witness(spec, h)
+        assert v == VERDICT_NAMES[int(dv)]
+        if v == "LINEARIZABLE":
+            w = [tuple(p) for p in w]
+            assert w == dw
+            assert verify_witness(spec, h, w)
+        else:
+            assert w is None
+
+
+# --- caching --------------------------------------------------------------
+
+def test_cache_hit_returns_banked_witness_that_replays(server):
+    spec, hists = _corpus("register", n=6)
+    with CheckClient(server.address) as client:
+        first = client.check("register", hists, witness=True)
+        second = client.check("register", hists, witness=True)
+    assert first["ok"] and second["ok"]
+    assert not any(first["cached"])
+    assert all(second["cached"])
+    assert second["verdicts"] == first["verdicts"]
+    for h, v, w in zip(hists, second["verdicts"], second["witnesses"]):
+        if v == "LINEARIZABLE":
+            assert verify_witness(spec, h, [tuple(p) for p in w])
+
+
+def test_verdict_only_hit_then_witness_request_upgrades(server):
+    """A verdict-only bank must not starve a later witness request: the
+    hit without a witness falls through to the one-search path and the
+    bank upgrades."""
+    spec, hists = _corpus("register", n=4)
+    with CheckClient(server.address) as client:
+        plain = client.check("register", hists)
+        with_w = client.check("register", hists, witness=True)
+    assert plain["ok"] and with_w["ok"]
+    assert with_w["verdicts"] == plain["verdicts"]
+    for h, v, w in zip(hists, with_w["verdicts"], with_w["witnesses"]):
+        if v == "LINEARIZABLE":
+            assert w is not None
+            assert verify_witness(spec, h, [tuple(p) for p in w])
+
+
+def test_kill_mid_bank_then_restart_serves_persisted_cache(tmp_path):
+    """The bank is atomic per put: an abrupt kill (no graceful flush)
+    plus a torn trailing line still leaves every banked entry servable
+    by the next server generation — duplicates answer cached, O(1)."""
+    bank = str(tmp_path / "bank.jsonl")
+    spec, hists = _corpus("cas", n=8)
+    direct = WingGongCPU(memo=True).check_histories(spec, hists)
+
+    srv = CheckServer(flush_s=0.005, max_lanes=16, cache_path=bank).start()
+    try:
+        with CheckClient(srv.address) as client:
+            res = client.check("cas", hists)
+            assert res["ok"] and not any(res["cached"])
+    finally:
+        # abrupt: no cache.flush() beyond the per-put ones — the
+        # atomic-per-put discipline IS what this test pins
+        srv.stop()
+    with open(bank, "a") as f:
+        f.write('{"key": "torn-mid-wr')  # simulated torn tail
+
+    srv2 = CheckServer(flush_s=0.005, max_lanes=16, cache_path=bank).start()
+    try:
+        with CheckClient(srv2.address) as client:
+            res2 = client.check("cas", hists)
+        assert res2["ok"]
+        assert all(res2["cached"]), res2["cached"]
+        assert res2["verdicts"] == _names(direct)
+        assert srv2.stats()["cache"]["hits"] == len(hists)
+    finally:
+        srv2.stop()
+
+
+# --- shedding: explicit, never wrong --------------------------------------
+
+class _SlowEngine:
+    """Delegates to the memo oracle after a fixed stall (deadline bait)."""
+
+    name = "slow_stub"
+
+    def __init__(self, spec, stall_s=0.4):
+        self.inner = WingGongCPU(memo=True)
+        self.stall_s = stall_s
+
+    def check_histories(self, spec, histories):
+        time.sleep(self.stall_s)
+        return self.inner.check_histories(spec, histories)
+
+
+def test_deadline_exceeded_gets_shed_never_wrong(tmp_path):
+    srv = CheckServer(flush_s=0.005, max_lanes=16,
+                      engine_factory=lambda spec: _SlowEngine(spec)).start()
+    try:
+        with CheckClient(srv.address) as client:
+            spec, hists = _corpus("register", n=4)
+            res = client.check("register", hists, deadline_s=0.05)
+            assert res["ok"] is False
+            assert res["shed"] is True and res["reason"] == "deadline"
+            assert "verdicts" not in res  # shed carries NO verdicts
+        assert srv.stats()["admission"]["shed_deadline"] == 1
+    finally:
+        srv.stop()
+
+
+def test_bad_requests_do_not_leak_admission_slots(tmp_path):
+    """Review regression: a request that dies after validation (bogus
+    spec_kwargs, oracle trouble) must release every admitted lane —
+    leaked slots would shrink queue_depth until the server sheds ALL
+    traffic."""
+    srv = CheckServer(flush_s=0.005, max_lanes=16, queue_depth=8).start()
+    try:
+        spec, hists = _corpus("register", n=6)
+        with CheckClient(srv.address) as client:
+            for _ in range(3):
+                res = client.check("cas", hists,
+                                   spec_kwargs={"bogus": 1})
+                assert res["ok"] is False and "error" in res
+            assert srv.admission.snapshot()["in_flight"] == 0
+            # a valid 6-lane request still fits the depth-8 queue
+            res = client.check("register", hists)
+            assert res["ok"], res
+            direct = WingGongCPU(memo=True).check_histories(spec, hists)
+            assert res["verdicts"] == _names(direct)
+    finally:
+        srv.stop()
+
+
+def test_queue_full_gets_shed(tmp_path):
+    srv = CheckServer(flush_s=0.005, max_lanes=16, queue_depth=2).start()
+    try:
+        with CheckClient(srv.address) as client:
+            spec, hists = _corpus("register", n=5)
+            res = client.check("register", hists)
+            assert res["ok"] is False and res["shed"] is True
+            assert res["reason"] == "queue full"
+        assert srv.stats()["admission"]["shed_queue"] == 1
+    finally:
+        srv.stop()
+
+
+# --- the `serve` fault site -----------------------------------------------
+
+def test_serve_fault_raise_degrades_batch_not_server(monkeypatch, server):
+    """raise:serve fires at request-dispatch; the batch re-dispatches on
+    the emergency host ladder and verdicts stay exact — the degraded
+    SERVER keeps answering."""
+    spec, hists = _corpus("queue", n=6)
+    direct = WingGongCPU(memo=True).check_histories(spec, hists)
+    monkeypatch.setenv("QSM_TPU_FAULTS", "raise:serve")
+    with CheckClient(server.address) as client:
+        res = client.check("queue", hists)
+    assert res["ok"]
+    assert res["verdicts"] == _names(direct)
+    assert server.stats()["serve_faults"] >= 1
+    assert any(b.get("degraded") for b in res["batches"])
+
+
+def test_serve_fault_hang_is_watchdogged(monkeypatch, tmp_path):
+    """hang:serve wedges the dispatch; the serve policy's watchdog
+    abandons it and the emergency ladder answers — bounded, exact."""
+    monkeypatch.setenv("QSM_TPU_FAULTS", "hang:serve")
+    monkeypatch.setenv("QSM_TPU_FAULT_HANG_S", "5")
+    srv = CheckServer(flush_s=0.005, max_lanes=16,
+                      policy=preset("serve").with_(timeout_s=0.2)).start()
+    try:
+        spec, hists = _corpus("register", n=4)
+        direct = WingGongCPU(memo=True).check_histories(spec, hists)
+        t0 = time.monotonic()
+        with CheckClient(srv.address) as client:
+            res = client.check("register", hists)
+        assert res["ok"]
+        assert res["verdicts"] == _names(direct)
+        assert time.monotonic() - t0 < 4.0  # abandoned, not slept out
+        assert srv.stats()["serve_faults"] >= 1
+    finally:
+        srv.stop()
+
+
+# --- the CI serve smoke: 2 concurrent clients, default lane ---------------
+
+def test_serve_smoke_two_concurrent_clients(server):
+    """The fast serve smoke (ISSUE 5 satellite): in-process server, two
+    concurrent clients on distinct families, one shared micro-batching
+    plane — both get exact answers."""
+    results = {}
+
+    def drive(family):
+        spec, hists = _corpus(family, n=6)
+        direct = WingGongCPU(memo=True).check_histories(spec, hists)
+        with CheckClient(server.address) as client:
+            res = client.check(family, hists)
+        results[family] = (res, _names(direct))
+
+    threads = [threading.Thread(target=drive, args=(f,))
+               for f in ("register", "cas")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert set(results) == {"register", "cas"}
+    for family, (res, direct_names) in results.items():
+        assert res["ok"], family
+        assert res["verdicts"] == direct_names, family
+    st = server.stats()
+    assert st["requests"] == 2
+    assert st["batcher"]["batches"] >= 1
+    # every batch stamp is self-describing provenance
+    for res, _ in results.values():
+        for b in res["batches"]:
+            assert {"batch", "lanes", "width", "occupancy",
+                    "flush"} <= set(b)
+
+
+# --- CLI: submit + stats --serve ------------------------------------------
+
+def test_submit_and_stats_cli_roundtrip(server, tmp_path, capsys):
+    from qsm_tpu.utils.cli import main
+
+    spec, hists = _corpus("cas", n=4)
+    from qsm_tpu.serve.protocol import history_to_rows
+
+    trace = {"model": "cas",
+             "histories": [history_to_rows(h) for h in hists]}
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps(trace))
+    rc = main(["submit", "--addr", server.address, "--trace", str(path)])
+    doc = json.loads(capsys.readouterr().out.strip())
+    direct = WingGongCPU(memo=True).check_histories(spec, hists)
+    assert doc["verdicts"] == _names(direct)
+    n_vio = sum(v == "VIOLATION" for v in doc["verdicts"])
+    assert rc == (1 if n_vio else 0)
+
+    rc = main(["stats", "--serve", server.address])
+    stats = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0
+    assert stats["requests"] >= 1
+    assert "cache" in stats and "batcher" in stats and "admission" in stats
+
+
+# --- unit: batcher / cache / admission ------------------------------------
+
+def test_batcher_flushes_full_batches_immediately():
+    done = threading.Event()
+    batches = []
+
+    def dispatch(key, lanes, why):
+        batches.append((key, lanes, why))
+        for lane in lanes:
+            lane.resolve(1, why)
+        done.set()
+
+    b = MicroBatcher(dispatch, max_lanes=4, flush_s=5.0)
+    b.start()
+    try:
+        far = time.monotonic() + 60
+        for i in range(4):
+            assert b.submit("g", Lane(key=str(i), history=None,
+                                      deadline=far,
+                                      resolve=lambda v, w: None))
+        assert done.wait(2.0), "full batch did not flush"
+        key, lanes, why = batches[0]
+        assert len(lanes) == 4 and why["flush"] == "full"
+        assert why["occupancy"] == 1.0
+    finally:
+        b.stop()
+
+
+def test_batcher_interval_flush_for_lone_lane():
+    done = threading.Event()
+    stamps = []
+
+    def dispatch(key, lanes, why):
+        stamps.append(why)
+        done.set()
+
+    b = MicroBatcher(dispatch, max_lanes=64, flush_s=0.02)
+    b.start()
+    try:
+        b.submit("g", Lane(key="k", history=None,
+                           deadline=time.monotonic() + 60,
+                           resolve=lambda v, w: None))
+        assert done.wait(2.0), "lone lane never flushed"
+        assert stamps[0]["flush"] == "interval"
+        assert stamps[0]["lanes"] == 1
+    finally:
+        b.stop()
+
+
+def test_batcher_deadline_flush_preempts_interval():
+    done = threading.Event()
+    stamps = []
+
+    def dispatch(key, lanes, why):
+        stamps.append(why)
+        done.set()
+
+    b = MicroBatcher(dispatch, max_lanes=64, flush_s=1.0)
+    b.start()
+    try:
+        t0 = time.monotonic()
+        b.submit("g", Lane(key="k", history=None,
+                           deadline=time.monotonic() + 0.05,
+                           resolve=lambda v, w: None))
+        assert done.wait(2.0)
+        assert time.monotonic() - t0 < 0.9  # did not wait the interval
+        assert stamps[0]["flush"] == "deadline"
+    finally:
+        b.stop()
+
+
+def test_verdict_cache_lru_persistence_and_honesty(tmp_path):
+    bank = str(tmp_path / "bank.jsonl")
+    c = VerdictCache(max_entries=2, path=bank)
+    c.put("a", 1, witness=[(0, 1)])
+    c.put("b", 0)
+    c.put("undecided", 2)  # BUDGET_EXCEEDED must never bank
+    assert c.get("undecided") is None
+    assert c.get("a").witness == [(0, 1)]
+    c.put("c", 1)  # evicts LRU ("b": "a" was touched above)
+    assert c.get("b") is None
+    assert c.get("a") is not None
+
+    c2 = VerdictCache(max_entries=8, path=bank)
+    assert c2.get("a").verdict == 1
+    assert c2.get("a").witness == [(0, 1)]
+    assert c2.get("c").verdict == 1
+    # a verdict-only refresh must not drop a banked witness
+    c2.put("a", 1)
+    assert c2.get("a").witness == [(0, 1)]
+
+
+def test_verdict_cache_preserves_alien_file(tmp_path):
+    path = tmp_path / "not_a_bank.json"
+    path.write_text('{"something": "else"}\n')
+    c = VerdictCache(path=str(path))
+    assert len(c) == 0
+    # the alien file was preserved aside, never clobbered
+    assert (tmp_path / "not_a_bank.json.pre-resume").exists()
+    c.put("k", 1)
+    assert VerdictCache(path=str(path)).get("k") is not None
+
+
+def test_admission_bounds_and_counters():
+    from qsm_tpu.serve import AdmissionController
+
+    a = AdmissionController(queue_depth=4)
+    assert a.try_admit(3)
+    assert not a.try_admit(2)  # over depth: shed
+    assert a.try_admit(1)
+    a.release(4)
+    snap = a.snapshot()
+    assert snap["in_flight"] == 0
+    assert snap["shed_queue"] == 1
+    assert snap["admitted_lanes"] == 4
+    assert snap["completed_lanes"] == 4
+    assert snap["peak_in_flight"] == 4
+    assert snap["policy"] == "serve"
+
+
+def test_verdict_names_match_verdict_enum():
+    for v in Verdict:
+        assert VERDICT_NAMES[int(v)] == v.name

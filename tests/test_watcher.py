@@ -482,3 +482,48 @@ def test_partial_e2e_and_configs_banks_are_chased_resumably(
     w._seize_window(600.0)
     assert ("window_e2e", w.E2E_MIN_ROWS, True) in calls
     assert ("window_configs", w.CONFIGS_MIN_ROWS, True) in calls
+
+
+def test_probe_log_compaction_keeps_device_and_event_rows(
+        w, tmp_path, monkeypatch):
+    """The watcher-invoked compactor (tools/soak_prune.py
+    --compact-probe-log): device-hit rows and event rows survive
+    forever, failures keep only a bounded tail, the rewrite is atomic,
+    and the compaction logs its own event row."""
+    rows = [json.dumps({"ok": False, "is_device": False, "ts": i,
+                        "detail": "wedged"}) for i in range(30)]
+    rows.insert(5, json.dumps({"ok": True, "is_device": True,
+                               "platform": "tpu", "ts": 1000}))
+    rows.insert(12, json.dumps({"event": "window_lint", "ok": True}))
+    log = tmp_path / "probe_log.jsonl"
+    log.write_text("\n".join(rows) + "\n")
+    monkeypatch.setattr(w, "_PROBE_LOG_SIZE_FLOOR", 0)
+    monkeypatch.setattr(w, "PROBE_LOG_COMPACT_ROWS", 10)
+    monkeypatch.setattr(w, "PROBE_LOG_KEEP_FAILURES", 4)
+    w._maybe_compact_probe_log()
+    kept = [json.loads(ln) for ln in log.read_text().splitlines()
+            if ln.strip()]
+    assert sum(1 for r in kept if r.get("is_device")) == 1
+    assert any(r.get("event") == "window_lint" for r in kept)
+    # the compactor's own log line landed after the rewrite
+    compacts = [r for r in kept if r.get("event") == "probe_log_compact"]
+    assert len(compacts) == 1 and compacts[0]["ok"] is True
+    assert compacts[0]["rows_before"] == 32
+    failures = [r for r in kept
+                if not r.get("is_device") and "event" not in r]
+    assert len(failures) == 4
+    assert [r["ts"] for r in failures] == [26, 27, 28, 29]  # the tail
+
+
+def test_probe_log_compaction_is_a_noop_below_threshold(
+        w, tmp_path, monkeypatch):
+    log = tmp_path / "probe_log.jsonl"
+    log.write_text(json.dumps({"ok": False, "is_device": False}) + "\n")
+    before = log.read_text()
+    monkeypatch.setattr(w, "_PROBE_LOG_SIZE_FLOOR", 0)
+    monkeypatch.setattr(w, "PROBE_LOG_COMPACT_ROWS", 10)
+    calls = []
+    monkeypatch.setattr(w.subprocess, "run",
+                        lambda *a, **k: calls.append(a))
+    w._maybe_compact_probe_log()
+    assert log.read_text() == before and not calls

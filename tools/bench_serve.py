@@ -1,0 +1,304 @@
+"""Serving-plane bench — does warm + coalesced beat one-shot? (ISSUE 5)
+
+Every one-shot ``qsm-tpu check`` invocation pays interpreter startup,
+engine construction and compile-bucket warmup before the first verdict;
+the check server (qsm_tpu/serve) pays them once and amortizes across
+requests, coalescing concurrent clients into shared micro-batches.
+This tool prices exactly that trade, all on the CPU platform (the
+serving win is amortization + batching, not hardware):
+
+* ``baseline_cli``   — one-shot CLI per corpus: N subprocess reps of
+  ``qsm-tpu check --trace …`` over a fixed corpus; throughput =
+  corpus / median wall (full cost INCLUDING startup — that is the
+  point being amortized);
+* ``serve_c{1,2,4,8}`` — closed-loop concurrent clients against one
+  warm in-process server, each submitting DISTINCT corpora (zero cache
+  hits: this measures checking, not memoization); throughput, p50/p99
+  request latency, batch occupancy;
+* ``cache_hit``      — duplicate-corpus submissions: the O(1) banked-
+  verdict path, cold vs hit latency.
+
+Win condition (ISSUE 5 acceptance): served throughput at ≥4 concurrent
+clients ≥ 2× the one-shot baseline at unchanged verdicts, plus the
+cache-hit row.  Output: a resumable ``CellJournal`` (header + one row
+per cell; ``--resume`` re-runs zero completed cells) committed as
+``BENCH_SERVE_<tag>.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_PIDS = 4
+N_OPS = 10
+CLIENT_COUNTS = (1, 2, 4, 8)
+ROUNDS = 6           # closed-loop rounds per client
+BASELINE_REPS = 3
+CACHE_HIT_REPS = 20
+SUBPROC_TIMEOUT_S = 600.0
+
+
+def _build_corpora(n_corpora: int, corpus_n: int):
+    from qsm_tpu.models import AtomicCasSUT, CasSpec, RacyCasSUT
+    from qsm_tpu.utils.corpus import build_corpus
+
+    spec = CasSpec()
+    pool = []
+    for i in range(n_corpora):
+        pool.append(build_corpus(
+            spec, (AtomicCasSUT, RacyCasSUT), n=corpus_n, n_pids=N_PIDS,
+            max_ops=N_OPS, seed_base=i * 10_000,
+            seed_prefix=f"bench_serve_{i}"))
+    return spec, pool
+
+
+def _trace_doc(hists) -> dict:
+    from qsm_tpu.serve.protocol import history_to_rows
+
+    return {"model": "cas",
+            "histories": [history_to_rows(h) for h in hists]}
+
+
+def bench_baseline_cli(hists) -> dict:
+    """One-shot CLI per corpus: the cost every caller pays today."""
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False) as f:
+        json.dump(_trace_doc(hists), f)
+        path = f.name
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    secs, verdicts = [], None
+    try:
+        for _ in range(BASELINE_REPS):
+            t0 = time.perf_counter()
+            r = subprocess.run(
+                [sys.executable, "-m", "qsm_tpu", "check", "--trace",
+                 path, "--backend", "auto"],
+                capture_output=True, text=True, cwd=REPO, env=env,
+                timeout=SUBPROC_TIMEOUT_S)
+            secs.append(time.perf_counter() - t0)
+            line = (r.stdout or "").strip().splitlines()
+            verdicts = json.loads(line[-1])["verdicts"] if line else None
+    finally:
+        os.unlink(path)
+    med = float(np.median(secs))
+    return {"reps": BASELINE_REPS, "seconds_per_corpus": round(med, 3),
+            "all_seconds": [round(s, 3) for s in secs],
+            "histories": len(hists),
+            "histories_per_sec": round(len(hists) / med, 1),
+            "verdicts": verdicts,
+            "note": "includes interpreter startup + engine construction "
+                    "per invocation — the cost the server amortizes"}
+
+
+def _fresh_server(tmp_dir: str, cell: str):
+    """One server per cell, with a PER-CELL cache bank: a shared bank
+    would let an earlier cell's verdicts contaminate a later cell's
+    throughput (and turn the cache row's 'cold' request into a hit)."""
+    from qsm_tpu.serve.server import CheckServer
+
+    srv = CheckServer(flush_s=0.005, max_lanes=64,
+                      cache_path=os.path.join(tmp_dir, f"bank_{cell}.jsonl"))
+    srv.start()
+    srv.warm("cas")
+    return srv
+
+
+def bench_served(n_clients: int, pool, tmp_dir: str) -> dict:
+    """Closed-loop concurrent clients, distinct corpora (no cache hits):
+    the coalesced-dispatch throughput row."""
+    from qsm_tpu.serve.client import CheckClient
+
+    srv = _fresh_server(tmp_dir, f"c{n_clients}")
+    latencies: list = []
+    verdicts_first: dict = {}
+    errors: list = []
+    lock = threading.Lock()
+
+    def drive(ci: int):
+        try:
+            with CheckClient(srv.address, timeout_s=120.0) as client:
+                for r in range(ROUNDS):
+                    hists = pool[(ci * ROUNDS + r) % len(pool)]
+                    t0 = time.perf_counter()
+                    res = client.check("cas", hists)
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        latencies.append(dt)
+                        if not res.get("ok"):
+                            errors.append(res)
+                        elif ci == 0 and r == 0:
+                            verdicts_first["v"] = res["verdicts"]
+                            verdicts_first["cached"] = res["cached"]
+        except Exception as e:  # noqa: BLE001 — a dead client is a row fact
+            with lock:
+                errors.append({"error": f"{type(e).__name__}: {e}"})
+
+    threads = [threading.Thread(target=drive, args=(ci,))
+               for ci in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(SUBPROC_TIMEOUT_S)
+    wall = time.perf_counter() - t0
+    stats = srv.stats()
+    srv.stop()
+    corpus_n = len(pool[0])
+    total = n_clients * ROUNDS * corpus_n
+    lat = np.asarray(sorted(latencies)) if latencies else np.asarray([0.0])
+    return {
+        "clients": n_clients, "rounds": ROUNDS,
+        "histories": total, "seconds": round(wall, 3),
+        "histories_per_sec": round(total / wall, 1),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1000, 2),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1000, 2),
+        "batch_occupancy": stats["batcher"]["mean_occupancy"],
+        "batches": stats["batcher"]["batches"],
+        "cache_hit_rate": stats["cache"]["hit_rate"],
+        "shed": stats["admission"]["shed_queue"]
+        + stats["admission"]["shed_deadline"],
+        "errors": len(errors),
+        "verdicts_first_corpus": verdicts_first.get("v"),
+    }
+
+
+def bench_cache_hit(pool, tmp_dir: str) -> dict:
+    """Duplicate submissions: the O(1) banked-verdict path."""
+    from qsm_tpu.serve.client import CheckClient
+
+    srv = _fresh_server(tmp_dir, "cache_hit")
+    hists = pool[0]
+    with CheckClient(srv.address, timeout_s=120.0) as client:
+        t0 = time.perf_counter()
+        cold = client.check("cas", hists)
+        cold_s = time.perf_counter() - t0
+        hit_secs = []
+        all_cached = True
+        for _ in range(CACHE_HIT_REPS):
+            t0 = time.perf_counter()
+            res = client.check("cas", hists)
+            hit_secs.append(time.perf_counter() - t0)
+            all_cached = all_cached and all(res.get("cached", []))
+    stats = srv.stats()
+    srv.stop()
+    hit_p50 = float(np.percentile(np.asarray(hit_secs), 50))
+    return {
+        "histories": len(hists), "reps": CACHE_HIT_REPS,
+        "cold_ms": round(cold_s * 1000, 2),
+        "hit_p50_ms": round(hit_p50 * 1000, 2),
+        "hit_p99_ms": round(
+            float(np.percentile(np.asarray(hit_secs), 99)) * 1000, 2),
+        "speedup_vs_cold": round(cold_s / max(hit_p50, 1e-9), 1),
+        "all_cached": all_cached,
+        "cache_hit_rate": stats["cache"]["hit_rate"],
+        "verdicts_unchanged": cold.get("verdicts")
+        == _names_for(hists),
+    }
+
+
+def _names_for(hists):
+    from qsm_tpu.models import CasSpec
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+    from qsm_tpu.serve.protocol import VERDICT_NAMES
+
+    v = WingGongCPU(memo=True).check_histories(CasSpec(), hists)
+    return [VERDICT_NAMES[int(x)] for x in v]
+
+
+def run(corpus_n: int, tag: str, out_path: str | None,
+        resume: bool) -> int:
+    from qsm_tpu.resilience.checkpoint import CellJournal
+
+    path = out_path or os.path.join(REPO, f"BENCH_SERVE_{tag}.json")
+    header = {
+        "artifact": "BENCH_SERVE",
+        "device_fallback": None,  # host-side by design: the serving win
+        # is amortization + coalescing, measured where it is honest
+        "platform": "cpu",
+        "model": "cas", "pids": N_PIDS, "ops": N_OPS,
+        "corpus_n": corpus_n, "rounds": ROUNDS,
+        "engine": "auto (warm host cpp->memo ladder)",
+        "captured_iso": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+    }
+    journal = CellJournal(path, header, resume=resume)
+    todo = ["baseline_cli"] + [f"serve_c{c}" for c in CLIENT_COUNTS] \
+        + ["cache_hit"]
+    need_pool = any(journal.complete(k) is None for k in todo)
+    pool = None
+    if need_pool:
+        _spec, pool = _build_corpora(max(CLIENT_COUNTS) * ROUNDS, corpus_n)
+
+    with tempfile.TemporaryDirectory() as tmp_dir:
+        if journal.complete("baseline_cli") is None:
+            journal.emit("baseline_cli", bench_baseline_cli(pool[0]))
+        for c in CLIENT_COUNTS:
+            key = f"serve_c{c}"
+            if journal.complete(key) is None:
+                journal.emit(key, bench_served(c, pool, tmp_dir))
+        if journal.complete("cache_hit") is None:
+            journal.emit("cache_hit", bench_cache_hit(pool, tmp_dir))
+
+    base = journal.complete("baseline_cli")
+    c4 = journal.complete("serve_c4")
+    hit = journal.complete("cache_hit")
+    ratio = c4["histories_per_sec"] / max(base["histories_per_sec"], 1e-9)
+    unchanged = (base.get("verdicts") is not None
+                 and base["verdicts"] == c4.get("verdicts_first_corpus"))
+    summary = {
+        "metric": "served_vs_oneshot_cli_throughput",
+        "baseline_hps": base["histories_per_sec"],
+        "serve_c4_hps": c4["histories_per_sec"],
+        "ratio_c4": round(ratio, 1),
+        "gate_2x_at_4_clients": bool(ratio >= 2.0),
+        "verdicts_unchanged": bool(unchanged),
+        "cache_cold_ms": hit["cold_ms"],
+        "cache_hit_p50_ms": hit["hit_p50_ms"],
+        "cache_speedup": hit["speedup_vs_cold"],
+        "resumed_cells": journal.resumed_cells,
+        "artifact": os.path.basename(path),
+    }
+    if journal.complete("summary") is None:
+        journal.emit("summary", summary)
+    print(json.dumps(summary))
+    return 0 if (summary["gate_2x_at_4_clients"]
+                 and summary["verdicts_unchanged"]) else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--corpus", type=int, default=32,
+                    help="histories per request corpus")
+    ap.add_argument("--tag", default="r07")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--resume", action="store_true",
+                    help="adopt completed cells from a prior journal at "
+                         "the output path (resilience/checkpoint.py)")
+    args = ap.parse_args(argv)
+
+    from qsm_tpu.utils.device import force_cpu_platform
+
+    force_cpu_platform()
+    try:
+        return run(args.corpus, args.tag, args.out, args.resume)
+    except Exception as e:  # noqa: BLE001 — diagnostic line, not a traceback
+        print(json.dumps({"metric": "served_vs_oneshot_cli_throughput",
+                          "error": f"{type(e).__name__}: {e}"}))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,6 +177,44 @@ def _log(**rec) -> None:
         f.write(json.dumps(rec) + "\n")
 
 
+# probe_log.jsonl is append-only and was at 717 rows (9 device hits) by
+# round 6 — almost all of it the same wedged-tunnel line.  Past this
+# many rows the watcher compacts it via tools/soak_prune.py
+# --compact-probe-log (atomic; keeps every device-hit row, every event
+# row, and the last N failures for cadence context).
+PROBE_LOG_COMPACT_ROWS = 2000
+PROBE_LOG_KEEP_FAILURES = 500
+# size precheck so the steady loop never line-counts a small log
+_PROBE_LOG_SIZE_FLOOR = 64 * 1024
+
+
+def _maybe_compact_probe_log() -> None:
+    try:
+        if os.path.getsize(LOG) < _PROBE_LOG_SIZE_FLOOR:
+            return
+        with open(LOG) as f:
+            rows = sum(1 for ln in f if ln.strip())
+    except OSError:
+        return
+    if rows <= PROBE_LOG_COMPACT_ROWS:
+        return
+    # the compactor lives next to this file — resolve by module
+    # location, not REPO (tests sandbox REPO into a tmp dir)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "soak_prune.py")
+    try:
+        r = subprocess.run(
+            [sys.executable, script, "--compact-probe-log", LOG,
+             "--keep-failures", str(PROBE_LOG_KEEP_FAILURES)],
+            capture_output=True, text=True, timeout=120.0)
+        detail = (r.stdout or r.stderr or "").strip()[-200:]
+        _log(event="probe_log_compact", ok=r.returncode == 0,
+             rows_before=rows, detail=detail)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        _log(event="probe_log_compact", ok=False,
+             rows_before=rows, detail=f"{type(e).__name__}: {e}")
+
+
 def _run_window_bench(bench_timeout: float, extra_args, label: str,
                       bank: bool = True) -> bool:
     """One bounded bench.py run; writes the artifact iff it really ran on
@@ -555,6 +593,7 @@ def main() -> int:
         _preflight_lint()
     while True:
         t0 = time.time()
+        _maybe_compact_probe_log()  # bounded; no-op below the threshold
         p = probe_default_backend(args.timeout,
                                   policy=preset("watcher-probe"))
         _log(ok=p.ok, is_device=p.is_device, platform=p.platform,

@@ -1,6 +1,8 @@
-"""Pruning-soundness soak: pruned vs unpruned exploration on many random
-programs across every model family (the round-3 burn-in lesson: 400+
-trials catch what 120 don't — docs/EXPERIMENTS.md).
+"""Pruning-soundness soak + probe-log compaction.
+
+**Soak** (the original job): pruned vs unpruned exploration on many
+random programs across every model family (the round-3 burn-in lesson:
+400+ trials catch what 120 don't — docs/EXPERIMENTS.md).
 
 For each (family, seed): enumerate the delivery tree twice, pruned and
 unpruned, bounded by --max-schedules.  Whenever BOTH walks exhaust, the
@@ -10,6 +12,20 @@ unpruned walk's.  Any divergence prints the reproducer (family, impl,
 seed, pids, ops) and exits 1.
 
     python tools/soak_prune.py --per-family 60 [--pids 3] [--ops 5]
+
+**Compaction** (``--compact-probe-log PATH``): ``probe_log.jsonl`` is
+append-only and grows every watcher round (717 rows and counting by
+round 6) while almost all of it is the same wedged-tunnel failure line.
+The evidence worth keeping forever is tiny: every DEVICE-HIT row (the
+windows), every ``event`` row (window seizes, lint gates, banked
+artifacts), and a recent tail of failures for cadence context.  This
+mode rewrites the log atomically
+(qsm_tpu/resilience/checkpoint.py) keeping exactly those, and the probe
+watcher invokes it when the log crosses a row threshold.  Deliberately
+light: no jax, no model imports — safe to run from the watcher loop.
+
+    python tools/soak_prune.py --compact-probe-log probe_log.jsonl \
+        [--keep-failures 500]
 """
 
 from __future__ import annotations
@@ -21,24 +37,59 @@ import time
 
 sys.path.insert(0, "/root/repo")
 
-from qsm_tpu.utils.device import force_cpu_platform  # noqa: E402
 
-force_cpu_platform()
+# ---------------------------------------------------------------------------
+# Probe-log compaction (watcher-invoked; keep this path import-light)
+# ---------------------------------------------------------------------------
 
-from qsm_tpu.core.generator import generate_program  # noqa: E402
-from qsm_tpu.models.registry import MODELS, SutFactory, make  # noqa: E402
-from qsm_tpu.sched.systematic import _enumerate  # noqa: E402
+def compact_probe_log(path: str, keep_failures: int = 500) -> dict:
+    """Rewrite ``path`` keeping all device-hit rows, all ``event`` rows,
+    and the last ``keep_failures`` other rows, in original order.  A
+    garbled line is treated as a failure row (kept only in the tail
+    window) — never a reason to abort a compaction.  Atomic: a watcher
+    killed mid-compaction leaves the previous log intact."""
+    from qsm_tpu.resilience.checkpoint import atomic_write_text
+
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError:
+        return {"rows": 0, "kept": 0, "dropped": 0, "compacted": False}
+    keep = [False] * len(lines)
+    other_idx = []
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            other_idx.append(i)  # garbled: only the tail window keeps it
+            continue
+        if rec.get("is_device") or "event" in rec:
+            keep[i] = True
+        else:
+            other_idx.append(i)
+    for i in other_idx[-keep_failures:] if keep_failures > 0 else []:
+        keep[i] = True
+    kept = [lines[i] for i in range(len(lines)) if keep[i]]
+    dropped = len(lines) - len(kept)
+    if dropped > 0:
+        atomic_write_text(path, "\n".join(kept) + "\n")
+    return {"rows": len(lines), "kept": len(kept), "dropped": dropped,
+            "compacted": dropped > 0}
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--per-family", type=int, default=60)
-    ap.add_argument("--pids", type=int, default=3)
-    ap.add_argument("--ops", type=int, default=5)
-    ap.add_argument("--max-schedules", type=int, default=4_000)
-    ap.add_argument("--impl", default="racy",
-                    help="racy impls have the richer interleaving trees")
-    args = ap.parse_args(argv)
+# ---------------------------------------------------------------------------
+# The pruning-soundness soak (heavy imports live here, not at module top,
+# so the compaction path stays watcher-cheap)
+# ---------------------------------------------------------------------------
+
+def run_soak(args) -> int:
+    from qsm_tpu.utils.device import force_cpu_platform
+
+    force_cpu_platform()
+
+    from qsm_tpu.core.generator import generate_program
+    from qsm_tpu.models.registry import MODELS, SutFactory, make
+    from qsm_tpu.sched.systematic import _enumerate
 
     t0 = time.time()
     total = both_exh = pruned_only = mismatches = 0
@@ -84,6 +135,31 @@ def main(argv=None) -> int:
         "pids": args.pids, "ops": args.ops,
         "seconds": round(time.time() - t0, 1)}))
     return 1 if mismatches else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--per-family", type=int, default=60)
+    ap.add_argument("--pids", type=int, default=3)
+    ap.add_argument("--ops", type=int, default=5)
+    ap.add_argument("--max-schedules", type=int, default=4_000)
+    ap.add_argument("--impl", default="racy",
+                    help="racy impls have the richer interleaving trees")
+    ap.add_argument("--compact-probe-log", default=None, metavar="PATH",
+                    help="compact a probe_log.jsonl instead of soaking: "
+                         "keep device-hit rows, event rows, and the last "
+                         "--keep-failures others; atomic rewrite")
+    ap.add_argument("--keep-failures", type=int, default=500,
+                    help="non-device, non-event rows retained from the "
+                         "tail during --compact-probe-log")
+    args = ap.parse_args(argv)
+
+    if args.compact_probe_log:
+        print(json.dumps({"compact_probe_log": args.compact_probe_log,
+                          **compact_probe_log(args.compact_probe_log,
+                                              args.keep_failures)}))
+        return 0
+    return run_soak(args)
 
 
 if __name__ == "__main__":
