@@ -17,6 +17,8 @@ constructed —
   ``jax.devices()`` outside a watchdog, subprocess waits without a
   timeout, scattered probe-timeout literals the named
   :data:`~qsm_tpu.resilience.policy.PRESETS` replaced;
+* pool (``pool_passes``): worker-process lifecycle hazards — spawns
+  without a bounded reap path, respawn loops without backoff
 * serve (``serve_passes``): the serving plane's structural hazards —
   accept/recv loops without a deadline or shutdown check, unbounded
   queue growth in admission paths.

@@ -45,13 +45,21 @@ DEFAULT_RESILIENCE_FILES = (
     "bench.py", "tools/probe_watcher.py", "tools/bench_configs.py",
     "tools/bench_e2e.py", "tools/bench_scale.py",
     "tools/bench_search.py", "tools/bench_host_baseline.py",
-    "tools/bench_serve.py", "tools/soak_prune.py")
+    "tools/bench_serve.py", "tools/soak_prune.py",
+    "qsm_tpu/serve/pool.py", "qsm_tpu/serve/worker.py")
 # the serving plane the serve passes cover (repo-root-relative): every
 # module that accepts connections, buffers lanes, or drives the server
+# — including the pool supervisor and the worker's own recv loops
 DEFAULT_SERVE_FILES = (
     "qsm_tpu/serve/server.py", "qsm_tpu/serve/batcher.py",
     "qsm_tpu/serve/admission.py", "qsm_tpu/serve/cache.py",
     "qsm_tpu/serve/client.py", "qsm_tpu/serve/protocol.py",
+    "qsm_tpu/serve/pool.py", "qsm_tpu/serve/worker.py",
+    "qsm_tpu/serve/frames.py", "tools/bench_serve.py")
+# the worker-lifecycle modules the pool passes cover: everything that
+# spawns, supervises, or benches worker processes
+DEFAULT_POOL_FILES = (
+    "qsm_tpu/serve/pool.py", "qsm_tpu/serve/worker.py",
     "tools/bench_serve.py")
 
 
@@ -126,10 +134,12 @@ def run_lint(models: Optional[Sequence[str]] = None,
              sched_files: Optional[Sequence[str]] = None,
              resilience_files: Optional[Sequence[str]] = None,
              serve_files: Optional[Sequence[str]] = None,
+             pool_files: Optional[Sequence[str]] = None,
              seed: int = 0) -> LintReport:
     from ..models.registry import MODELS
     from .kernel_passes import (check_host_transfers, check_pallas_vmem,
                                 check_retracing, check_step_dtypes)
+    from .pool_passes import check_pool_file
     from .resilience_passes import check_resilience_file
     from .sched_passes import check_sched_file
     from .serve_passes import check_serve_file
@@ -203,6 +213,14 @@ def run_lint(models: Optional[Sequence[str]] = None,
         path = rel if os.path.isabs(rel) else os.path.join(REPO_ROOT, rel)
         findings += check_serve_file(path, root=REPO_ROOT)
     passes["serve"] = time.perf_counter() - t0
+
+    # --- (f) pool: unreaped workers / respawn storms ---------------------
+    t0 = time.perf_counter()
+    for rel in (pool_files if pool_files is not None
+                else DEFAULT_POOL_FILES):
+        path = rel if os.path.isabs(rel) else os.path.join(REPO_ROOT, rel)
+        findings += check_pool_file(path, root=REPO_ROOT)
+    passes["pool"] = time.perf_counter() - t0
 
     wl = _resolve_whitelist(whitelist)
     kept, allowed = split_whitelisted(findings, wl)

@@ -121,6 +121,72 @@ class UnboundedDeviceProbeStub:
         return watchdog(lambda: jax.devices(), 45.0, label="fixture")
 
 
+class UnreapedWorkerPoolStub:
+    """Seeded bug for the pool passes (family f), REAP half: a worker
+    ``Popen`` inside a class with NO bounded reap path anywhere in it
+    (QSM-POOL-REAP — leaked/zombie workers accumulate for the server's
+    whole lifetime).  Never executed; tests point the pool AST pass at
+    this file and assert the rule fires exactly once."""
+
+    def spawn_unreaped(self):
+        import subprocess
+        import sys
+
+        return subprocess.Popen([sys.executable, "-c", "pass"])  # <-- bug
+
+
+class RespawnStormPoolStub:
+    """Seeded bug for the pool passes (family f), RESPAWN half: a
+    while-True respawn loop with no backoff sleep (QSM-POOL-RESPAWN —
+    an instantly-dying worker turns it into a spawn storm).  The reap
+    is bounded, so ONLY the respawn rule fires on this class."""
+
+    def respawn_forever(self):
+        import subprocess
+        import sys
+
+        while True:                      # <-- bug: no backoff, no bound
+            proc = subprocess.Popen([sys.executable, "-c", "pass"])
+            proc.wait(timeout=5.0)       # reap is bounded: REAP is clean
+
+
+class ReapedWorkerPoolStub:
+    """The sanctioned twins the pool passes must NOT flag: spawn with a
+    terminate → bounded wait → kill escalation in the same class, and a
+    stop-flag-gated respawn loop with exponential-backoff sleeps (the
+    serve/pool.py discipline)."""
+
+    def __init__(self):
+        import threading
+
+        self._stop = threading.Event()
+
+    def spawn_and_reap(self):
+        import subprocess
+        import sys
+
+        proc = subprocess.Popen([sys.executable, "-c", "pass"])
+        proc.terminate()
+        try:
+            proc.wait(timeout=2.0)       # bounded reap
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5.0)
+        return proc
+
+    def respawn_with_backoff(self):
+        import subprocess
+        import sys
+        import time
+
+        backoff = 0.5
+        while not self._stop.is_set():   # gated, backed off: sanctioned
+            proc = subprocess.Popen([sys.executable, "-c", "pass"])
+            proc.wait(timeout=5.0)
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 30.0)
+
+
 class UnboundedServeAcceptStub:
     """Seeded bug for the serve passes (family e): a ``while True``
     accept loop with no deadline or shutdown check (QSM-SERVE-ACCEPT —

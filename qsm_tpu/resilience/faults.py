@@ -20,8 +20,18 @@ Syntax (the ``QSM_TPU_FAULTS`` env var, comma-separated rules)::
              | "wedge"  (returned to the caller: site-specific
                          unavailability — a probe reports the tunnel
                          wedged instead of raising)
+             | "kill"   (SIGKILL the CURRENT PROCESS at the site — no
+                         cleanup, no atexit, exactly an OOM-kill or a
+                         segfaulting engine.  Meant for the ``worker``
+                         site: the rule rides the env into every pool
+                         worker process, so a worker's own dispatch
+                         kills that worker and the SUPERVISOR's
+                         shed/re-dispatch path is what gets tested)
     nth     := fire on the nth hit of the site AND every later one
-               (a lost device stays lost — "mid-scan crash" semantics)
+               (a lost device stays lost — "mid-scan crash" semantics;
+               for kill:worker the count is PER PROCESS, so a respawned
+               worker dies again at the same dispatch ordinal — the
+               crash-loop the quarantine path exists for)
 
 Probability draws come from ONE ``random.Random`` seeded by
 ``QSM_TPU_FAULTS_SEED`` (default 0), so a fault schedule is replayable —
@@ -38,7 +48,9 @@ Fault sites instrumented today: ``probe`` (utils/device.py),
 device engine entry), ``seize`` (tools/probe_watcher.py), ``serve``
 (serve/server.py micro-batch dispatch — a hang/raise there exercises
 the check server's degrade-to-host-ladder path on the CPU platform,
-tests/test_serve.py).
+tests/test_serve.py), ``worker`` (serve/worker.py pool-worker dispatch
+— hang/raise/kill INSIDE a worker process exercises the supervisor's
+shed → re-dispatch → respawn/quarantine ladder, tests/test_serve_pool.py).
 """
 
 from __future__ import annotations
@@ -53,7 +65,7 @@ ENV_VAR = "QSM_TPU_FAULTS"
 SEED_VAR = "QSM_TPU_FAULTS_SEED"
 HANG_VAR = "QSM_TPU_FAULT_HANG_S"
 
-ACTIONS = ("hang", "raise", "wedge")
+ACTIONS = ("hang", "raise", "wedge", "kill")
 
 
 class InjectedFault(RuntimeError):
@@ -154,8 +166,10 @@ def inject(site: str) -> Optional[str]:
     """THE fault hook.  Production cost when the plane is off: one env
     read.  With a matching rule: ``raise`` raises :class:`InjectedFault`;
     ``hang`` sleeps ``QSM_TPU_FAULT_HANG_S`` (default 3600 — long enough
-    that any watchdog fires first) then raises; ``wedge`` is RETURNED so
-    the site applies its own unavailability semantics."""
+    that any watchdog fires first) then raises; ``kill`` SIGKILLs the
+    current process (a crash leaves no traceback and runs no cleanup —
+    the supervisor side is what survives to be tested); ``wedge`` is
+    RETURNED so the site applies its own unavailability semantics."""
     if not os.environ.get(ENV_VAR):
         return None
     act = active_plane().action_for(site)
@@ -164,4 +178,8 @@ def inject(site: str) -> Optional[str]:
     if act == "hang":
         time.sleep(float(os.environ.get(HANG_VAR, "3600")))
         raise InjectedFault(site, act)
+    if act == "kill":
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
     return act

@@ -203,6 +203,18 @@ PRESETS = {
     # never late and never wrong (docs/SERVING.md).
     "serve": RetryPolicy(name="serve", attempts=1, timeout_s=60.0,
                          deadline_s=30.0),
+    # one micro-batch dispatched to a pool WORKER PROCESS
+    # (serve/pool.py): timeout_s bounds the round-trip over the worker
+    # pipe — past it the worker is presumed wedged and is shed exactly
+    # like a wedged chip (killed, lanes re-dispatched to a healthy
+    # worker or the supervisor's own host ladder); attempts bounds how
+    # many workers one batch may burn before the in-process last
+    # resort; deadline_s caps the whole shed/re-dispatch ladder so a
+    # request's lanes resolve inside its serve deadline.  backoff_s
+    # stays 0: the re-dispatch target is a DIFFERENT process, so there
+    # is nothing to wait out.
+    "worker-dispatch": RetryPolicy(name="worker-dispatch", attempts=3,
+                                   timeout_s=30.0, deadline_s=60.0),
 }
 
 

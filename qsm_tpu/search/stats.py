@@ -52,6 +52,9 @@ class SearchStats:
     degradations: int = 0       # device-loss events absorbed
     retries: int = 0            # extra dispatch attempts before degrading
     fallback_engine: str = ""   # host engine degraded onto ("" = none)
+    worker_faults: int = 0      # pool workers shed (crash/wedge/kill)
+    # while deciding these lanes — serve/pool.py stamps it so a batch
+    # that survived a worker loss says so in its own cost record
 
     # -- derived -----------------------------------------------------------
     @property
@@ -74,7 +77,8 @@ class SearchStats:
         for f in ("lockstep_iters", "nodes_explored", "memo_prunes",
                   "memo_inserts", "compactions", "chunk_rounds", "rescued",
                   "deferred", "tail_histories", "segments_split",
-                  "segments_total", "degradations", "retries"):
+                  "segments_total", "degradations", "retries",
+                  "worker_faults"):
             setattr(self, f, getattr(self, f) + getattr(other, f))
         if count_histories:
             self.histories += other.histories
@@ -109,6 +113,7 @@ class SearchStats:
             # rate must never read as a clean device rate)
             "deg": self.degradations,
             "fb": self.fallback_engine,
+            "wf": self.worker_faults,
         }
 
     def to_timings(self) -> Dict[str, float]:
@@ -129,6 +134,8 @@ class SearchStats:
             out["resilience_degradations"] = float(self.degradations)
         if self.retries:
             out["resilience_retries"] = float(self.retries)
+        if self.worker_faults:
+            out["resilience_worker_faults"] = float(self.worker_faults)
         return out
 
 
@@ -136,7 +143,7 @@ _COUNTER_FIELDS = ("histories", "lockstep_iters", "nodes_explored",
                    "memo_prunes", "memo_inserts", "compactions",
                    "chunk_rounds", "rescued", "deferred", "tail_histories",
                    "segments_split", "segments_total", "degradations",
-                   "retries")
+                   "retries", "worker_faults")
 
 
 def stats_delta(after: Optional[SearchStats],

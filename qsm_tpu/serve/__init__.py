@@ -14,18 +14,25 @@ ones — admission → micro-batch → dispatch → cache (docs/SERVING.md):
 * ``cache``     — fingerprint-keyed verdict/witness LRU with an atomic
   persistent bank (kill/restart serves banked verdicts in O(1));
 * ``admission`` — bounded in-flight lanes, preset-driven per-request
-  deadlines, explicit ``SHED`` load shedding;
+  deadlines, explicit ``SHED`` load shedding (with pool state);
+* ``pool``      — :class:`WorkerPool`: supervised engine worker
+  processes (``--workers N``) with crash/wedge shedding, undecided-lane
+  re-dispatch, bounded-backoff respawn and per-spec quarantine;
+* ``worker``    — the pool worker process entry point (bank-free warm
+  host-ladder engines over a length-prefixed pipe protocol);
 * ``client``    — :class:`CheckClient` (``qsm-tpu submit`` / bench).
 
 CLI: ``qsm-tpu serve`` / ``qsm-tpu submit`` (utils/cli.py); bench:
-tools/bench_serve.py (artifact ``BENCH_SERVE_r07.json``); static gate:
-the QSM-SERVE pass family (analysis/serve_passes.py).
+tools/bench_serve.py (artifact ``BENCH_SERVE_r08.json``); static gates:
+the QSM-SERVE pass family (analysis/serve_passes.py) and the QSM-POOL
+family (analysis/pool_passes.py).
 """
 
 from .admission import AdmissionController
 from .batcher import Lane, MicroBatcher
 from .cache import CacheEntry, VerdictCache, fingerprint_key
 from .client import CheckClient
+from .pool import (WorkerDead, WorkerFault, WorkerPool, WorkerTimeout)
 from .protocol import (VERDICT_NAMES, history_to_rows, parse_address,
                        rows_to_history)
 from .server import CheckServer
@@ -33,6 +40,7 @@ from .server import CheckServer
 __all__ = [
     "AdmissionController", "CacheEntry", "CheckClient", "CheckServer",
     "Lane", "MicroBatcher", "VERDICT_NAMES", "VerdictCache",
+    "WorkerDead", "WorkerFault", "WorkerPool", "WorkerTimeout",
     "fingerprint_key", "history_to_rows", "parse_address",
     "rows_to_history",
 ]

@@ -22,19 +22,29 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from ..resilience.policy import RetryPolicy, preset
 
 
 class AdmissionController:
     """Lane accounting + shed counters (one site the server and the
-    ``stats`` op both read)."""
+    ``stats`` op both read).
+
+    ``pool_state`` (optional) is a zero-arg provider of the worker
+    pool's compact health block (``serve/pool.py shed_state``).  When
+    set, every SHED response carries it: "queue full" against a pool
+    running 1-of-4 workers is a degradation story, not an overload
+    story, and the client deciding whether to back off or fail over
+    needs to tell them apart.
+    """
 
     def __init__(self, queue_depth: int = 1024,
-                 policy: Optional[RetryPolicy] = None):
+                 policy: Optional[RetryPolicy] = None,
+                 pool_state: Optional[Callable[[], dict]] = None):
         self.queue_depth = queue_depth
         self.policy = policy or preset("serve")
+        self.pool_state = pool_state
         self._lock = threading.Lock()
         self.in_flight = 0
         self.peak_in_flight = 0
@@ -70,10 +80,21 @@ class AdmissionController:
         with self._lock:
             self.shed_deadline += 1
 
+    def shed_doc(self, req_id, reason: str) -> dict:
+        """THE shed response payload (serve/protocol.py's refusal
+        contract): explicit reason, plus the pool-state block when a
+        worker pool serves this plane."""
+        doc = {"id": req_id, "ok": False, "shed": True, "reason": reason}
+        if self.pool_state is not None:
+            state = self.pool_state()
+            if state:
+                doc["pool"] = state
+        return doc
+
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
-            return {"queue_depth": self.queue_depth,
+            snap = {"queue_depth": self.queue_depth,
                     "in_flight": self.in_flight,
                     "peak_in_flight": self.peak_in_flight,
                     "admitted_lanes": self.admitted_lanes,
@@ -81,3 +102,8 @@ class AdmissionController:
                     "shed_queue": self.shed_queue,
                     "shed_deadline": self.shed_deadline,
                     "policy": self.policy.name}
+        if self.pool_state is not None:
+            state = self.pool_state()
+            if state:
+                snap["pool"] = state
+        return snap

@@ -21,10 +21,20 @@ admission → micro-batch → dispatch → cache — over the existing planes:
 * **Admission** — ``admission.AdmissionController`` bounds in-flight
   lanes and enforces per-request deadlines from the ``serve`` policy
   preset; overload and lateness are answered ``SHED``, never wrong.
+* **Worker pool** — with ``workers=N`` the batcher's dispatches fan
+  out to a supervised pool of engine worker PROCESSES
+  (``serve/pool.py`` / ``serve/worker.py``): checking outscales the
+  one core the in-process path saturates (BENCH_SERVE_r07's wall), a
+  crashed or wedged worker is shed like a wedged chip with undecided
+  lanes re-dispatched, and the verdict bank stays supervisor-owned
+  (workers are bank-free, so no SIGKILL can tear it).  ``workers=0``
+  keeps the single-process path unchanged.
 * **Fault plane** — the batch dispatch runs through the ``serve``
   fault site (``QSM_TPU_FAULTS=hang:serve`` / ``raise:serve``) under a
-  watchdog, so degraded-server behavior is CPU-testable like every
-  other degradation path (tests/test_serve.py).
+  watchdog, and pool workers through the ``worker`` site
+  (``kill:worker`` / ``hang:worker`` / ``raise:worker``), so every
+  degraded-server behavior is CPU-testable like every other
+  degradation path (tests/test_serve.py, tests/test_serve_pool.py).
 
 Wire protocol: serve/protocol.py (JSON lines over TCP or UNIX socket).
 """
@@ -55,9 +65,17 @@ from .protocol import (VERDICT_NAMES, LineChannel, rows_to_history,
 
 
 class _EngineEntry:
-    """One warm spec: engine + witness oracle + planner provenance."""
+    """One warm spec: engine + witness oracle + planner provenance.
 
-    __slots__ = ("spec", "engine", "oracle", "plan_why", "emergency")
+    ``dispatch_lock`` serializes in-process dispatches on this entry:
+    engines are stateful (memo tables, search counters) and NOT
+    thread-safe — with one batcher thread (workers=0) the lock is
+    uncontended, and with a worker pool it guards the fallback path
+    (quarantined spec / exhausted pool), where several dispatcher
+    threads may otherwise hit the same engine concurrently."""
+
+    __slots__ = ("spec", "engine", "oracle", "plan_why", "emergency",
+                 "dispatch_lock")
 
     def __init__(self, spec, engine, oracle, plan_why):
         self.spec = spec
@@ -65,6 +83,7 @@ class _EngineEntry:
         self.oracle = oracle
         self.plan_why = plan_why
         self.emergency = None  # built on first serve-site fault
+        self.dispatch_lock = threading.Lock()
 
 
 class _PendingRequest:
@@ -117,23 +136,40 @@ class CheckServer:
                  cache_entries: int = 4096,
                  policy: Optional[RetryPolicy] = None,
                  allow_shutdown: bool = True,
-                 engine_factory=None):
+                 engine_factory=None,
+                 workers: int = 0,
+                 worker_policy: Optional[RetryPolicy] = None,
+                 quarantine_after: int = 2):
         if engine not in ("auto", "planned"):
             raise ValueError(f"unknown serve engine {engine!r}; "
                              "one of ('auto', 'planned')")
+        if workers and engine != "auto":
+            # pool workers own the host ladder only; a device engine
+            # belongs in the supervisor process where the probe gate ran
+            raise ValueError("workers>0 requires engine='auto' (pool "
+                             "workers run the host cpp->memo ladder)")
         self.host, self.port, self.unix_path = host, port, unix_path
         self.engine_kind = engine
         self.policy = policy or preset("serve")
         self.max_lanes = max_lanes
         self.allow_shutdown = allow_shutdown
         self._engine_factory = engine_factory
+        self.n_workers = max(0, int(workers))
+        self.pool = None
+        if self.n_workers:
+            from .pool import WorkerPool
+
+            self.pool = WorkerPool(self.n_workers, policy=worker_policy,
+                                   quarantine_after=quarantine_after)
         self.cache = VerdictCache(max_entries=cache_entries,
                                   path=cache_path)
-        self.admission = AdmissionController(queue_depth=queue_depth,
-                                             policy=self.policy)
+        self.admission = AdmissionController(
+            queue_depth=queue_depth, policy=self.policy,
+            pool_state=self.pool.shed_state if self.pool else None)
         self.batcher = MicroBatcher(self._dispatch, max_lanes=max_lanes,
                                     flush_s=flush_s,
-                                    queue_depth=max(queue_depth * 2, 64))
+                                    queue_depth=max(queue_depth * 2, 64),
+                                    concurrency=self.n_workers or 1)
         self._engines: Dict[str, _EngineEntry] = {}
         self._engines_lock = threading.Lock()
         self._engine_builds: Dict[str, threading.Lock] = {}
@@ -168,6 +204,8 @@ class CheckServer:
             self.port = self._sock.getsockname()[1]
         self._sock.listen(64)
         self._sock.settimeout(0.2)  # accept loop stays shutdown-checkable
+        if self.pool is not None:
+            self.pool.start()
         self.batcher.start()
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="qsm-serve-accept")
@@ -177,7 +215,14 @@ class CheckServer:
 
     def stop(self) -> None:
         self._stop.set()
+        # order matters: the batcher drains FIRST (in-flight batches
+        # still need the pool), THEN the pool tears down its worker
+        # processes deterministically (exit frame → terminate → bounded
+        # wait → kill escalation → reap) so no test or caller ever
+        # leaks a child process
         self.batcher.stop()
+        if self.pool is not None:
+            self.pool.stop()
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -198,10 +243,14 @@ class CheckServer:
     # -- engines -------------------------------------------------------
     def warm(self, model: str, spec_kwargs: Optional[dict] = None) -> None:
         """Build (and warm-dispatch) the engine for a spec up front so
-        the first request pays nothing."""
+        the first request pays nothing — in this process AND in every
+        pool worker."""
         entry = self._engine_for(model, spec_kwargs or {})
         pad = [History([])] * self.max_lanes
-        entry.engine.check_histories(entry.spec, pad)
+        with entry.dispatch_lock:
+            entry.engine.check_histories(entry.spec, pad)
+        if self.pool is not None:
+            self.pool.warm(model, spec_kwargs or {})
 
     def _spec_key(self, model: str, spec_kwargs: dict) -> str:
         return json.dumps([model, spec_kwargs or {}], sort_keys=True)
@@ -474,12 +523,12 @@ class CheckServer:
                 release_lane(j)
                 pending.resolve(j, int(Verdict.BUDGET_EXCEEDED))
 
-    @staticmethod
-    def _shed(req: dict, reason: str) -> dict:
-        return {"id": req.get("id"), "ok": False, "shed": True,
-                "reason": reason}
+    def _shed(self, req: dict, reason: str) -> dict:
+        # the admission layer builds the payload so SHED responses gain
+        # the pool-state block when a worker pool serves this plane
+        return self.admission.shed_doc(req.get("id"), reason)
 
-    # -- batch dispatch (the `serve` fault site) -----------------------
+    # -- batch dispatch (the `serve` fault site / the worker pool) -----
     def _dispatch(self, spec_key: str, lanes: List[Lane],
                   why: dict) -> None:
         model, spec_kwargs = json.loads(spec_key)
@@ -488,6 +537,72 @@ class CheckServer:
         from ..core.history import bucket_for
 
         width = why["width"]
+        why = {**why, "model": model,
+               "bucket": bucket_for(max((len(h) for h in hists),
+                                        default=1))}
+        verdicts = None
+        if self.pool is not None:
+            verdicts, why = self._dispatch_pool(spec_key, model,
+                                                spec_kwargs, hists,
+                                                width, why)
+        if verdicts is None:
+            # no pool, a quarantined spec, or a pool that lost every
+            # healthy worker for this batch: the supervisor's own host
+            # cpp→memo ladder is the last resort — exact, in-process
+            verdicts, why = self._dispatch_host(entry, hists, width, why)
+        # engine-relative BUDGET_EXCEEDED resolves via the witness
+        # oracle (the property layer's rule) unless the engine IS that
+        # ladder — re-running an identical search only repeats itself
+        # (pool workers run the same auto ladder, so pooled verdicts
+        # follow the same rule)
+        todo = [i for i, v in enumerate(verdicts)
+                if v == int(Verdict.BUDGET_EXCEEDED)]
+        if todo and self.engine_kind != "auto":
+            sub = entry.oracle.check_histories(
+                entry.spec, [hists[i] for i in todo])
+            for i, v in zip(todo, sub):
+                verdicts[i] = int(v)
+                self.budget_resolved += 1
+        # one bank flush for the whole batch (put_many), then resolve —
+        # banking is SUPERVISOR-ONLY by design: a SIGKILLed worker can
+        # never leave a torn or wrong bank behind
+        self.cache.put_many((lane.key, int(v), None)
+                            for lane, v in zip(lanes, verdicts))
+        for lane, v in zip(lanes, verdicts):
+            lane.resolve(int(v), why)
+
+    def _dispatch_pool(self, spec_key: str, model: str, spec_kwargs,
+                       hists, width: int, why: dict):
+        """One micro-batch on the worker pool; ``(None, why)`` when the
+        pool cannot decide it and the host path must."""
+        from .protocol import history_to_rows
+
+        pooled = self.pool.dispatch(
+            spec_key, model, spec_kwargs,
+            [history_to_rows(h) for h in hists], width)
+        if pooled is None:
+            return None, {**why, "pool": "in-process"}
+        why = {**why, "worker": pooled.get("wid")}
+        wf = int(pooled.get("batch_worker_faults", 0))
+        if wf:
+            why["worker_faults"] = wf
+        search = pooled.get("search")
+        if search is not None:
+            # worker faults ride the batch's own cost record: a batch
+            # that survived a worker loss must say so (SearchStats
+            # worker_faults, compact key "wf")
+            why["search"] = {**search, "wf": search.get("wf", 0) + wf}
+        return np.asarray(pooled["verdicts"]), why
+
+    def _dispatch_host(self, entry: _EngineEntry, hists, width: int,
+                       why: dict):
+        """The in-process dispatch (the `serve` fault site), serialized
+        per entry — see _EngineEntry.dispatch_lock."""
+        with entry.dispatch_lock:
+            return self._dispatch_host_locked(entry, hists, width, why)
+
+    def _dispatch_host_locked(self, entry: _EngineEntry, hists,
+                              width: int, why: dict):
         padded = hists + [History([])] * (width - len(hists))
         st0 = collect_search_stats(entry.engine)
 
@@ -513,28 +628,10 @@ class CheckServer:
             verdicts = np.asarray(entry.emergency.check_histories(
                 entry.spec, padded))[:len(hists)]
             why = {**why, "degraded": f"{type(e).__name__}"}
-        # engine-relative BUDGET_EXCEEDED resolves via the witness
-        # oracle (the property layer's rule) unless the engine IS that
-        # ladder — re-running an identical search only repeats itself
-        todo = [i for i, v in enumerate(verdicts)
-                if v == int(Verdict.BUDGET_EXCEEDED)]
-        if todo and self.engine_kind != "auto":
-            sub = entry.oracle.check_histories(
-                entry.spec, [hists[i] for i in todo])
-            for i, v in zip(todo, sub):
-                verdicts[i] = int(v)
-                self.budget_resolved += 1
         st = stats_delta(collect_search_stats(entry.engine), st0)
-        why = {**why, "model": model,
-               "bucket": bucket_for(max((len(h) for h in hists),
-                                        default=1))}
         if st is not None:
-            why["search"] = st.to_compact()
-        # one bank flush for the whole batch (put_many), then resolve
-        self.cache.put_many((lane.key, int(v), None)
-                            for lane, v in zip(lanes, verdicts))
-        for lane, v in zip(lanes, verdicts):
-            lane.resolve(int(v), why)
+            why = {**why, "search": st.to_compact()}
+        return verdicts, why
 
     # -- observability -------------------------------------------------
     def stats(self) -> dict:
@@ -554,12 +651,18 @@ class CheckServer:
             "address": self.address,
             "uptime_s": round(time.monotonic() - self._t0, 1),
             "engine_kind": self.engine_kind,
+            "workers": self.n_workers,
             "requests": self.requests,
             "histories": self.histories,
             "serve_faults": self.serve_faults,
+            "worker_faults": (self.pool.worker_faults
+                              if self.pool is not None else 0),
             "budget_resolved": self.budget_resolved,
             "admission": self.admission.snapshot(),
             "batcher": self.batcher.snapshot(),
             "cache": self.cache.stats(),
+            # per-worker rows (dispatches, faults, deaths, respawns,
+            # quarantines) — what `qsm-tpu stats --serve` aggregates
+            "pool": self.pool.snapshot() if self.pool is not None else None,
             "engines": engines,
         }

@@ -459,7 +459,8 @@ def cmd_serve(args) -> int:
         host=args.host, port=args.port, unix_path=args.unix,
         engine=args.engine, max_lanes=args.max_lanes,
         flush_s=args.flush_ms / 1000.0, queue_depth=args.queue_depth,
-        cache_path=args.cache, cache_entries=args.cache_entries)
+        cache_path=args.cache, cache_entries=args.cache_entries,
+        workers=args.workers, quarantine_after=args.quarantine_after)
     warm = [m.strip() for m in args.warm.split(",")] if args.warm else []
     warm = [m for m in warm if m]
     unknown = sorted(set(warm) - set(MODELS))
@@ -474,6 +475,7 @@ def cmd_serve(args) -> int:
             server.warm(model)
         print(json.dumps({"serving": server.address,
                           "engine": args.engine,
+                          "workers": args.workers,
                           "max_lanes": args.max_lanes,
                           "flush_ms": args.flush_ms,
                           "queue_depth": args.queue_depth,
@@ -529,8 +531,11 @@ def cmd_stats(args) -> int:
     it — ``--planned`` actually runs the planned backend (device
     engines only; the planner's levers are the kernel driver's).
     ``--serve ADDR`` instead prints a RUNNING check server's aggregate
-    stats (requests, batch occupancy, cache hit rate, shed counts, and
-    the per-engine SearchStats/resilience blocks every response rides)."""
+    stats (requests, batch occupancy, cache hit rate, shed counts, the
+    per-engine SearchStats/resilience blocks every response rides, and
+    — when the server runs ``--workers N`` — the pool block with
+    per-worker rows: dispatches, faults, deaths, respawns, plus the
+    quarantined-spec list)."""
     if getattr(args, "serve", None):
         from ..serve.client import CheckClient
 
@@ -1078,6 +1083,16 @@ def main(argv=None) -> int:
                    help="auto = the warm host cpp->memo ladder (today's "
                         "fast path); planned = the plan_search-built "
                         "device checker (needs a reachable device)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="dispatch micro-batches to N supervised engine "
+                        "worker PROCESSES (serve/pool.py): checking "
+                        "outscales one core, a crashed/wedged worker is "
+                        "shed with its undecided lanes re-dispatched; "
+                        "0 = check in-process (auto engine only)")
+    p.add_argument("--quarantine-after", type=int, default=2,
+                   help="a spec whose dispatches crashed this many "
+                        "workers is quarantined to the in-process host "
+                        "ladder (no respawn storm)")
     p.add_argument("--max-lanes", type=int, default=64,
                    help="micro-batch width: lanes coalesced per dispatch")
     p.add_argument("--flush-ms", type=float, default=20.0,

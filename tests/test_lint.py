@@ -15,6 +15,7 @@ import pytest
 import qsm_tpu.analysis.fixtures as fixtures
 from qsm_tpu.analysis import (ERROR, Finding, Whitelist, run_lint)
 from qsm_tpu.analysis.engine import (DEFAULT_OPS_FILES,
+                                     DEFAULT_POOL_FILES,
                                      DEFAULT_RESILIENCE_FILES,
                                      DEFAULT_SCHED_FILES,
                                      DEFAULT_SERVE_FILES,
@@ -48,9 +49,13 @@ def test_in_tree_corpus_is_clean(report):
     assert len(DEFAULT_RESILIENCE_FILES) >= 12
     assert "resilience" in report.passes
     # the serving plane (family e): every connection-accepting /
-    # lane-buffering module plus the serve bench tool
-    assert len(DEFAULT_SERVE_FILES) == 7
+    # lane-buffering module (the pool supervisor and worker recv loops
+    # included) plus the serve bench tool
+    assert len(DEFAULT_SERVE_FILES) == 10
     assert "serve" in report.passes
+    # the worker-lifecycle plane (family f): spawn/supervise/bench
+    assert len(DEFAULT_POOL_FILES) == 3
+    assert "pool" in report.passes
     assert report.ok, "\n".join(
         f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
 
@@ -157,6 +162,66 @@ def test_unbounded_serve_loop_is_caught():
     assert len(unbounded) == 1
     assert "serve_forever_unbounded" in unbounded[0].location
     assert not by_rule  # nothing else fires on the fixture module
+
+
+def test_unreaped_worker_pool_is_caught():
+    """The pool pass's bulb check (family f): the reapless Popen and
+    the backoffless while-True respawn loop each fire their rule
+    exactly once; the terminate→bounded-wait→kill twin and the
+    stop-gated backoff loop must NOT be flagged."""
+    from qsm_tpu.analysis.pool_passes import check_pool_file
+
+    findings = check_pool_file(fixtures.__file__)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule_id, []).append(f)
+    reap = by_rule.pop("QSM-POOL-REAP")
+    assert len(reap) == 1 and reap[0].severity == ERROR
+    assert "spawn_unreaped" in reap[0].location
+    respawn = by_rule.pop("QSM-POOL-RESPAWN")
+    assert len(respawn) == 1 and respawn[0].severity == ERROR
+    assert "respawn_forever" in respawn[0].location
+    assert not by_rule  # nothing else fires on the fixture module
+
+
+def test_bounded_pool_idioms_are_clean(tmp_path):
+    """True-negative pin: the pool plane's own idioms — spawn with a
+    bounded reap in the same class, a stop-gated respawn loop with
+    backoff, a for-bounded retry — must not be flagged."""
+    from qsm_tpu.analysis.pool_passes import check_pool_file
+
+    p = tmp_path / "stub.py"
+    p.write_text(
+        "import subprocess, sys, time\n"
+        "class Pool:\n"
+        "    def spawn(self):\n"
+        "        p = subprocess.Popen([sys.executable, '-c', 'pass'])\n"
+        "        p.terminate()\n"
+        "        p.wait(timeout=2.0)\n"
+        "        return p\n"
+        "    def retry_bounded(self):\n"
+        "        for _ in range(3):\n"
+        "            p = subprocess.Popen([sys.executable, '-c', 'x'])\n"
+        "            p.wait(timeout=1.0)\n")
+    assert check_pool_file(str(p)) == []
+
+
+def test_module_scope_unreaped_spawn_is_caught(tmp_path):
+    """A module-level spawn (the bench-tool shape) needs a bounded reap
+    at module scope too — a class' reap elsewhere says nothing about
+    it."""
+    from qsm_tpu.analysis.pool_passes import check_pool_file
+
+    p = tmp_path / "stub.py"
+    p.write_text(
+        "import subprocess, sys\n"
+        "class Unrelated:\n"
+        "    def reap(self, p):\n"
+        "        p.wait(timeout=1.0)\n"
+        "proc = subprocess.Popen([sys.executable, '-c', 'pass'])\n")
+    findings = check_pool_file(str(p))
+    assert [f.rule_id for f in findings] == ["QSM-POOL-REAP"]
+    assert "<module>" in findings[0].location
 
 
 def test_bounded_serve_idioms_are_clean(tmp_path):

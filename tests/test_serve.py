@@ -419,6 +419,73 @@ def test_verdict_cache_lru_persistence_and_honesty(tmp_path):
     assert c2.get("a").witness == [(0, 1)]
 
 
+def test_verdict_bank_append_log_supersede_and_witness(tmp_path):
+    """The bank is an append log: later rows supersede earlier ones on
+    load, and a verdict-only refresh row still carries the banked
+    witness (serialized post-merge) — so witnesses survive restarts
+    even when the LAST write for a key had none."""
+    bank = str(tmp_path / "bank.jsonl")
+    c = VerdictCache(max_entries=8, path=bank)
+    c.put("a", 1, witness=[(0, 1)])
+    c.put("a", 1)  # verdict-only refresh APPENDS; must not drop witness
+    c2 = VerdictCache(max_entries=8, path=bank)
+    assert c2.get("a").witness == [(0, 1)]
+    # two rows on disk (append log), one live entry
+    assert c2.stats()["bank_rows"] == 2
+    assert len(c2) == 1
+
+
+def test_verdict_bank_append_after_torn_tail_compacts_first(tmp_path):
+    """Review regression: a bank whose tail line is torn (killed
+    mid-append) must NOT be appended to directly — the first new row
+    would weld onto the partial line and poison every later load.  The
+    loader forces the next flush to compact, so banking keeps working
+    across repeated kill/restart generations."""
+    bank = str(tmp_path / "bank.jsonl")
+    c = VerdictCache(max_entries=8, path=bank)
+    c.put("a", 1)
+    c.put("b", 0)
+    with open(bank, "a") as f:
+        f.write('{"key": "c", "verd')  # torn mid-append, no newline
+    c2 = VerdictCache(max_entries=8, path=bank)
+    assert c2.get("a") is not None and c2.get("b") is not None
+    c2.put("d", 1)  # must compact, not append after the partial line
+    c3 = VerdictCache(max_entries=8, path=bank)
+    assert c3.get("a").verdict == 1
+    assert c3.get("b").verdict == 0
+    assert c3.get("d").verdict == 1
+    c3.put("e", 1)  # and the NEXT generation still banks cleanly
+    assert VerdictCache(max_entries=8, path=bank).get("e") is not None
+    # the subtler tear: the last line PARSES but has no trailing
+    # newline (killed between payload and '\n') — still not
+    # appendable-after; the next flush must compact too
+    with open(bank) as f:
+        body = f.read()
+    with open(bank, "w") as f:
+        f.write(body.rstrip("\n"))  # strip the final newline only
+    c4 = VerdictCache(max_entries=8, path=bank)
+    assert c4.get("e") is not None  # the newline-less row still loads
+    c4.put("f", 1)
+    c5 = VerdictCache(max_entries=8, path=bank)
+    assert c5.get("e") is not None and c5.get("f") is not None
+
+
+def test_verdict_bank_compacts_instead_of_growing_unbounded(tmp_path):
+    """Appends are O(batch); the log must compact (atomic rewrite of
+    live entries) once it outgrows twice the live set — a long-lived
+    server's bank cannot grow without bound."""
+    bank = str(tmp_path / "bank.jsonl")
+    c = VerdictCache(max_entries=4, path=bank)
+    for i in range(40):
+        c.put(f"k{i}", 1)
+    st = c.stats()
+    assert st["compactions"] >= 1
+    assert st["bank_rows"] <= 2 * 40  # bounded, not 40 appends forever
+    # the live set survives a reload
+    c2 = VerdictCache(max_entries=4, path=bank)
+    assert c2.get("k39") is not None
+
+
 def test_verdict_cache_preserves_alien_file(tmp_path):
     path = tmp_path / "not_a_bank.json"
     path.write_text('{"something": "else"}\n')
