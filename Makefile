@@ -1,0 +1,30 @@
+# qsm_tpu CI/tooling entry points.
+#
+# `lint-gate` is the static-analysis gate: it runs every registered
+# qsmlint pass family (a–g, docs/ANALYSIS.md) over the full tree,
+# archives the JSON findings document to LINT_r07.json (the artifact
+# probe_watcher also refreshes before every window seize) and FAILS
+# (exit 1) on any non-whitelisted error-severity finding.  The on-disk
+# result cache (.qsmlint-cache.json) keeps a warm full-tree run in the
+# low seconds; CI lanes that want diff-scoped speed use `lint-changed`.
+
+PYTHON ?= python
+# keep in lockstep with tools/probe_watcher.py LINT_ROUND (the watcher
+# archives the same document before every window seize)
+LINT_ARTIFACT ?= LINT_r07.json
+
+.PHONY: lint-gate lint-changed lint-sarif test
+
+lint-gate:
+	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT)
+
+lint-changed:
+	$(PYTHON) -m qsm_tpu lint --changed $(or $(REF),HEAD)
+
+lint-sarif:
+	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT) \
+		--sarif $(LINT_ARTIFACT:.json=.sarif)
+
+# the tier-1 quick lane (ROADMAP.md has the full pinned command)
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

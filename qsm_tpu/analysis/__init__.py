@@ -21,7 +21,16 @@ constructed —
   without a bounded reap path, respawn loops without backoff
 * serve (``serve_passes``): the serving plane's structural hazards —
   accept/recv loops without a deadline or shutdown check, unbounded
-  queue growth in admission paths.
+  queue growth in admission paths;
+* race (``race_passes`` on the ``callgraph`` whole-program substrate):
+  interprocedural lock/thread hazards across serve + resilience +
+  tools — lock-order deadlock cycles, unguarded shared writes, thread
+  lifecycle without stop/join, leaked fds/sockets.
+
+Families are registered declaratively in ``engine.FAMILIES`` (id,
+scan set, runner); ``--family g`` selects by id, ``--changed`` scopes
+to git-touched modules, and per-file findings are cached on disk by
+content digest (``incremental.py``).
 
 Entry points: :func:`run_lint` (the engine), ``python -m qsm_tpu lint``
 (the CLI gate), tests/test_lint.py (the tier-1 gate) and the
@@ -30,16 +39,20 @@ format are documented in docs/ANALYSIS.md.
 """
 
 from .findings import (ERROR, INFO, WARNING, Finding, Whitelist,
-                       render_json, render_text, sort_findings,
-                       split_whitelisted)
-from .engine import (DEFAULT_OPS_FILES, DEFAULT_RESILIENCE_FILES,
-                     DEFAULT_SCHED_FILES, DEFAULT_SERVE_FILES, LintReport,
-                     default_whitelist_path, run_lint)
+                       render_json, render_sarif, render_text,
+                       sort_findings, split_whitelisted)
+from .engine import (DEFAULT_OPS_FILES, DEFAULT_POOL_FILES,
+                     DEFAULT_RACE_FILES, DEFAULT_RESILIENCE_FILES,
+                     DEFAULT_SCHED_FILES, DEFAULT_SERVE_FILES, FAMILIES,
+                     Family, LintReport, default_whitelist_path,
+                     run_lint)
 
 __all__ = [
     "ERROR", "WARNING", "INFO", "Finding", "Whitelist", "LintReport",
-    "run_lint", "render_text", "render_json", "sort_findings",
-    "split_whitelisted", "default_whitelist_path",
+    "run_lint", "render_text", "render_json", "render_sarif",
+    "sort_findings", "split_whitelisted", "default_whitelist_path",
+    "FAMILIES", "Family",
     "DEFAULT_OPS_FILES", "DEFAULT_SCHED_FILES",
     "DEFAULT_RESILIENCE_FILES", "DEFAULT_SERVE_FILES",
+    "DEFAULT_POOL_FILES", "DEFAULT_RACE_FILES",
 ]

@@ -1,13 +1,23 @@
-"""``qsmlint`` orchestration — every pass family over the in-tree corpus.
+"""``qsmlint`` orchestration — declarative pass families over the
+in-tree corpus.
 
-One entry point, :func:`run_lint`: spec soundness passes over every
-registry model family, kernel trace-hazard passes over the five
-lineariser engine modules (ops/jax_kernel.py, ops/pallas_kernel.py,
-ops/segdc.py, ops/rootsplit.py, ops/pcomp.py), determinism passes over
-the scheduler plane (sched/).  CPU-only by contract: callers pin the
-platform (utils/cli.py cmd_lint forces it) and nothing here constructs
-a device backend — the entire point is deciding cheaply BEFORE any TPU
+One entry point, :func:`run_lint`, drives the :data:`FAMILIES`
+registry: each family declares its id (the ``--family`` letter), its
+scan set and how it runs — ``per_file`` (independent single-module
+checks, cacheable per file) or ``whole`` (semantic or whole-program
+runs).  Family (g)'s wider scan set (serve + resilience + tools) and
+any future family ride the same declaration; nothing special-cases
+the engine.  CPU-only by contract: callers pin the platform
+(utils/cli.py cmd_lint forces it) and nothing here constructs a
+device backend — the entire point is deciding cheaply BEFORE any TPU
 window opens.
+
+Incrementality (``analysis/incremental.py``): per-file findings are
+cached on disk keyed by content digest + analyzer fingerprint, so the
+full-tree run re-checks only what changed; ``changed=REF`` narrows
+the scan itself to git-touched modules.  Both stamp the ``--json``
+report (``cache``, ``changed``) so an archived lint says what it
+actually looked at.
 
 Consumed by ``python -m qsm_tpu lint`` (exit 1 on non-whitelisted
 error findings), tests/test_lint.py (the tier-1 gate) and
@@ -20,7 +30,8 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from typing import Dict, List, Optional, Sequence, Union
+from typing import (Callable, Dict, List, Optional, Sequence, Set,
+                    Tuple, Union)
 
 from .findings import (ERROR, Finding, Whitelist, render_json,
                        split_whitelisted)
@@ -61,50 +72,99 @@ DEFAULT_SERVE_FILES = (
 DEFAULT_POOL_FILES = (
     "qsm_tpu/serve/pool.py", "qsm_tpu/serve/worker.py",
     "tools/bench_serve.py")
+# the race family's whole-program scan set — the widest beat: every
+# module where threads, locks and child processes coordinate (serve +
+# resilience planes and the tools that drive them); analyzed as ONE
+# closed program, not file by file
+DEFAULT_RACE_FILES = (
+    "qsm_tpu/serve/server.py", "qsm_tpu/serve/batcher.py",
+    "qsm_tpu/serve/admission.py", "qsm_tpu/serve/cache.py",
+    "qsm_tpu/serve/client.py", "qsm_tpu/serve/protocol.py",
+    "qsm_tpu/serve/pool.py", "qsm_tpu/serve/worker.py",
+    "qsm_tpu/serve/frames.py",
+    "qsm_tpu/resilience/policy.py", "qsm_tpu/resilience/failover.py",
+    "qsm_tpu/resilience/faults.py", "qsm_tpu/resilience/checkpoint.py",
+    "tools/bench_serve.py", "tools/probe_watcher.py",
+    "tools/soak_prune.py")
 
 
 def default_whitelist_path() -> str:
     return os.path.join(REPO_ROOT, ".qsmlint")
 
 
-@dataclasses.dataclass
-class LintReport:
-    findings: List[Finding]          # non-whitelisted
-    whitelisted: List[Finding]
-    passes: Dict[str, float]         # pass family -> seconds
-    seconds: float
-    models: List[str]
-    whitelist_path: Optional[str] = None  # the file actually loaded
+# ---------------------------------------------------------------------------
+# the declarative family registry
+# ---------------------------------------------------------------------------
+
+class _LintRun:
+    """Per-run context the family runners share: validated model
+    names, lazily-built specs, knobs."""
+
+    def __init__(self, names: List[str], retrace: bool, seed: int):
+        self.names = names
+        self.retrace = retrace
+        self.seed = seed
+        self._specs: Optional[List[tuple]] = None
 
     @property
-    def errors(self) -> List[Finding]:
-        return [f for f in self.findings if f.severity == ERROR]
+    def specs(self) -> List[tuple]:
+        if self._specs is None:
+            from ..models.registry import MODELS
 
-    @property
-    def ok(self) -> bool:
-        """True when no non-whitelisted error-severity findings."""
-        return not self.errors
-
-    def to_json(self) -> str:
-        return render_json(
-            self.findings, self.whitelisted,
-            meta={"ok": self.ok,
-                  "seconds": round(self.seconds, 3),
-                  "passes": {k: round(v, 3)
-                             for k, v in self.passes.items()},
-                  "models": self.models})
+            self._specs = [(n, MODELS[n].make_spec(), f"model:{n}")
+                           for n in self.names]
+        return self._specs
 
 
-def _resolve_whitelist(whitelist: Union[None, str, Whitelist]
-                       ) -> Optional[Whitelist]:
-    if isinstance(whitelist, Whitelist):
-        return whitelist
-    if isinstance(whitelist, str):
-        return Whitelist.load(whitelist)
-    path = default_whitelist_path()
-    if os.path.exists(path):
-        return Whitelist.load(path)
-    return None
+@dataclasses.dataclass(frozen=True)
+class Family:
+    """One registered pass family.
+
+    ``per_file`` families run an independent check over each file of
+    their scan set (cacheable per file, subsettable by ``--changed``);
+    ``whole`` families run once over the full set — semantic passes
+    (spec/kernel, which execute code) and the whole-program race
+    analysis (whose findings are a property of the set, not of any one
+    file).  ``triggers`` are the extra path prefixes that force a
+    ``whole`` family to run under ``--changed`` even when no scan-set
+    file itself changed (spec soundness depends on the model sources,
+    not on any linted file)."""
+
+    fid: str                       # the --family id ("a".."g")
+    key: str                       # passes-timing / report key
+    title: str
+    files: Tuple[str, ...] = ()
+    base: str = "repo"             # scan-set paths relative to: repo|pkg
+    per_file: Optional[Callable[[str, str], List[Finding]]] = None
+    whole: Optional[Callable[["_LintRun", List[str]],
+                             List[Finding]]] = None
+    triggers: Tuple[str, ...] = ()
+    cacheable: bool = True
+
+    def resolve(self, rel: str) -> str:
+        if os.path.isabs(rel):
+            return rel
+        root = _PKG_DIR if self.base == "pkg" else REPO_ROOT
+        return os.path.join(root, rel)
+
+    def repo_rel(self, rel: str) -> str:
+        """Scan-set entry as a repo-relative path (the --changed and
+        cache-key form)."""
+        try:
+            return os.path.relpath(self.resolve(rel), REPO_ROOT)
+        except ValueError:
+            return rel
+
+
+def _run_spec(ctx: _LintRun, _files: List[str]) -> List[Finding]:
+    from .kernel_passes import check_step_dtypes
+    from .spec_passes import check_spec
+
+    out: List[Finding] = []
+    for _name, spec, loc in ctx.specs:
+        out += check_spec(spec, loc, seed=ctx.seed)
+        out += check_step_dtypes(spec, loc)
+    return out
 
 
 def _retrace_corpora(entry, spec):
@@ -127,23 +187,197 @@ def _retrace_corpora(entry, spec):
     return [a, b]
 
 
+def _run_kernel(ctx: _LintRun, files: List[str]) -> List[Finding]:
+    from ..models.registry import MODELS
+    from .kernel_passes import (check_host_transfers, check_pallas_vmem,
+                                check_retracing)
+
+    out: List[Finding] = []
+    for path in files:
+        out += check_host_transfers(path, root=REPO_ROOT)
+    out += check_pallas_vmem(
+        [(spec, loc) for _, spec, loc in ctx.specs],
+        "qsm_tpu/ops/pallas_kernel.py:MAX_PALLAS_STATES")
+    if ctx.retrace and ctx.specs:
+        # one representative family is enough: the check exercises the
+        # DRIVER's compile-key discipline, which is spec-independent
+        name, spec, _loc = ctx.specs[0]
+        from ..ops.jax_kernel import JaxTPU
+
+        backend = JaxTPU(spec, budget=2_000, mid_budget=0,
+                         rescue_budget=0, rescue_slots=64)
+        backend.CHUNK_SCHEDULE = (512,)   # one chunk shape: any cache
+        backend.DOUBLE_BUFFER = False     # growth is a real retrace
+        out += check_retracing(
+            spec, backend, _retrace_corpora(MODELS[name], spec),
+            "qsm_tpu/ops/jax_kernel.py")
+    return out
+
+
+def _per_file_sched(path: str, root: str) -> List[Finding]:
+    from .sched_passes import check_sched_file
+
+    return check_sched_file(path, root=root)
+
+
+def _per_file_resilience(path: str, root: str) -> List[Finding]:
+    from .resilience_passes import check_resilience_file
+
+    return check_resilience_file(path, root=root)
+
+
+def _per_file_serve(path: str, root: str) -> List[Finding]:
+    from .serve_passes import check_serve_file
+
+    return check_serve_file(path, root=root)
+
+
+def _per_file_pool(path: str, root: str) -> List[Finding]:
+    from .pool_passes import check_pool_file
+
+    return check_pool_file(path, root=root)
+
+
+def _run_race(_ctx: _LintRun, files: List[str]) -> List[Finding]:
+    from .race_passes import check_race_project
+
+    return check_race_project(files, root=REPO_ROOT)
+
+
+FAMILIES: Dict[str, Family] = {f.fid: f for f in (
+    Family(fid="a", key="spec",
+           title="spec soundness (parity, domains, bounds, dtypes)",
+           whole=_run_spec, cacheable=False,
+           triggers=("qsm_tpu/models/", "qsm_tpu/core/",
+                     "qsm_tpu/analysis/spec_passes.py",
+                     "qsm_tpu/analysis/kernel_passes.py")),
+    Family(fid="b", key="kernel",
+           title="kernel trace hazards (host xfer, VMEM, retrace)",
+           files=DEFAULT_OPS_FILES, base="pkg",
+           whole=_run_kernel, cacheable=False,
+           triggers=("qsm_tpu/ops/", "qsm_tpu/models/", "qsm_tpu/core/",
+                     "qsm_tpu/analysis/kernel_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+    Family(fid="c", key="sched",
+           title="scheduler determinism",
+           files=DEFAULT_SCHED_FILES, base="pkg",
+           per_file=_per_file_sched,
+           triggers=("qsm_tpu/analysis/sched_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+    Family(fid="d", key="resilience",
+           title="unbounded device/subprocess calls",
+           files=DEFAULT_RESILIENCE_FILES,
+           per_file=_per_file_resilience,
+           triggers=("qsm_tpu/analysis/resilience_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+    Family(fid="e", key="serve",
+           title="serving-plane loops and queues",
+           files=DEFAULT_SERVE_FILES, per_file=_per_file_serve,
+           triggers=("qsm_tpu/analysis/serve_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+    Family(fid="f", key="pool",
+           title="worker-process lifecycle",
+           files=DEFAULT_POOL_FILES, per_file=_per_file_pool,
+           triggers=("qsm_tpu/analysis/pool_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+    Family(fid="g", key="race",
+           title="interprocedural lock/thread races (whole-program)",
+           files=DEFAULT_RACE_FILES, whole=_run_race,
+           triggers=("qsm_tpu/analysis/callgraph.py",
+                     "qsm_tpu/analysis/race_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+)}
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: List[Finding]          # non-whitelisted
+    whitelisted: List[Finding]
+    passes: Dict[str, float]         # family key -> seconds
+    seconds: float
+    models: List[str]
+    whitelist_path: Optional[str] = None  # the file actually loaded
+    families: List[str] = dataclasses.field(default_factory=list)
+    cache: Optional[dict] = None     # {path, hits, misses}
+    changed: Optional[dict] = None   # {ref, files} when --changed ran
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def ok(self) -> bool:
+        """True when no non-whitelisted error-severity findings."""
+        return not self.errors
+
+    def _meta(self) -> dict:
+        meta = {"ok": self.ok,
+                "seconds": round(self.seconds, 3),
+                "passes": {k: round(v, 3)
+                           for k, v in self.passes.items()},
+                "models": self.models,
+                "families": self.families}
+        if self.cache is not None:
+            meta["cache"] = self.cache
+        if self.changed is not None:
+            meta["changed"] = self.changed
+        return meta
+
+    def to_json(self) -> str:
+        return render_json(self.findings, self.whitelisted,
+                           meta=self._meta())
+
+    def to_sarif(self) -> str:
+        from .findings import render_sarif
+
+        return render_sarif(self.findings, self.whitelisted,
+                            meta=self._meta())
+
+
+def _resolve_whitelist(whitelist: Union[None, str, Whitelist]
+                       ) -> Optional[Whitelist]:
+    if isinstance(whitelist, Whitelist):
+        return whitelist
+    if isinstance(whitelist, str):
+        return Whitelist.load(whitelist)
+    path = default_whitelist_path()
+    if os.path.exists(path):
+        return Whitelist.load(path)
+    return None
+
+
+def _resolve_families(families: Optional[Sequence[str]]
+                      ) -> List[Family]:
+    if families is None:
+        return list(FAMILIES.values())
+    unknown = [f for f in families if f not in FAMILIES]
+    if unknown:
+        raise ValueError(f"unknown pass families {unknown}; "
+                         f"one of {sorted(FAMILIES)}")
+    # dedupe, order-preserving: "--family e,e" must not double every
+    # finding in the archived report
+    return [FAMILIES[f] for f in dict.fromkeys(families)]
+
+
 def run_lint(models: Optional[Sequence[str]] = None,
              retrace: bool = True,
              whitelist: Union[None, str, Whitelist] = None,
-             ops_files: Optional[Sequence[str]] = None,
-             sched_files: Optional[Sequence[str]] = None,
-             resilience_files: Optional[Sequence[str]] = None,
-             serve_files: Optional[Sequence[str]] = None,
-             pool_files: Optional[Sequence[str]] = None,
+             families: Optional[Sequence[str]] = None,
+             changed: Optional[str] = None,
+             cache: Union[bool, str] = True,
+             file_overrides: Optional[Dict[str, Sequence[str]]] = None,
              seed: int = 0) -> LintReport:
+    """Run the registered pass families.
+
+    ``families`` — family ids to run (None = all registered).
+    ``changed`` — a git ref: narrow per-file families to modules
+    touched since it (whole families run iff their scan set or
+    triggers were touched); git trouble falls back to the full tree.
+    ``cache`` — True (default cache path), a path, or False: reuse
+    per-file findings whose content digest is unchanged.
+    ``file_overrides`` — family id -> replacement scan set (tests)."""
     from ..models.registry import MODELS
-    from .kernel_passes import (check_host_transfers, check_pallas_vmem,
-                                check_retracing, check_step_dtypes)
-    from .pool_passes import check_pool_file
-    from .resilience_passes import check_resilience_file
-    from .sched_passes import check_sched_file
-    from .serve_passes import check_serve_file
-    from .spec_passes import check_spec
+    from . import incremental
 
     t_start = time.perf_counter()
     names = list(models) if models else sorted(MODELS)
@@ -151,80 +385,93 @@ def run_lint(models: Optional[Sequence[str]] = None,
     if unknown:
         raise ValueError(f"unknown model families {unknown}; "
                          f"one of {sorted(MODELS)}")
+    fams = _resolve_families(families)
+    ctx = _LintRun(names, retrace, seed)
+
+    changed_set: Optional[Set[str]] = None
+    changed_meta: Optional[dict] = None
+    if changed is not None:
+        changed_set = incremental.changed_files(REPO_ROOT, changed)
+        changed_meta = {"ref": changed,
+                        "files": (sorted(changed_set)
+                                  if changed_set is not None else None),
+                        "git_ok": changed_set is not None}
+
+    lint_cache: Optional[incremental.LintCache] = None
+    if cache:
+        cache_path = (cache if isinstance(cache, str)
+                      else incremental.default_cache_path(REPO_ROOT))
+        lint_cache = incremental.LintCache(cache_path)
+
     findings: List[Finding] = []
     passes: Dict[str, float] = {}
-
-    # --- (a) spec soundness + step_jax dtype abstract eval ---------------
-    t0 = time.perf_counter()
-    specs = []
-    for name in names:
-        spec = MODELS[name].make_spec()
-        loc = f"model:{name}"
-        specs.append((name, spec, loc))
-        findings += check_spec(spec, loc, seed=seed)
-        findings += check_step_dtypes(spec, loc)
-    passes["spec"] = time.perf_counter() - t0
-
-    # --- (b) kernel trace hazards ----------------------------------------
-    t0 = time.perf_counter()
-    for rel in (ops_files if ops_files is not None else DEFAULT_OPS_FILES):
-        path = rel if os.path.isabs(rel) else os.path.join(_PKG_DIR, rel)
-        findings += check_host_transfers(path, root=REPO_ROOT)
-    findings += check_pallas_vmem(
-        [(spec, loc) for _, spec, loc in specs],
-        "qsm_tpu/ops/pallas_kernel.py:MAX_PALLAS_STATES")
-    if retrace and specs:
-        # one representative family is enough: the check exercises the
-        # DRIVER's compile-key discipline, which is spec-independent
-        name, spec, _loc = specs[0]
-        from ..ops.jax_kernel import JaxTPU
-
-        backend = JaxTPU(spec, budget=2_000, mid_budget=0,
-                         rescue_budget=0, rescue_slots=64)
-        backend.CHUNK_SCHEDULE = (512,)   # one chunk shape: any cache
-        backend.DOUBLE_BUFFER = False     # growth is a real retrace
-        findings += check_retracing(
-            spec, backend, _retrace_corpora(MODELS[name], spec),
-            "qsm_tpu/ops/jax_kernel.py")
-    passes["kernel"] = time.perf_counter() - t0
-
-    # --- (c) determinism / race ------------------------------------------
-    t0 = time.perf_counter()
-    for rel in (sched_files if sched_files is not None
-                else DEFAULT_SCHED_FILES):
-        path = rel if os.path.isabs(rel) else os.path.join(_PKG_DIR, rel)
-        findings += check_sched_file(path, root=REPO_ROOT)
-    passes["sched"] = time.perf_counter() - t0
-
-    # --- (d) resilience: unbounded device calls --------------------------
-    t0 = time.perf_counter()
-    for rel in (resilience_files if resilience_files is not None
-                else DEFAULT_RESILIENCE_FILES):
-        # repo-root-relative by convention: the tool modules live
-        # outside the package (bench.py, tools/)
-        path = rel if os.path.isabs(rel) else os.path.join(REPO_ROOT, rel)
-        findings += check_resilience_file(path, root=REPO_ROOT)
-    passes["resilience"] = time.perf_counter() - t0
-
-    # --- (e) serve: unbounded accept loops / queues ----------------------
-    t0 = time.perf_counter()
-    for rel in (serve_files if serve_files is not None
-                else DEFAULT_SERVE_FILES):
-        path = rel if os.path.isabs(rel) else os.path.join(REPO_ROOT, rel)
-        findings += check_serve_file(path, root=REPO_ROOT)
-    passes["serve"] = time.perf_counter() - t0
-
-    # --- (f) pool: unreaped workers / respawn storms ---------------------
-    t0 = time.perf_counter()
-    for rel in (pool_files if pool_files is not None
-                else DEFAULT_POOL_FILES):
-        path = rel if os.path.isabs(rel) else os.path.join(REPO_ROOT, rel)
-        findings += check_pool_file(path, root=REPO_ROOT)
-    passes["pool"] = time.perf_counter() - t0
+    for fam in fams:
+        t0 = time.perf_counter()
+        findings += _run_family(fam, ctx, changed_set, lint_cache,
+                                file_overrides or {})
+        passes[fam.key] = time.perf_counter() - t0
+    if lint_cache is not None:
+        lint_cache.save()
 
     wl = _resolve_whitelist(whitelist)
     kept, allowed = split_whitelisted(findings, wl)
     return LintReport(findings=kept, whitelisted=allowed, passes=passes,
                       seconds=time.perf_counter() - t_start,
                       models=names,
-                      whitelist_path=wl.path if wl else None)
+                      whitelist_path=wl.path if wl else None,
+                      families=[f.fid for f in fams],
+                      cache=(lint_cache.stats() if lint_cache else None),
+                      changed=changed_meta)
+
+
+def _run_family(fam: Family, ctx: _LintRun,
+                changed_set: Optional[Set[str]],
+                cache, file_overrides: Dict[str, Sequence[str]]
+                ) -> List[Finding]:
+    from . import incremental
+
+    file_set = list(file_overrides.get(fam.fid, fam.files))
+    rel_paths = [fam.repo_rel(r) for r in file_set]
+    abs_paths = [fam.resolve(r) for r in file_set]
+
+    # a touched trigger (the family's own pass source) re-lints the
+    # whole scan set even when no scanned file changed: a rule edit
+    # must be exercised, not skipped, under --changed
+    trigger_hit = (changed_set is not None and fam.triggers
+                   and any(p.startswith(fam.triggers)
+                           for p in changed_set))
+
+    if fam.per_file is not None:
+        out: List[Finding] = []
+        for rel, path in zip(rel_paths, abs_paths):
+            if (changed_set is not None and rel not in changed_set
+                    and not trigger_hit):
+                continue
+            if cache is not None and fam.cacheable:
+                key = f"{fam.fid}:{rel}:{incremental.file_digest(path)}"
+                hit = cache.get(key)
+                if hit is not None:
+                    out += hit
+                    continue
+                found = fam.per_file(path, REPO_ROOT)
+                cache.put(key, found)
+            else:  # --no-cache: don't hash files for a discarded key
+                found = fam.per_file(path, REPO_ROOT)
+            out += found
+        return out
+
+    # whole-set family: under --changed it runs iff its scan set or
+    # trigger prefixes were touched; cacheable ones key on the set
+    if changed_set is not None:
+        if not trigger_hit and not any(rel in changed_set
+                                       for rel in rel_paths):
+            return []
+    if cache is not None and fam.cacheable:
+        key = f"{fam.fid}:<set>:{incremental.combined_digest(abs_paths)}"
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+        found = fam.whole(ctx, abs_paths)
+        cache.put(key, found)
+        return found
+    return fam.whole(ctx, abs_paths)

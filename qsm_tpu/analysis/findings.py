@@ -52,6 +52,12 @@ class Finding:
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(severity=d["severity"], rule_id=d["rule_id"],
+                   location=d["location"], message=d["message"],
+                   fix_hint=d.get("fix_hint", ""))
+
 
 class Whitelist:
     """Accepted findings: exact rule_id + location prefix per entry."""
@@ -134,3 +140,70 @@ def render_json(findings: Sequence[Finding],
     if meta:
         doc.update(meta)
     return json.dumps(doc)
+
+
+# SARIF 2.1.0 (``--sarif PATH``): the interchange form CI diff viewers
+# annotate pull requests from.  Deterministic output — sorted keys, no
+# timestamps — so a golden-file test can pin the exact document.
+
+_SARIF_LEVEL = {ERROR: "error", WARNING: "warning", INFO: "note"}
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _sarif_location(location: str) -> dict:
+    """``path:Class.fn:line`` / ``path:line`` → uri + startLine;
+    semantic locations (``model:cas``) become a bare uri."""
+    parts = location.split(":")
+    uri, line = parts[0], None
+    if len(parts) > 1 and parts[-1].isdigit():
+        line = int(parts[-1])
+    if "/" not in uri and not uri.endswith(".py"):
+        uri, line = location, None  # model:/fixture: pseudo-locations
+    phys: Dict = {"artifactLocation": {"uri": uri}}
+    if line is not None:
+        phys["region"] = {"startLine": line}
+    return {"physicalLocation": phys}
+
+
+def _sarif_result(f: Finding, suppressed: bool) -> dict:
+    text = f.message if not f.fix_hint else f"{f.message} (fix: {f.fix_hint})"
+    res: Dict = {
+        "ruleId": f.rule_id,
+        "level": _SARIF_LEVEL.get(f.severity, "none"),
+        "message": {"text": text},
+        "locations": [_sarif_location(f.location)],
+    }
+    if suppressed:
+        res["suppressions"] = [{
+            "kind": "external",
+            "justification": "accepted via the reviewed .qsmlint "
+                             "whitelist (docs/ANALYSIS.md)"}]
+    return res
+
+
+def render_sarif(findings: Sequence[Finding],
+                 whitelisted: Sequence[Finding] = (),
+                 meta: Optional[Dict] = None) -> str:
+    """One SARIF 2.1.0 document; whitelisted findings ride along as
+    suppressed results so the CI viewer shows the reviewed claims too."""
+    rules = sorted({f.rule_id
+                    for f in list(findings) + list(whitelisted)})
+    results = ([_sarif_result(f, False) for f in sort_findings(findings)]
+               + [_sarif_result(f, True)
+                  for f in sort_findings(whitelisted)])
+    # no informationUri: SARIF 2.1.0 requires it to be an ABSOLUTE
+    # URI and this repo has no canonical URL; the rule docs pointer
+    # rides each rule's helpUri-free id (docs/ANALYSIS.md)
+    driver: Dict = {
+        "name": "qsmlint",
+        "rules": [{"id": r} for r in rules],
+    }
+    if meta and meta.get("version"):
+        driver["version"] = str(meta["version"])
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{"tool": {"driver": driver}, "results": results}],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

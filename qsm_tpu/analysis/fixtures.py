@@ -187,6 +187,176 @@ class ReapedWorkerPoolStub:
             backoff = min(backoff * 2, 30.0)
 
 
+class DeadlockingLockPairStub:
+    """Seeded bug for the race passes (family g), ORDER half: lock_b
+    taken while holding lock_a on one path and lock_a while holding
+    lock_b on the other (QSM-RACE-ORDER — two threads interleaving
+    these paths deadlock, which on the serving stack is a wedged
+    server).  Never executed; tests point the whole-program race pass
+    at this file and assert the cycle fires exactly once."""
+
+    def __init__(self):
+        import threading
+
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+        self.balance = 0
+
+    def transfer_ab(self):
+        with self.lock_a:
+            with self.lock_b:
+                self.balance += 1
+
+    def refund_ba(self):
+        with self.lock_b:
+            with self.lock_a:            # <-- bug: AB/BA cycle
+                self.balance -= 1
+
+
+class OrderedLockPairStub:
+    """The sanctioned twin: both paths take lock_a before lock_b — one
+    global acquisition order, no cycle, must NOT be flagged."""
+
+    def __init__(self):
+        import threading
+
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+        self.balance = 0
+
+    def transfer(self):
+        with self.lock_a:
+            with self.lock_b:
+                self.balance += 1
+
+    def audit(self):
+        with self.lock_a:
+            with self.lock_b:
+                self.balance -= 1
+
+
+class UnguardedCounterStub:
+    """Seeded bug for the race passes (family g), UNGUARDED half: a
+    shared counter written under ``_lock`` on one line and with no lock
+    two lines later, from a thread-target loop (QSM-RACE-UNGUARDED —
+    the torn/lost-update shape a single-module lint cannot see)."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.count = 0
+
+    def start(self):
+        import threading
+
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self.count += 1          # guarded write: the discipline
+            self.count -= 1              # <-- bug: unguarded write
+
+
+class GuardedCounterStub:
+    """The sanctioned twin: every post-``__init__`` write holds the one
+    guard lock — must NOT be flagged."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.count = 0
+
+    def start(self):
+        import threading
+
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self.count += 1
+            with self._lock:
+                self.count -= 1
+
+
+class UnjoinedThreadStub:
+    """Seeded bug for the race passes (family g), LIFECYCLE half: a
+    thread whose target loops forever without consulting any stop flag,
+    retained on an attribute in a class with no bounded ``join``
+    (QSM-THREAD-LIFECYCLE — teardown can never complete)."""
+
+    def start(self):
+        import threading
+
+        t = threading.Thread(target=self._pump, daemon=True)
+        t.start()
+        self._pump_thread = t            # retained, never joined
+
+    def _pump(self):
+        import time
+
+        while True:                      # <-- bug: no stop flag/deadline
+            time.sleep(0.01)
+
+
+class StoppableThreadStub:
+    """The sanctioned twin: stop-flag-gated target loop plus a bounded
+    join on the teardown path — must NOT be flagged."""
+
+    def __init__(self):
+        import threading
+
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        import threading
+
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)       # bounded join
+
+    def _pump(self):
+        import time
+
+        while not self._stop.is_set():
+            time.sleep(0.01)
+
+
+class LeakedPipeStub:
+    """Seeded bug for the race passes (family g), LEAK half: a pipe
+    acquired and dropped — neither end closed on any path, nothing
+    handed off (QSM-RES-LEAK — a long-lived server leaks descriptors
+    until accept() fails with EMFILE) — next to the try/finally twin
+    the pass must NOT flag."""
+
+    def open_unclosed(self):
+        import os
+
+        r, w = os.pipe()                 # <-- bug: never closed
+        return "opened"
+
+    def open_closed(self):
+        """The sanctioned form: closed on every exit."""
+        import os
+
+        r, w = os.pipe()
+        try:
+            return "opened"
+        finally:
+            os.close(r)
+            os.close(w)
+
+
 class UnboundedServeAcceptStub:
     """Seeded bug for the serve passes (family e): a ``while True``
     accept loop with no deadline or shutdown check (QSM-SERVE-ACCEPT —

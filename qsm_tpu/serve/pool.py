@@ -285,7 +285,8 @@ class WorkerPool:
                     handle.request({"op": "exit"}, timeout_s=0.5)
                 except (WorkerBusy, WorkerFault):
                     pass
-            handle.dead = True
+            with self._lock:  # dead is checked/set under the pool lock
+                handle.dead = True
             proc = handle.proc
             try:
                 proc.terminate()
@@ -327,9 +328,13 @@ class WorkerPool:
                  "--wid", str(slot.index)],
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
         except OSError:
-            slot.deaths += 1
-            slot.next_respawn_at = time.monotonic() + slot.backoff_s
-            slot.backoff_s = min(slot.backoff_s * 2, self.max_backoff_s)
+            # slot backoff state is shared with the dispatch threads'
+            # _shed path — same guard discipline (QSM-RACE-UNGUARDED)
+            with self._lock:
+                slot.deaths += 1
+                slot.next_respawn_at = time.monotonic() + slot.backoff_s
+                slot.backoff_s = min(slot.backoff_s * 2,
+                                     self.max_backoff_s)
             return False
         with self._lock:
             slot.handle = WorkerHandle(slot.index, proc)
@@ -382,13 +387,16 @@ class WorkerPool:
                     if (slot.respawns < self.max_respawns
                             and now >= slot.next_respawn_at
                             and slot.next_respawn_at > 0.0):
-                        slot.respawns += 1
                         with self._lock:
+                            slot.respawns += 1
                             self.respawns += 1
                         self._spawn(slot)
                     continue
-                if now - handle.started >= self.HEALTHY_RESET_S:
-                    slot.backoff_s = slot.base_backoff_s
+                if (now - handle.started >= self.HEALTHY_RESET_S
+                        and slot.backoff_s != slot.base_backoff_s):
+                    # backoff is also written by _shed under the lock
+                    with self._lock:
+                        slot.backoff_s = slot.base_backoff_s
                 if handle.busy or handle.dead:
                     continue  # dispatch deadline covers busy workers
                 if now - handle.last_ok < self.heartbeat_s:

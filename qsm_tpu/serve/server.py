@@ -233,6 +233,11 @@ class CheckServer:
                 os.unlink(self.unix_path)
             except OSError:
                 pass
+        # the accept thread is retained, so its teardown is bounded:
+        # the loop re-checks _stop every 0.2 s (settimeout) and the
+        # closed socket breaks it immediately (QSM-THREAD-LIFECYCLE)
+        for t in self._threads:
+            t.join(2.0)
         self.cache.flush()
 
     def wait(self, timeout_s: Optional[float] = None) -> bool:

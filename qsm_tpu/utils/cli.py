@@ -741,7 +741,7 @@ def cmd_lint(args) -> int:
         from .device import force_cpu_platform
 
         force_cpu_platform()
-        from ..analysis import render_json, render_text, run_lint
+        from ..analysis import FAMILIES, render_text, run_lint
 
         wl = args.whitelist
         if wl is not None and not os.path.exists(wl):
@@ -757,11 +757,20 @@ def cmd_lint(args) -> int:
                 print(f"unknown model families {unknown}; one of "
                       f"{sorted(MODELS)}", file=sys.stderr)
                 return 2
+        families = args.family.split(",") if args.family else None
+        if families:
+            unknown = sorted(set(families) - set(FAMILIES))
+            if unknown:
+                print(f"unknown pass families {unknown}; one of "
+                      f"{sorted(FAMILIES)}", file=sys.stderr)
+                return 2
         # default-whitelist resolution (.qsmlint at the repo root when
         # present) happens INSIDE run_lint — one definition; the report
         # carries the resolved path back for the label
         rep = run_lint(models=models, retrace=not args.no_retrace,
-                       whitelist=wl)
+                       whitelist=wl, families=families,
+                       changed=args.changed,
+                       cache=not args.no_cache)
         doc = rep.to_json()
         if args.out:
             # archived alongside bench artifacts (probe_watcher/CI) —
@@ -771,13 +780,28 @@ def cmd_lint(args) -> int:
             from ..resilience.checkpoint import atomic_write_text
 
             atomic_write_text(args.out, doc + "\n")
+        if args.sarif:
+            # the CI diff-annotation form (SARIF 2.1.0), always archived
+            # to a file: stdout keeps its one-document contract
+            from ..resilience.checkpoint import atomic_write_text
+
+            atomic_write_text(args.sarif, rep.to_sarif() + "\n")
         if args.json:
             print(doc)
         else:
             print(render_text(rep.findings, rep.whitelisted))
+            scope = ""
+            if rep.changed is not None:
+                n = (len(rep.changed["files"])
+                     if rep.changed["files"] is not None else "all")
+                scope = f"; changed since {rep.changed['ref']}: {n}"
+            if rep.cache is not None:
+                scope += (f"; cache {rep.cache['hits']} hit(s) / "
+                          f"{rep.cache['misses']} miss(es)")
             print(f"({rep.seconds:.1f}s over models: "
-                  f"{', '.join(rep.models)};"
-                  f" whitelist: {rep.whitelist_path or 'none'})")
+                  f"{', '.join(rep.models)}; families: "
+                  f"{','.join(rep.families)};"
+                  f" whitelist: {rep.whitelist_path or 'none'}{scope})")
     except Exception as e:  # noqa: BLE001 — analyzer trouble, not findings
         import traceback
 
@@ -1144,6 +1168,20 @@ def main(argv=None) -> int:
                         "the repo root when present)")
     p.add_argument("--models", default=None,
                    help="comma list of registry families (default: all)")
+    p.add_argument("--family", default=None,
+                   help="comma list of registered pass-family ids "
+                        "(a..g; default: all — docs/ANALYSIS.md)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="lint only modules git-touched since REF "
+                        "(default HEAD); whole-program families run "
+                        "iff their scan set or triggers changed")
+    p.add_argument("--sarif", default=None, metavar="PATH",
+                   help="also write the findings as a SARIF 2.1.0 "
+                        "document (CI diff annotation)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="disable the on-disk per-file result cache "
+                        "(.qsmlint-cache.json)")
     p.add_argument("--no-retrace", action="store_true",
                    help="skip the dynamic jit-cache retracing check "
                         "(the one pass that executes a backend)")

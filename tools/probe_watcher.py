@@ -76,7 +76,12 @@ CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 
 # Committed archive of the pre-seize static-analysis findings (the lint
 # gate below); one JSON document, refreshed whenever the gate runs.
-LINT_ARTIFACT = os.path.join(REPO, f"LINT_{ROUND_TAG}.json")
+# The lint artifact tracks the ANALYZER round (r07 added the family-g
+# interprocedural race analyzer), independent of the window artifacts'
+# ROUND_TAG — renaming those retires banked measurements, renaming this
+# just says which rule set produced the findings.
+LINT_ROUND = "r07"
+LINT_ARTIFACT = os.path.join(REPO, f"LINT_{LINT_ROUND}.json")
 
 # Cached verdict of the pre-seize lint gate, keyed on a SOURCE
 # fingerprint — not process lifetime: the watcher runs all round while
@@ -96,10 +101,14 @@ def _lint_fingerprint() -> str:
     whitelist, and must clear a cached refusal just like a code fix).
     Uncommitted edits count — git state would not."""
     latest, count = 0.0, 0
-    paths = [os.path.join(REPO, ".qsmlint")]
-    for dirpath, _dirs, files in os.walk(os.path.join(REPO, "qsm_tpu")):
-        paths.extend(os.path.join(dirpath, f) for f in files
-                     if f.endswith(".py"))
+    paths = [os.path.join(REPO, ".qsmlint"),
+             os.path.join(REPO, "bench.py")]
+    # tools/ is part of the scanned corpus too (families d–g read the
+    # bench drivers and this watcher): edits there must re-lint
+    for sub in ("qsm_tpu", "tools"):
+        for dirpath, _dirs, files in os.walk(os.path.join(REPO, sub)):
+            paths.extend(os.path.join(dirpath, f) for f in files
+                         if f.endswith(".py"))
     for p in paths:
         try:
             latest = max(latest, os.path.getmtime(p))
