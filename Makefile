@@ -13,7 +13,13 @@ PYTHON ?= python
 # archives the same document before every window seize)
 LINT_ARTIFACT ?= LINT_r07.json
 
-.PHONY: lint-gate lint-changed lint-sarif test
+# P-compositionality bench (tools/bench_pcomp.py): host-only — no TPU
+# window needed — on CellJournal --resume rails; refreshes the
+# committed BENCH_PCOMP artifact (kv 64/256/1024 decomposed vs whole,
+# oracle-verified, stitched witnesses replayed)
+PCOMP_ARTIFACT ?= BENCH_PCOMP_r09.json
+
+.PHONY: lint-gate lint-changed lint-sarif test bench-pcomp
 
 lint-gate:
 	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT)
@@ -24,6 +30,10 @@ lint-changed:
 lint-sarif:
 	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT) \
 		--sarif $(LINT_ARTIFACT:.json=.sarif)
+
+bench-pcomp:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_pcomp.py \
+		--out $(PCOMP_ARTIFACT) --resume
 
 # the tier-1 quick lane (ROADMAP.md has the full pinned command)
 test:

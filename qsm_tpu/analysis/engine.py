@@ -84,8 +84,13 @@ DEFAULT_RACE_FILES = (
     "qsm_tpu/serve/frames.py",
     "qsm_tpu/resilience/policy.py", "qsm_tpu/resilience/failover.py",
     "qsm_tpu/resilience/faults.py", "qsm_tpu/resilience/checkpoint.py",
-    "tools/bench_serve.py", "tools/probe_watcher.py",
-    "tools/soak_prune.py")
+    # the P-compositional split plane: server sub-lane fan-out joins
+    # across connection + dispatcher threads (serve/server.py _SubJoin),
+    # and the combinator/planner it rides — one closed program with the
+    # rest of the serving stack
+    "qsm_tpu/ops/pcomp.py", "qsm_tpu/search/planner.py",
+    "tools/bench_serve.py", "tools/bench_pcomp.py",
+    "tools/probe_watcher.py", "tools/soak_prune.py")
 
 
 def default_whitelist_path() -> str:
@@ -246,9 +251,14 @@ def _run_race(_ctx: _LintRun, files: List[str]) -> List[Finding]:
 
 FAMILIES: Dict[str, Family] = {f.fid: f for f in (
     Family(fid="a", key="spec",
-           title="spec soundness (parity, domains, bounds, dtypes)",
+           title="spec soundness (parity, domains, bounds, dtypes, "
+                 "projections)",
            whole=_run_spec, cacheable=False,
            triggers=("qsm_tpu/models/", "qsm_tpu/core/",
+                     # projection consumers: a pcomp/planner change can
+                     # shift what QSM-SPEC-PCOMP must hold, so --changed
+                     # runs re-validate the spec family too
+                     "qsm_tpu/ops/pcomp.py", "qsm_tpu/search/planner.py",
                      "qsm_tpu/analysis/spec_passes.py",
                      "qsm_tpu/analysis/kernel_passes.py")),
     Family(fid="b", key="kernel",

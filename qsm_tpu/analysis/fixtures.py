@@ -400,3 +400,54 @@ class UnboundedServeAcceptStub:
                     return
                 continue
             conn.close()
+
+
+# ---------------------------------------------------------------------------
+# P-compositionality projection fixtures (QSM-SPEC-PCOMP — pass family a)
+# ---------------------------------------------------------------------------
+
+from ..core.spec import KeyProj  # noqa: E402
+from ..models.kv import KvSpec  # noqa: E402
+
+
+class NonTotalPartitionKvSpec(KvSpec):
+    """Seeded bug for QSM-SPEC-PCOMP: the spec still advertises
+    ``projected_spec`` but PUT declares no KeyProj — partition_key is
+    not total, so a split would have to drop or guess where PUTs land.
+    The validator must refuse (and the planner must stamp the refusal
+    into its ``why`` instead of splitting)."""
+
+    name = "non_total_partition_kv"
+
+    def __init__(self, n_keys: int = 4, n_values: int = 4):
+        super().__init__(n_keys=n_keys, n_values=n_values)
+        get, put = self.CMDS
+        import dataclasses
+
+        self.CMDS = (get, dataclasses.replace(put, proj=None))  # <-- bug
+
+
+class UnfaithfulProjectionKvSpec(KvSpec):
+    """Seeded bug for QSM-SPEC-PCOMP: PUT's KeyProj declares stride 1 —
+    the key becomes the PACKED (key*n_values+value) arg, so the split
+    scatters one key's writes across many sub-histories while the
+    projected WRITE arg is always 0.  The sampled faithfulness check
+    must catch the disagreement with the whole spec's step."""
+
+    name = "unfaithful_projection_kv"
+
+    def __init__(self, n_keys: int = 4, n_values: int = 4):
+        super().__init__(n_keys=n_keys, n_values=n_values)
+        get, put = self.CMDS
+        import dataclasses
+
+        self.CMDS = (get, dataclasses.replace(
+            put, proj=KeyProj(pcmd=1, stride=1)))  # <-- bug: wrong stride
+
+
+class SanctionedProjectionKvSpec(KvSpec):
+    """Sanctioned twin: a KvSpec subclass whose declarations are exactly
+    the sound ones — must stay CLEAN under QSM-SPEC-PCOMP (the pass
+    flags unsound declarations, not subclassing)."""
+
+    name = "sanctioned_projection_kv"

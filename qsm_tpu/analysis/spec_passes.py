@@ -26,6 +26,13 @@ not parse it):
   exhaustive over the declared scalar state space when tabulable, else
   sampled over trajectory-reachable states; resps include out-of-domain
   values (SUTs can return anything).
+* ``QSM-SPEC-PCOMP``       — a declared per-key projection
+  (``CmdSig.proj`` / ``projected_spec``) must VALIDATE: total
+  partition_key, in-domain projected ops, faithful + independent per-key
+  semantics (``core.spec.projection_report``).  An unsound declaration
+  would let the P-compositional checkers split a history they must not
+  (unsound verdicts); the planner refuses at plan time, and this pass
+  refuses before any corpus is even built.
 """
 
 from __future__ import annotations
@@ -366,6 +373,32 @@ def check_parity(spec: Spec, location: str,
     return out
 
 
+def check_projection(spec: Spec, location: str,
+                     seed: int = 0) -> List[Finding]:
+    """QSM-SPEC-PCOMP: validate a declared per-key projection.
+
+    A spec that declares nothing is silent (whole-history checking is
+    always sound); a spec that DECLARES decomposability — any
+    ``CmdSig.proj``, or a ``projected_spec`` attribute — must pass the
+    compile-time validator, because every P-compositional consumer
+    (ops/pcomp.py, the planner's decompose_keys stage, the serve split
+    plane) trusts exactly that report."""
+    from ..core.spec import projection_report
+
+    declares = (hasattr(spec, "projected_spec")
+                or any(c.proj is not None for c in spec.CMDS))
+    if not declares:
+        return []
+    problems = projection_report(spec, seed=seed)
+    return [Finding(ERROR, "QSM-SPEC-PCOMP", location,
+                    f"declared per-key projection is unsound: {p}",
+                    "fix the KeyProj declaration/projected spec, or "
+                    "remove it — the planner refuses to decompose "
+                    "(never splits unsoundly), so the declaration only "
+                    "misleads")
+            for p in problems]
+
+
 def check_spec(spec: Spec, location: str,
                n_ops: int = KERNEL_OPS_CEILING,
                seed: int = 0) -> List[Finding]:
@@ -380,4 +413,5 @@ def check_spec(spec: Spec, location: str,
     out += bound_findings
     out += check_parity(spec, location, n_ops=n_ops, visited=visited,
                         seed=seed)
+    out += check_projection(spec, location, seed=seed)
     return out

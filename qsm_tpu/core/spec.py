@@ -33,16 +33,41 @@ import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)
+class KeyProj:
+    """Declarative per-key projection of one command (P-compositionality,
+    PAPERS.md:5).  A command whose integer argument packs ``key * stride +
+    projected_arg`` declares how to unpack it:
+
+        key           = arg // stride
+        projected op  = (pcmd, arg % stride, resp)   — resp passes through
+
+    ``pcmd`` indexes the PROJECTED spec's alphabet (``projected_spec()``).
+    Declared next to the step tables so the split the checker performs is
+    visible in the same place the semantics live, and so
+    :func:`projection_report` can validate totality and faithfulness once
+    at compile time instead of trusting a hand-written ``partition_key``.
+    """
+
+    pcmd: int    # command index in the projected (per-key) spec
+    stride: int  # key = arg // stride; projected arg = arg % stride
+
+
+@dataclasses.dataclass(frozen=True)
 class CmdSig:
     """Signature of one command in a spec's alphabet.
 
     ``n_args``/``n_resps`` bound the integer domains so generators and the
     pending-op completion logic (fault injection) can enumerate them.
+    ``proj`` (optional) declares the command's per-key projection for
+    P-compositional decomposition; a spec is decomposable iff EVERY
+    command declares one (totality) and :func:`projection_report` finds
+    the projected spec faithful.
     """
 
     name: str
     n_args: int  # args drawn from [0, n_args); 1 means "no argument"
     n_resps: int  # responses live in [0, n_resps)
+    proj: Optional[KeyProj] = None  # per-key projection (P-compositionality)
 
 
 class Spec:
@@ -163,8 +188,39 @@ class Spec:
     def partition_key(self, cmd: int, arg: int) -> Optional[int]:
         """Key for P-compositionality decomposition, or None if the spec is
         not per-key decomposable.  Sound only when sub-histories for distinct
-        keys are independent (PAPERS.md:5)."""
-        return None
+        keys are independent (PAPERS.md:5).
+
+        Derived from the ``CmdSig.proj`` declarations: a spec that tags
+        every command with a :class:`KeyProj` gets the split for free and
+        — more importantly — gets it VALIDATED (:func:`projection_report`)
+        instead of trusted.  A command without a declaration answers None,
+        which every consumer treats as "refuse to decompose"."""
+        p = self.CMDS[cmd].proj
+        return None if p is None else arg // p.stride
+
+    def project_op(self, cmd: int, arg: int, resp: int
+                   ) -> Tuple[int, int, int]:
+        """Map a whole-spec op onto the projected (per-key) spec's
+        ``(cmd, arg, resp)``.  Responses pass through unchanged — the
+        validator pins the projected command's response domain equal to
+        the original's, so a stitched witness's completion choices stay
+        in-domain for the WHOLE spec too (ops/backend.py verify_witness).
+        """
+        p = self.CMDS[cmd].proj
+        if p is None:
+            raise ValueError(
+                f"{self.name}: command {cmd} declares no KeyProj; "
+                "partition_key is not total")
+        return p.pcmd, arg % p.stride, resp
+
+    def project_state(self, state: Sequence[int], key: int) -> list:
+        """The per-key component of a whole model state — the state the
+        projected spec sees for ``key``.  Default: element ``key`` of the
+        packed vector (a product-of-scalars state layout, which every
+        in-tree decomposable spec uses).  Specs with a different packing
+        override; :func:`projection_report` validates the choice against
+        ``step_py`` either way."""
+        return [int(state[key])]
 
     # -- persistence ------------------------------------------------------
     def spec_kwargs(self) -> dict:
@@ -261,3 +317,182 @@ def compile_selectivity_table(
                     if good:
                         sel[c, a, r] += 1.0
     return sel / max(n_probed, 1)
+
+
+# ---------------------------------------------------------------------------
+# P-compositionality projection validation (compile time, once per spec)
+# ---------------------------------------------------------------------------
+
+# Sampling caps: faithfulness is checked over every (cmd, arg, resp)
+# tuple (arg domains stride-sampled past the cap) from a seeded set of
+# reachable states — exhaustive state enumeration is out of the question
+# for product states (n_values ** n_keys), and a projection bug is a
+# per-command packing mistake, visible from almost any state.
+_PROJ_PROBE_STATES = 24
+_PROJ_PROBE_ARGS = 64
+
+
+def projection_report(spec: Spec, seed: int = 0) -> list:
+    """Validate a spec's declared per-key projection; [] means sound.
+
+    Returns a list of human-readable problem strings (the planner's
+    refusal ``why`` stamps and qsmlint's QSM-SPEC-PCOMP findings both
+    render these verbatim).  Checks, in order:
+
+    * **declaration** — ``projected_spec()`` exists and every command
+      carries a :class:`KeyProj` (totality: a history can only be split
+      if EVERY op maps to a key);
+    * **domains** — projected cmd indexes the projected alphabet, the
+      projected arg domain ``[0, stride)`` fits it, and the projected
+      command's response domain EQUALS the original's (a pending op's
+      completion is chosen in the projected domain and must replay
+      in-domain against the whole spec — verify_witness);
+    * **faithfulness + independence** — from seeded reachable whole
+      states: a step changes ONLY its key's component, and the projected
+      spec's ``step_py`` on the projected op from the projected state
+      agrees (same ok, same per-key next state).  This is the
+      P-compositionality soundness obligation: the whole object IS the
+      product of the per-key objects.
+
+    Cached on the spec instance (``_projection_report``): the planner,
+    PComp construction, the serve plane and qsmlint all consult it, and
+    it must stay a compile-time cost, not a per-batch one.
+    """
+    cached = spec.__dict__.get("_projection_report")
+    if cached is not None:
+        return list(cached)
+    report = _projection_report_uncached(spec, seed)
+    spec.__dict__["_projection_report"] = tuple(report)
+    return report
+
+
+def _projection_report_uncached(spec: Spec, seed: int) -> list:
+    problems: list = []
+    if not hasattr(spec, "projected_spec"):
+        if any(c.proj is not None for c in spec.CMDS):
+            return [f"{spec.name}: CmdSig declares KeyProj but the spec "
+                    "has no projected_spec()"]
+        return [f"{spec.name}: no per-key projection declared"]
+    missing = [c.name for c in spec.CMDS if c.proj is None]
+    if missing:
+        # non-total: some ops have no key — decomposition would have to
+        # drop or guess them, which is exactly the unsound split the
+        # refusal path exists to prevent
+        return [f"{spec.name}: partition_key is not total — commands "
+                f"{missing} declare no KeyProj"]
+    try:
+        proj = spec.projected_spec()
+    except Exception as e:  # noqa: BLE001 — a failing factory is a report
+        return [f"{spec.name}: projected_spec() raised "
+                f"{type(e).__name__}: {e}"]
+    for c, sig in enumerate(spec.CMDS):
+        p = sig.proj
+        if p.stride <= 0:
+            problems.append(f"{sig.name}: KeyProj stride {p.stride} <= 0")
+            continue
+        if not 0 <= p.pcmd < proj.n_cmds:
+            problems.append(f"{sig.name}: projected cmd {p.pcmd} outside "
+                            f"{proj.name}'s alphabet [0, {proj.n_cmds})")
+            continue
+        psig = proj.CMDS[p.pcmd]
+        if p.stride > psig.n_args:
+            problems.append(
+                f"{sig.name}: projected args [0, {p.stride}) exceed "
+                f"{proj.name}.{psig.name} domain [0, {psig.n_args})")
+        if sig.n_resps != psig.n_resps:
+            problems.append(
+                f"{sig.name}: response domain {sig.n_resps} != projected "
+                f"{proj.name}.{psig.name} domain {psig.n_resps} (pending "
+                "completions must replay in-domain on both)")
+    if problems:
+        return problems
+    problems += _check_faithful(spec, proj, seed)
+    return problems
+
+
+def _check_faithful(spec: Spec, proj: Spec, seed: int) -> list:
+    """Sampled step-level faithfulness/independence (docstring above)."""
+    import random
+
+    rng = random.Random(f"pcomp-faithful:{spec.name}:{seed}")
+    states = [[int(v) for v in spec.initial_state()]]
+    # seeded ok-walks from the initial state gather a reachable sample
+    for _ in range(_PROJ_PROBE_STATES - 1):
+        st = list(rng.choice(states))
+        for _ in range(8):
+            cmd = rng.randrange(spec.n_cmds)
+            arg = rng.randrange(spec.CMDS[cmd].n_args)
+            resp = rng.randrange(spec.CMDS[cmd].n_resps)
+            nxt, ok = spec.step_py(list(st), cmd, arg, resp)
+            if ok:
+                st = [int(v) for v in nxt]
+        states.append(st)
+    problems: list = []
+    # key universe: every key any in-domain arg can map to
+    n_keys = max((sig.n_args - 1) // sig.proj.stride + 1
+                 for sig in spec.CMDS)
+    for cmd, sig in enumerate(spec.CMDS):
+        p = sig.proj
+        args = range(sig.n_args)
+        if sig.n_args > _PROJ_PROBE_ARGS:
+            stride = -(-sig.n_args // _PROJ_PROBE_ARGS)
+            args = range(0, sig.n_args, stride)
+        for arg in args:
+            key = arg // p.stride
+            if spec.partition_key(cmd, arg) != key:
+                # a hand-written partition_key override that disagrees
+                # with the declaration would split one way and project
+                # another — the split itself becomes unsound
+                problems.append(
+                    f"{sig.name}(arg={arg}): partition_key() answers "
+                    f"{spec.partition_key(cmd, arg)} but KeyProj derives "
+                    f"{key}")
+                break
+            for resp in range(sig.n_resps):
+                for st in states:
+                    try:
+                        whole, ok = spec.step_py(list(st), cmd, arg, resp)
+                        sub_st = spec.project_state(st, key)
+                        want_sub = spec.project_state(whole, key)
+                        got_sub, got_ok = proj.step_py(
+                            list(sub_st), p.pcmd, arg % p.stride, resp)
+                        # independence through the projection itself
+                        # (layout-agnostic: project_state overrides
+                        # validate too): every OTHER key's projected
+                        # state must be untouched
+                        leaked = [
+                            k2 for k2 in range(n_keys) if k2 != key
+                            and ([int(v)
+                                  for v in spec.project_state(whole, k2)]
+                                 != [int(v)
+                                     for v in spec.project_state(st, k2)])
+                        ]
+                    except Exception as e:  # noqa: BLE001 — report, not crash
+                        # a projection that derives out-of-range keys or
+                        # states is exactly what this validator exists
+                        # to refuse — report it, never crash the caller
+                        problems.append(
+                            f"{sig.name}(arg={arg}, resp={resp}): "
+                            f"{type(e).__name__}: {e}")
+                        break
+                    if leaked:
+                        problems.append(
+                            f"{sig.name}(arg={arg}): step leaks into "
+                            f"keys {leaked} beyond its own key {key} — "
+                            "keys are not independent")
+                        break
+                    if (bool(got_ok) != bool(ok)
+                            or [int(v) for v in got_sub]
+                            != [int(v) for v in want_sub]):
+                        problems.append(
+                            f"{sig.name}(arg={arg}, resp={resp}): projected "
+                            f"{proj.name} step disagrees with the whole "
+                            f"spec (ok {bool(ok)} vs {bool(got_ok)})")
+                        break
+                else:
+                    continue
+                break  # one problem per (cmd, arg) family is enough
+            else:
+                continue
+            break  # and one per command keeps the report readable
+    return problems

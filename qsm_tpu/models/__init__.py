@@ -8,5 +8,8 @@ from .counter import AtomicTicketSUT, RacyTicketSUT, TicketSpec
 from .cas import AtomicCasSUT, CasSpec, RacyCasSUT
 from .queue import AtomicQueueSUT, QueueSpec, RacyTwoPhaseQueueSUT
 from .kv import AtomicKvSUT, KvSpec, StaleCacheKvSUT
+from .multi import (AtomicMultiCasSUT, AtomicMultiRegisterSUT,
+                    MultiCasSpec, MultiRegisterSpec, RacyMultiCasSUT,
+                    ShardedStaleMultiRegisterSUT)
 from .set import AtomicSetSUT, RacyCheckThenActSetSUT, SetSpec
 from .stack import AtomicStackSUT, RacyTwoPhaseStackSUT, StackSpec

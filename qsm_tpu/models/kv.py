@@ -12,9 +12,9 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..core.spec import CmdSig, Spec
+from ..core.spec import CmdSig, KeyProj, Spec
 from ..sched.scheduler import Recv, Scheduler, Send
-from .register import RegisterSpec
+from .register import READ, WRITE, RegisterSpec
 
 GET = 0
 PUT = 1
@@ -33,9 +33,15 @@ class KvSpec(Spec):
         self.n_keys = n_keys
         self.n_values = n_values
         self.STATE_DIM = n_keys
+        # the per-key projection is DECLARED next to the alphabet (and
+        # validated once by core.spec.projection_report): GET's arg IS
+        # the key (stride 1, projected arg 0 = READ's no-arg), PUT packs
+        # key * n_values + value (stride n_values → projected WRITE(v))
         self.CMDS = (
-            CmdSig("get", n_args=n_keys, n_resps=n_values),
-            CmdSig("put", n_args=n_keys * n_values, n_resps=1),
+            CmdSig("get", n_args=n_keys, n_resps=n_values,
+                   proj=KeyProj(pcmd=READ, stride=1)),
+            CmdSig("put", n_args=n_keys * n_values, n_resps=1,
+                   proj=KeyProj(pcmd=WRITE, stride=n_values)),
         )
 
     def initial_state(self) -> np.ndarray:
@@ -73,18 +79,12 @@ class KvSpec(Spec):
         return new_state.astype(state.dtype), ok
 
     # -- P-compositionality (PAPERS.md:5) ------------------------------
-    def partition_key(self, cmd, arg):
-        return arg if cmd == GET else arg // self.n_values
-
+    # partition_key / project_op are DERIVED from the KeyProj
+    # declarations above (core/spec.py); only the projected spec's
+    # identity needs stating.
     def projected_spec(self) -> RegisterSpec:
         """Each per-key sub-history is a history of a plain register."""
         return RegisterSpec(n_values=self.n_values)
-
-    def project_op(self, cmd, arg, resp):
-        """Map a KV op to the projected register spec's (cmd, arg, resp)."""
-        if cmd == GET:
-            return 0, 0, resp  # READ
-        return 1, arg % self.n_values, resp  # WRITE(v)
 
 
 # ---------------------------------------------------------------------------

@@ -17,6 +17,9 @@ from .queue import AtomicQueueSUT, QueueSpec, RacyTwoPhaseQueueSUT
 from .register import (AtomicRegisterSUT, RacyCachedRegisterSUT,
                        RegisterSpec, ReplicatedRegisterSUT)
 from .failover import AsyncReplFailoverSUT, SyncReplFailoverSUT
+from .multi import (AtomicMultiCasSUT, AtomicMultiRegisterSUT,
+                    MultiCasSpec, MultiRegisterSpec, RacyMultiCasSUT,
+                    ShardedStaleMultiRegisterSUT)
 from .set import AtomicSetSUT, RacyCheckThenActSetSUT, SetSpec
 from .stack import AtomicStackSUT, RacyTwoPhaseStackSUT, StackSpec
 
@@ -56,6 +59,18 @@ MODELS: Dict[str, ModelEntry] = {
     "kv": ModelEntry(
         make_spec=KvSpec,
         impls={"atomic": AtomicKvSUT, "racy": StaleCacheKvSUT},
+        default_pids=16, default_ops=64),
+    # composed multi-cell families (P-compositional, ops/pcomp.py):
+    # per-cell sub-histories project onto register/cas, so long-history
+    # corpora decompose onto the single-object engines
+    "multireg": ModelEntry(
+        make_spec=MultiRegisterSpec,
+        impls={"atomic": AtomicMultiRegisterSUT,
+               "racy": ShardedStaleMultiRegisterSUT},
+        default_pids=16, default_ops=64),
+    "multicas": ModelEntry(
+        make_spec=MultiCasSpec,
+        impls={"atomic": AtomicMultiCasSUT, "racy": RacyMultiCasSUT},
         default_pids=16, default_ops=64),
     # extra model families beyond the five milestone configs
     "set": ModelEntry(

@@ -2,27 +2,41 @@
 
 After Horn & Kroening (PAPERS.md:5): for specs that are products of
 independent per-key objects, a history is linearizable **iff** each per-key
-sub-history is linearizable against the per-key object.  The split turns one
-history of ≤64 ops over 16 pids (config #5, BASELINE.json:11) into K small
-sub-problems — exactly the shape the batched device kernel wants: more,
-smaller, independent histories per ``vmap`` batch (SURVEY.md §2b).
+sub-history is linearizable against the per-key object.  Search cost is
+exponential in history length, so the split turns one 256-op history over a
+composed object into many short sub-problems — exactly the shape the batched
+checkers want: more, smaller, independent histories per call, landing in
+SMALLER compile buckets (docs/PCOMP.md).  Long-history corpora (256-1024
+ops) that fit no op bucket and blow past the native checker's 64-bit taken
+mask become checkable at all only through this split.
 
-Soundness rests on the spec's own declaration (SURVEY.md §7 hard-parts #3):
-``partition_key`` must be total (no cross-key ops) and the projected spec
-must faithfully model a single key.  ``PComp`` validates totality at runtime
-and refuses to decompose otherwise, rather than silently giving unsound
-verdicts.
+Soundness rests on the spec's own declaration, validated ONCE at compile
+time (``core.spec.projection_report``): ``partition_key`` must be total (no
+cross-key ops), the projected spec must faithfully model a single key, and
+keys must be independent.  An invalid projection refuses to decompose
+(``NotDecomposableError``) rather than silently giving unsound verdicts;
+the planner's refusal path stamps the same report into its ``why``.
+
+Certificates: a LINEARIZABLE verdict from the decomposed path carries a
+STITCHED whole-history witness — the per-key witnesses merged into one
+linearization order that respects whole-history real-time precedence —
+which ``verify_witness`` (ops/backend.py) replays search-free.  The merge
+always exists: any cycle among per-key witness edges and cross-key
+real-time edges would collapse (by timestamp transitivity) into a
+real-time edge WITHIN one key, which that key's witness already respects.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Sequence
+import heapq
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..core.history import NO_RESP, History, Op
-from ..core.spec import Spec
+from ..core.history import NO_RESP, OP_BUCKETS, History, Op
+from ..core.spec import Spec, projection_report
 from .backend import LineariseBackend, Verdict
 
 
@@ -32,8 +46,17 @@ def split_history(spec: Spec, history: History) -> Dict[int, History]:
     Timestamps are preserved, so real-time precedence *within* each key is
     exactly the induced sub-order; cross-key precedence is discarded, which
     is precisely what P-compositionality licenses."""
-    per_key: Dict[int, List[Op]] = {}
-    for op in history.ops:
+    return {k: h for k, (h, _) in split_history_indexed(spec, history).items()}
+
+
+def split_history_indexed(
+    spec: Spec, history: History
+) -> Dict[int, Tuple[History, List[int]]]:
+    """Like :func:`split_history`, also returning each sub-history's map
+    from sub-op position to ORIGINAL op index — what witness stitching
+    needs to lift per-key linearizations back onto the whole history."""
+    per_key: Dict[int, Tuple[List[Op], List[int]]] = {}
+    for j, op in enumerate(history.ops):
         key = spec.partition_key(op.cmd, op.arg)
         if key is None:
             raise ValueError(
@@ -44,18 +67,59 @@ def split_history(spec: Spec, history: History) -> Dict[int, History]:
             resp = NO_RESP
         else:
             cmd, arg, resp = spec.project_op(op.cmd, op.arg, op.resp)
-        per_key.setdefault(key, []).append(
-            dataclasses.replace(op, cmd=cmd, arg=arg, resp=resp))
-    return {k: History(ops, seed=history.seed,
-                       program_id=history.program_id)
-            for k, ops in per_key.items()}
+        ops, idx = per_key.setdefault(key, ([], []))
+        ops.append(dataclasses.replace(op, cmd=cmd, arg=arg, resp=resp))
+        idx.append(j)
+    return {k: (History(ops, seed=history.seed,
+                        program_id=history.program_id), idx)
+            for k, (ops, idx) in per_key.items()}
 
 
 class NotDecomposableError(ValueError):
-    """The spec declares no per-key projection; P-compositionality cannot
-    apply.  A distinct type so callers (the CLI) can convert exactly this
-    misconfiguration to a clean exit without masking unrelated
-    ValueErrors from inner-backend construction."""
+    """The spec declares no per-key projection, or declares one the
+    compile-time validator rejects; P-compositionality cannot apply.  A
+    distinct type so callers (the CLI, the planner's refusal path) can
+    convert exactly this misconfiguration to a clean refusal without
+    masking unrelated ValueErrors from inner-backend construction."""
+
+
+# ---------------------------------------------------------------------------
+# decomposition-gain gate (shared by the planner and the serve plane)
+# ---------------------------------------------------------------------------
+
+def bucket_or_none(n_ops: int) -> Optional[int]:
+    """The op bucket ``n_ops`` lands in, or None past the largest — the
+    form the gain gate wants (an unencodable history is "infinite")."""
+    n = max(int(n_ops), 1)
+    for b in OP_BUCKETS:
+        if n <= b:
+            return b
+    return None
+
+
+def longest_sub(spec: Spec, history: History) -> int:
+    """Length of the longest per-key sub-history — computed by counting,
+    no History objects built (the gate runs on every serve request)."""
+    counts: Dict[int, int] = {}
+    for op in history.ops:
+        key = spec.partition_key(op.cmd, op.arg)
+        if key is None:
+            raise ValueError(
+                f"{spec.name}: partition_key is not total "
+                f"(cmd={op.cmd}, arg={op.arg}); cannot decompose")
+        counts[key] = counts.get(key, 0) + 1
+    return max(counts.values(), default=0)
+
+
+def split_gain(spec: Spec, history: History) -> bool:
+    """True when decomposing ``history`` buys a strictly smaller compile
+    bucket (or makes an unencodable/over-mask history checkable at all).
+    Equal buckets mean the split only adds lanes — not worth it."""
+    sub = bucket_or_none(longest_sub(spec, history))
+    if sub is None:
+        return False  # even the sub-histories fit no bucket: no gain
+    whole = bucket_or_none(len(history))
+    return whole is None or sub < whole
 
 
 class PComp:
@@ -63,7 +127,10 @@ class PComp:
     the whole input batch in one inner-backend call, aggregate per input.
 
     Aggregation: VIOLATION if any key violates; else BUDGET_EXCEEDED if any
-    key was undecided; else LINEARIZABLE.
+    key was undecided; else LINEARIZABLE.  ``check_witness`` additionally
+    stitches the per-key witnesses into a whole-history certificate
+    (module docstring).  Construction VALIDATES the spec's projection
+    (``core.spec.projection_report``) and refuses unsound declarations.
     """
 
     def __init__(self, spec: Spec, make_inner=None):
@@ -76,35 +143,182 @@ class PComp:
         from .wing_gong_cpu import WingGongCPU
 
         self.spec = spec
-        if not hasattr(spec, "projected_spec"):
+        problems = projection_report(spec)
+        if problems:
             raise NotDecomposableError(
                 f"spec {spec.name!r} is not per-key decomposable: "
-                "P-compositionality needs projected_spec()/project_op() "
-                "and a partition_key (PAPERS.md:5); use a whole-history "
-                "backend for this spec")
+                + "; ".join(problems)
+                + " (P-compositionality, PAPERS.md:5; declare CmdSig.proj "
+                  "+ projected_spec(), or use a whole-history backend)")
         self.projected = spec.projected_spec()
         self.inner: LineariseBackend = (
             make_inner(self.projected) if make_inner is not None
             else WingGongCPU(memo=True))
         self.name = f"pcomp({self.inner.name})"
+        # the per-key witness searcher (and BUDGET_EXCEEDED resolver) —
+        # the property layer's own resolution oracle, bound to the
+        # projected spec; built lazily (check_histories never needs it)
+        self._witness_oracle = None
+        # pcomp_* accounting (search/stats.py)
+        self.histories_seen = 0
+        self.split_histories = 0   # inputs that split into >1 key
+        self.subs_produced = 0     # per-key sub-histories dispatched
+        self.max_sub_len = 0       # longest sub-history seen (ops)
+        self.recombine_s = 0.0     # split + aggregate + stitch time
 
+    # ------------------------------------------------------------------
     def check_histories(self, spec: Spec, histories: Sequence[History]
                         ) -> np.ndarray:
         assert spec is self.spec, "PComp is bound to one spec"
+        t0 = time.perf_counter()
+        self.histories_seen += len(histories)
         flat: List[History] = []
         groups: List[slice] = []
         for h in histories:
             start = len(flat)
-            flat.extend(split_history(spec, h).values())
+            subs = split_history(spec, h)
+            flat.extend(subs.values())
             groups.append(slice(start, len(flat)))
+            self.subs_produced += len(subs)
+            if len(subs) > 1:
+                self.split_histories += 1
+            self.max_sub_len = max(
+                self.max_sub_len, max((len(s) for s in subs.values()),
+                                      default=0))
         out = np.full(len(histories), int(Verdict.LINEARIZABLE), np.int8)
         if not flat:
+            self.recombine_s += time.perf_counter() - t0
             return out
+        t1 = time.perf_counter()
+        self.recombine_s += t1 - t0
         sub = self.inner.check_histories(self.projected, flat)
+        t2 = time.perf_counter()
         for i, g in enumerate(groups):
             v = sub[g]
             if (v == Verdict.VIOLATION).any():
                 out[i] = int(Verdict.VIOLATION)
             elif (v == Verdict.BUDGET_EXCEEDED).any():
                 out[i] = int(Verdict.BUDGET_EXCEEDED)
+        self.recombine_s += time.perf_counter() - t2
         return out
+
+    # ------------------------------------------------------------------
+    def check_witness(self, spec: Spec, history: History):
+        """(verdict, witness): per-key witnesses stitched into ONE
+        whole-history linearization (module docstring), or None when the
+        verdict is not LINEARIZABLE.  The stitched witness replays
+        search-free through ``verify_witness`` — the decomposed path's
+        LINEARIZABLE verdicts stay exactly as auditable as the direct
+        oracle's."""
+        assert spec is self.spec, "PComp is bound to one spec"
+        subs = split_history_indexed(spec, history)
+        self.histories_seen += 1
+        self.subs_produced += len(subs)
+        if len(subs) > 1:
+            self.split_histories += 1
+        self.max_sub_len = max(
+            self.max_sub_len,
+            max((len(h) for h, _ in subs.values()), default=0))
+        chains: List[List[Tuple[int, int]]] = []
+        for key in sorted(subs):
+            sub_h, idx = subs[key]
+            v, w = self._sub_witness(sub_h)
+            if v != Verdict.LINEARIZABLE:
+                return v, None
+            chains.append([(idx[j], resp) for j, resp in w])
+        t0 = time.perf_counter()
+        witness = stitch_witness(history, chains)
+        self.recombine_s += time.perf_counter() - t0
+        return Verdict.LINEARIZABLE, witness
+
+    def _sub_witness(self, sub_h: History):
+        """One per-key (verdict, witness) — from the inner backend when
+        it can produce witnesses, with BUDGET_EXCEEDED resolved on the
+        memoised oracle (the property layer's resolution rule)."""
+        from .wing_gong_cpu import WingGongCPU
+
+        inner_fn = getattr(self.inner, "check_witness", None)
+        if inner_fn is not None:
+            v, w = inner_fn(self.projected, sub_h)
+            if v != Verdict.BUDGET_EXCEEDED:
+                return Verdict(int(v)), w
+        if self._witness_oracle is None:
+            self._witness_oracle = WingGongCPU(memo=True)
+        v, w = self._witness_oracle.check_witness(self.projected, sub_h)
+        return Verdict(int(v)), w
+
+    # ------------------------------------------------------------------
+    def search_stats(self):
+        """The decomposition's own shape/cost record with the inner
+        engine's counters absorbed — a decomposed rate must say it
+        decomposed, and into what (search/stats.py)."""
+        from ..search.stats import SearchStats, collect_search_stats
+
+        st = SearchStats(
+            engine=self.name,
+            histories=self.histories_seen,
+            pcomp_split=self.split_histories,
+            pcomp_subs=self.subs_produced,
+            pcomp_max_sub=self.max_sub_len,
+            pcomp_recombine_ms=int(self.recombine_s * 1000),
+        )
+        st.absorb(collect_search_stats(self.inner))
+        if self._witness_oracle is not None:
+            # per-key witness searches are host nodes this combinator
+            # spent; hiding them would overstate the decomposed rate
+            st.absorb(collect_search_stats(self._witness_oracle))
+        return st
+
+
+# ---------------------------------------------------------------------------
+# witness stitching
+# ---------------------------------------------------------------------------
+
+def stitch_witness(history: History,
+                   chains: Sequence[Sequence[Tuple[int, int]]]
+                   ) -> List[Tuple[int, int]]:
+    """Merge per-key linearizations into one whole-history witness.
+
+    ``chains``: per key, ``(original_op_index, resp)`` pairs in that
+    key's linearization order.  The merge respects (a) every chain's own
+    order and (b) whole-history real-time precedence between LISTED ops
+    (pruned pending ops appear in no chain and are simply omitted — they
+    precede nothing, so dropping them constrains nothing).  Kahn's
+    algorithm with a min-heap on original index makes the result
+    deterministic.  Acyclicity is a theorem, not a hope (module
+    docstring); a cycle therefore raises — it means the split itself was
+    unsound, which must never be papered over with a bad certificate."""
+    resp_of: Dict[int, int] = {}
+    order: Dict[int, List[int]] = {}   # adjacency (original indices)
+    indeg: Dict[int, int] = {}
+    for chain in chains:
+        for j, resp in chain:
+            resp_of[j] = resp
+            order.setdefault(j, [])
+            indeg.setdefault(j, 0)
+        for (a, _), (b, _) in zip(chain, chain[1:]):
+            order[a].append(b)
+            indeg[b] += 1
+    listed = sorted(resp_of)
+    prec = history.precedes_matrix()
+    for a in listed:
+        for b in listed:
+            if prec[a, b]:
+                order[a].append(b)
+                indeg[b] += 1
+    heap = [j for j in listed if indeg[j] == 0]
+    heapq.heapify(heap)
+    out: List[Tuple[int, int]] = []
+    while heap:
+        j = heapq.heappop(heap)
+        out.append((j, resp_of[j]))
+        for b in order[j]:
+            indeg[b] -= 1
+            if indeg[b] == 0:
+                heapq.heappush(heap, b)
+    if len(out) != len(listed):
+        raise RuntimeError(
+            "witness stitch found a precedence cycle — the per-key "
+            "split was unsound for this history; refusing to emit a "
+            "false certificate")
+    return out

@@ -101,14 +101,21 @@ class AutoDevice:
         self.pcomp = None
         if hasattr(spec, "projected_spec"):
             # per-key decomposition first; each projected sub-history is
-            # routed by a nested AutoDevice bound to the projected spec
-            from .pcomp import PComp
+            # routed by a nested AutoDevice bound to the projected spec.
+            # An UNSOUND declaration refuses (core.spec.projection_report
+            # via PComp) and the router falls back to whole-history
+            # routing — the refusal path, never a silent unsound split
+            from .pcomp import NotDecomposableError, PComp
 
-            self.pcomp = PComp(
-                spec, make_inner=lambda s: AutoDevice(
-                    s, make_inner=make, width_cap=width_cap))
-            self.name = f"auto({self.pcomp.name})"
-            return
+            try:
+                self.pcomp = PComp(
+                    spec, make_inner=lambda s: AutoDevice(
+                        s, make_inner=make, width_cap=width_cap))
+            except NotDecomposableError:
+                self.pcomp = None
+            else:
+                self.name = f"auto({self.pcomp.name})"
+                return
         self.plain: LineariseBackend = make(spec)
         # the SAME kernel instance serves as SegDC's inner backend (one
         # compile/bucket cache across both routes); SegDC's default

@@ -86,15 +86,38 @@ class CorpusProfile:
     pending_fraction: float = 0.0
     cut_fraction: float = 0.0    # histories with ≥1 quiescent cut
     mean_segments: float = 1.0   # segments per history
+    # per-key decomposition shape (only measured when profile_corpus is
+    # given a spec whose projection VALIDATES — ops/pcomp.py): the
+    # longest per-key sub-history across the corpus, and the mean number
+    # of keys a history splits into.  sub_max_ops == 0 means "not
+    # measured" — the decompose_keys gate then stays off.
+    sub_max_ops: int = 0
+    mean_partitions: float = 0.0
 
 
-def profile_corpus(histories: Sequence[History]) -> CorpusProfile:
+def profile_corpus(histories: Sequence[History],
+                   spec=None) -> CorpusProfile:
+    """Corpus statistics; pass ``spec`` to also measure the per-key
+    decomposition shape (sub-history lengths) when the spec declares a
+    valid projection — the planner's ``decompose_keys`` gate needs it."""
     from ..ops.segdc import split_at_quiescent_cuts
 
     if not histories:
         return CorpusProfile()
     lens = [len(h) for h in histories]
     segs = [len(split_at_quiescent_cuts(h)) for h in histories]
+    sub_max = 0
+    mean_parts = 0.0
+    if spec is not None:
+        from ..core.spec import projection_report
+        from ..ops.pcomp import longest_sub
+
+        if not projection_report(spec):
+            subs = [longest_sub(spec, h) for h in histories]
+            parts = [len({spec.partition_key(o.cmd, o.arg)
+                          for o in h.ops}) for h in histories]
+            sub_max = max(subs, default=0)
+            mean_parts = sum(parts) / len(histories)
     return CorpusProfile(
         n=len(histories),
         max_ops=max(lens),
@@ -103,6 +126,8 @@ def profile_corpus(histories: Sequence[History]) -> CorpusProfile:
                           / len(histories)),
         cut_fraction=sum(s > 1 for s in segs) / len(histories),
         mean_segments=sum(segs) / len(histories),
+        sub_max_ops=sub_max,
+        mean_partitions=mean_parts,
     )
 
 
@@ -119,6 +144,13 @@ class SearchPlan:
     ordering: bool          # host-side selectivity permutation
     decompose: bool         # wrap the kernel in quiescent-cut segdc
     unroll: Optional[int]   # None = the driver's platform auto
+    # P-compositional per-key decomposition as a FIRST plan stage
+    # (ops/pcomp.py): on iff the spec's declared projection validates
+    # AND the corpus profile shows sub-histories landing in smaller
+    # compile buckets than the whole histories.  Outermost in
+    # build_backend — per-key sub-histories are sparser, so the
+    # quiescent-cut stage under it cuts more often.
+    decompose_keys: bool = False
     why: Tuple[str, ...] = ()
 
     def describe(self) -> Dict:
@@ -129,9 +161,43 @@ class SearchPlan:
             "max_slots": max(self.slots_for_batch.values(), default=0),
             "ordering": self.ordering,
             "decompose": self.decompose,
+            "decompose_keys": self.decompose_keys,
             "unroll": self.unroll,
             "why": list(self.why),
         }
+
+
+def _plan_decompose_keys(spec, profile: Optional[CorpusProfile]
+                         ) -> Tuple[bool, str]:
+    """The per-key decomposition gate with its ``why`` line: on iff the
+    declared projection VALIDATES (an invalid one refuses loudly here —
+    never a silent unsound split) and the profiled sub-histories land in
+    strictly smaller compile buckets than the whole histories."""
+    from ..core.spec import projection_report
+    from ..ops.pcomp import bucket_or_none
+
+    problems = projection_report(spec)
+    if problems:
+        # refusal with provenance: the plan SAYS why it would not split
+        return False, ("decompose_keys=off (refused: "
+                       f"{problems[0]})")
+    if profile is None or not profile.n or not profile.sub_max_ops:
+        return False, ("decompose_keys=off (projection valid but no "
+                       "sub-history profile for this corpus)")
+    whole = bucket_or_none(profile.max_ops)
+    sub = bucket_or_none(profile.sub_max_ops)
+    if sub is None:
+        return False, (f"decompose_keys=off (sub-histories up to "
+                       f"{profile.sub_max_ops} ops fit no op bucket)")
+    if whole is not None and sub >= whole:
+        return False, (f"decompose_keys=off (sub bucket {sub} >= whole "
+                       f"bucket {whole}: the split only adds lanes)")
+    return True, (
+        f"decompose_keys=on (sub-histories <= {profile.sub_max_ops} ops "
+        f"fit bucket {sub} vs whole "
+        + (f"bucket {whole}" if whole is not None
+           else f"max {profile.max_ops} ops past every bucket")
+        + f"; mean {profile.mean_partitions:.1f} keys/history)")
 
 
 def plan_search(spec, profile: Optional[CorpusProfile] = None,
@@ -161,6 +227,9 @@ def plan_search(spec, profile: Optional[CorpusProfile] = None,
     else:
         why.append("decompose=off (no corpus profile)")
 
+    decompose_keys, dk_why = _plan_decompose_keys(spec, profile)
+    why.append(dk_why)
+
     if on_device:
         why.append("device platform: verified (batch × slots) safe region "
                    "kept; small first chunk ends the starved wide stage "
@@ -172,19 +241,26 @@ def plan_search(spec, profile: Optional[CorpusProfile] = None,
             slots_for_batch=dict(_TPU_SLOTS),
             ordering=orderable,
             decompose=decompose,
+            decompose_keys=decompose_keys,
             unroll=8,
             why=tuple(why),
         )
     first = _CPU_SCHEDULE[0]
     sched = _CPU_SCHEDULE
-    if profile is not None and profile.max_ops > first:
+    # with per-key decomposition on, the inner kernel only ever sees
+    # sub-histories — sizing the schedule to the WHOLE corpus would
+    # re-coarsen exactly what the split just bought
+    eff_max = (profile.sub_max_ops if profile is not None and decompose_keys
+               else profile.max_ops if profile is not None else 0)
+    if eff_max > first:
         # a first chunk below the success-path depth decides nothing:
-        # shift the whole geometric ladder up to cover max_ops
-        while first < profile.max_ops:
+        # shift the whole geometric ladder up to cover the longest lane
+        while first < eff_max:
             first *= 2
         sched = tuple(first * (1 << i) for i in range(len(_CPU_SCHEDULE)))
-        why.append(f"first chunk {first} covers max_ops "
-                   f"{profile.max_ops}")
+        why.append(f"first chunk {first} covers "
+                   f"{'sub-history' if decompose_keys else ''} max_ops "
+                   f"{eff_max}")
     why.append("cpu platform: no crash region — full-size memo tables, "
                "fine buckets to single-lane")
     return SearchPlan(
@@ -194,6 +270,7 @@ def plan_search(spec, profile: Optional[CorpusProfile] = None,
         slots_for_batch={b: _CPU_SLOTS for b in _CPU_BUCKETS},
         ordering=orderable,
         decompose=decompose,
+        decompose_keys=decompose_keys,
         unroll=None,
         why=tuple(why),
     )
@@ -201,15 +278,24 @@ def plan_search(spec, profile: Optional[CorpusProfile] = None,
 
 def build_backend(spec, plan: SearchPlan, budget: int = 2_000, **device_kw):
     """The planned checker: a ``JaxTPU`` honoring ``plan``, wrapped in the
-    quiescent-cut segmentation combinator when the plan decomposes.
-    (Imports are local: the search plane must stay importable without
-    jax for the pure-policy callers — lint, docs, profiling.)"""
+    quiescent-cut segmentation combinator when the plan decomposes, and
+    the whole ladder wrapped in the per-key decomposition combinator
+    (``PComp``) when the plan splits per key — outermost, because per-key
+    sub-histories are sparser and cut more often, so every inner stage
+    benefits.  (Imports are local: the search plane must stay importable
+    without jax for the pure-policy callers — lint, docs, profiling.)"""
     from ..ops.jax_kernel import JaxTPU
 
-    if not plan.decompose:
-        return JaxTPU(spec, budget=budget, plan=plan, **device_kw)
-    from ..ops.segdc import SegDC
+    def make_core(s):
+        if not plan.decompose:
+            return JaxTPU(s, budget=budget, plan=plan, **device_kw)
+        from ..ops.segdc import SegDC
 
-    return SegDC(spec,
-                 make_inner=lambda s: JaxTPU(s, budget=budget, plan=plan,
-                                             **device_kw))
+        return SegDC(s, make_inner=lambda q: JaxTPU(q, budget=budget,
+                                                    plan=plan, **device_kw))
+
+    if plan.decompose_keys:
+        from ..ops.pcomp import PComp
+
+        return PComp(spec, make_inner=make_core)
+    return make_core(spec)

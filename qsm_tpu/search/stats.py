@@ -44,6 +44,15 @@ class SearchStats:
     tail_histories: int = 0     # hybrid: lanes the host tail decided
     segments_split: int = 0     # segdc: histories that actually cut
     segments_total: int = 0     # segdc: segments across them
+    # P-compositionality (ops/pcomp.py): the per-key decomposition's own
+    # cost/shape record — how many histories split, into how many per-key
+    # sub-histories, how long the worst sub-history stayed (the number
+    # that decides whether the split bought smaller compile buckets), and
+    # what recombining verdicts + stitching witnesses cost host-side
+    pcomp_split: int = 0        # histories decomposed per key
+    pcomp_subs: int = 0         # per-key sub-histories produced
+    pcomp_max_sub: int = 0      # longest sub-history (ops) — max-merged
+    pcomp_recombine_ms: int = 0  # verdict recombine + witness stitch
     ordering: bool = False      # postcondition-aware ordering active
     plan: str = ""              # planner provenance ("" = hand-tuned)
     # resilience plane (qsm_tpu/resilience): device-loss accounting —
@@ -78,8 +87,12 @@ class SearchStats:
                   "memo_inserts", "compactions", "chunk_rounds", "rescued",
                   "deferred", "tail_histories", "segments_split",
                   "segments_total", "degradations", "retries",
-                  "worker_faults"):
+                  "worker_faults", "pcomp_split", "pcomp_subs",
+                  "pcomp_recombine_ms"):
             setattr(self, f, getattr(self, f) + getattr(other, f))
+        # a maximum, not a tally: the composed record's worst sub-history
+        # is the worst either side saw
+        self.pcomp_max_sub = max(self.pcomp_max_sub, other.pcomp_max_sub)
         if count_histories:
             self.histories += other.histories
         self.ordering = self.ordering or other.ordering
@@ -114,6 +127,12 @@ class SearchStats:
             "deg": self.degradations,
             "fb": self.fallback_engine,
             "wf": self.worker_faults,
+            # P-compositionality counters ride every compact record too:
+            # a bench row from a decomposed run must say it decomposed
+            # (and into what) or its rate reads as a whole-history rate
+            "pcs": self.pcomp_split,
+            "pcn": self.pcomp_subs,
+            "pcm": self.pcomp_max_sub,
         }
 
     def to_timings(self) -> Dict[str, float]:
@@ -136,6 +155,14 @@ class SearchStats:
             out["resilience_retries"] = float(self.retries)
         if self.worker_faults:
             out["resilience_worker_faults"] = float(self.worker_faults)
+        # pcomp accounting only when decomposition actually happened —
+        # zeros would claim "pcomp ran, split nothing" on every
+        # whole-history run
+        if self.pcomp_subs:
+            out["pcomp_split"] = float(self.pcomp_split)
+            out["pcomp_subs"] = float(self.pcomp_subs)
+            out["pcomp_max_sub"] = float(self.pcomp_max_sub)
+            out["pcomp_recombine_ms"] = float(self.pcomp_recombine_ms)
         return out
 
 
@@ -143,7 +170,10 @@ _COUNTER_FIELDS = ("histories", "lockstep_iters", "nodes_explored",
                    "memo_prunes", "memo_inserts", "compactions",
                    "chunk_rounds", "rescued", "deferred", "tail_histories",
                    "segments_split", "segments_total", "degradations",
-                   "retries", "worker_faults")
+                   "retries", "worker_faults", "pcomp_split", "pcomp_subs",
+                   "pcomp_recombine_ms")
+# pcomp_max_sub is deliberately NOT a delta field: a maximum has no
+# meaningful "per-run difference", so stats_delta keeps `after`'s value.
 
 
 def stats_delta(after: Optional[SearchStats],

@@ -58,6 +58,11 @@ class Lane:
     history: History
     deadline: float          # absolute monotonic deadline of its request
     resolve: Callable        # resolve(verdict:int, batch_stamp:dict)
+    # P-compositional sub-lane: this lane is one per-key sub-history of a
+    # longer request history (server split it — serve/server.py).  Rides
+    # the batch `why` stamp so a micro-batch says how many of its lanes
+    # came from decomposition.
+    pcomp: bool = False
 
 
 class _Group:
@@ -272,6 +277,12 @@ class MicroBatcher:
             self.width_dispatched += width
         why = {"batch": batch_id, "lanes": len(lanes), "width": width,
                "occupancy": round(len(lanes) / width, 3), "flush": reason}
+        n_pcomp = sum(1 for lane in lanes if lane.pcomp)
+        if n_pcomp:
+            # decomposed lanes flattened into this micro-batch — the
+            # stamp keeps split traffic distinguishable from whole-lane
+            # traffic in every response and `qsm-tpu stats` aggregate
+            why["pcomp_lanes"] = n_pcomp
         try:
             self._dispatch(group_key, lanes, why)
         except Exception as e:  # noqa: BLE001 — the loop thread must survive

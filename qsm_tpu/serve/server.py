@@ -72,18 +72,76 @@ class _EngineEntry:
     thread-safe — with one batcher thread (workers=0) the lock is
     uncontended, and with a worker pool it guards the fallback path
     (quarantined spec / exhausted pool), where several dispatcher
-    threads may otherwise hit the same engine concurrently."""
+    threads may otherwise hit the same engine concurrently.
+
+    ``proj`` / ``proj_group_key`` carry the spec's VALIDATED per-key
+    projection (ops/pcomp.py): the projected spec instance whose
+    fingerprints key the per-sub-history cache rows, and the batcher
+    group sub-lanes flatten into (the projected spec's own group — a
+    kv-256 request and a plain register request share one engine and
+    one micro-batch stream).  None when the spec does not decompose.
+    ``pcomp`` is the lazy witness-path combinator."""
 
     __slots__ = ("spec", "engine", "oracle", "plan_why", "emergency",
-                 "dispatch_lock")
+                 "dispatch_lock", "proj", "proj_group_key", "pcomp")
 
-    def __init__(self, spec, engine, oracle, plan_why):
+    def __init__(self, spec, engine, oracle, plan_why,
+                 proj=None, proj_group_key=None):
         self.spec = spec
         self.engine = engine
         self.oracle = oracle
         self.plan_why = plan_why
         self.emergency = None  # built on first serve-site fault
         self.dispatch_lock = threading.Lock()
+        self.proj = proj
+        self.proj_group_key = proj_group_key
+        self.pcomp = None  # built on first decomposed witness request
+
+
+class _SubJoin:
+    """Recombine per-key sub-lane verdicts into ONE whole-history verdict
+    — the PComp aggregation rule (VIOLATION beats BUDGET_EXCEEDED beats
+    LINEARIZABLE) — across cache hits, batch dispatches and aborts.
+    Thread-safe: feeds arrive from the connection thread (hits) and any
+    dispatcher thread (batch resolutions)."""
+
+    def __init__(self, n: int, on_complete):
+        self._lock = threading.Lock()
+        self._n = n
+        self._fed = 0
+        self._worst = int(Verdict.LINEARIZABLE)
+        self._batch: Optional[dict] = None
+        self._on_complete = on_complete
+
+    def feed(self, verdict: int, batch: Optional[dict] = None) -> None:
+        with self._lock:
+            if batch is not None:
+                self._batch = batch
+            v = int(verdict)
+            if v == int(Verdict.VIOLATION):
+                self._worst = v
+            elif (v == int(Verdict.BUDGET_EXCEEDED)
+                  and self._worst == int(Verdict.LINEARIZABLE)):
+                self._worst = v
+            self._fed += 1
+            fire = self._fed == self._n
+            worst, b = self._worst, self._batch
+        if fire:
+            self._on_complete(worst, b)
+
+    def resolver(self):
+        def _resolve(verdict: int, batch: dict) -> None:
+            self.feed(verdict, batch)
+
+        return _resolve
+
+    def abort(self, k: int) -> None:
+        """Feed BUDGET_EXCEEDED for ``k`` sub-lanes that will never
+        dispatch (mid-request shed): the join still completes once the
+        in-flight remainder resolves, so the lane's admission slot
+        releases and nothing leaks."""
+        for _ in range(k):
+            self.feed(int(Verdict.BUDGET_EXCEEDED))
 
 
 class _PendingRequest:
@@ -139,7 +197,8 @@ class CheckServer:
                  engine_factory=None,
                  workers: int = 0,
                  worker_policy: Optional[RetryPolicy] = None,
-                 quarantine_after: int = 2):
+                 quarantine_after: int = 2,
+                 pcomp: bool = True):
         if engine not in ("auto", "planned"):
             raise ValueError(f"unknown serve engine {engine!r}; "
                              "one of ('auto', 'planned')")
@@ -181,6 +240,20 @@ class CheckServer:
         self.histories = 0
         self.serve_faults = 0       # serve-site degradations (batch level)
         self.budget_resolved = 0    # engine BUDGET_EXCEEDED → oracle-exact
+        # P-compositional split plane (ops/pcomp.py): long histories of
+        # specs with a VALIDATED projection are split into per-key
+        # sub-lanes that ride the projected spec's micro-batches, with
+        # per-sub-history cache rows — a one-key change to a 512-op
+        # history re-checks that key only (docs/PCOMP.md)
+        self.pcomp_enabled = bool(pcomp)
+        # counters below are written from concurrent connection threads
+        # — guarded, because bench/tests compute per-request DELTAS from
+        # stats() (one_key_change.recheck_keys) and a lost increment
+        # would corrupt them (the QSM-RACE-UNGUARDED discipline)
+        self._pcomp_lock = threading.Lock()
+        self.pcomp_split = 0        # request histories decomposed
+        self.pcomp_subs = 0         # sub-lanes produced from them
+        self.pcomp_sub_hits = 0     # sub-lanes answered from the cache
 
     # -- lifecycle -----------------------------------------------------
     @property
@@ -284,7 +357,7 @@ class CheckServer:
             return entry
 
     def _build_engine(self, model: str, spec_kwargs: dict) -> _EngineEntry:
-        from ..models.registry import make
+        from ..models.registry import MODELS, make
         from ..ops.wing_gong_cpu import WingGongCPU
         from ..search.planner import plan_search
 
@@ -308,7 +381,30 @@ class CheckServer:
             inner, plan_why = host_fallback(spec), list(plan.why)
         engine = FailoverBackend(spec, inner)
         oracle = WingGongCPU(memo=True)
-        return _EngineEntry(spec, engine, oracle, plan_why)
+        proj = proj_group = None
+        if self.pcomp_enabled:
+            from ..core.spec import projection_report
+
+            problems = projection_report(spec)
+            if not problems:
+                p = spec.projected_spec()
+                if p.name in MODELS:
+                    # dispatchable: sub-lanes rebuild this spec from its
+                    # registry name in the supervisor AND in every pool
+                    # worker, so split traffic rides the pool unchanged
+                    proj = p
+                    proj_group = self._spec_key(p.name, p.spec_kwargs())
+                else:
+                    plan_why.append(
+                        f"pcomp=off (projected spec {p.name!r} is not a "
+                        "registry model; sub-lanes would be "
+                        "undispatchable)")
+            else:
+                # the refusal path, stamped: an invalid projection must
+                # never split silently — the whole-history plan serves
+                plan_why.append(f"pcomp=off (refused: {problems[0]})")
+        return _EngineEntry(spec, engine, oracle, plan_why,
+                            proj=proj, proj_group_key=proj_group)
 
     # -- accept / connection plumbing ----------------------------------
     def _accept_loop(self) -> None:
@@ -459,17 +555,43 @@ class CheckServer:
             elif want_witness:
                 # ONE host-oracle search serves verdict AND witness
                 # (the replay/check CLI rule); bounded by the request
-                # deadline between items
+                # deadline between items.  A history whose split pays
+                # (smaller buckets) takes the DECOMPOSED witness path:
+                # per-key searches + a stitched whole-history witness
+                # that verify_witness replays identically (ops/pcomp.py)
                 if time.monotonic() >= deadline:
                     pending.dead = True
                     self.admission.shed_late()
                     self._release_unsubmitted(pending, release_lane)
                     send_doc(conn, self._shed(req, "deadline"))
                     return
-                v, w = entry.oracle.check_witness(entry.spec, h)
+                if self._split_pays(entry, h):
+                    with self._pcomp_lock:
+                        if entry.pcomp is None:
+                            from ..ops.pcomp import PComp
+
+                            entry.pcomp = PComp(entry.spec)
+                    before = entry.pcomp.subs_produced
+                    v, w = entry.pcomp.check_witness(entry.spec, h)
+                    with self._pcomp_lock:
+                        self.pcomp_split += 1
+                        # witness traffic's sub-histories count too, or
+                        # stats() would claim histories split into zero
+                        # sub-lanes
+                        self.pcomp_subs += (entry.pcomp.subs_produced
+                                            - before)
+                else:
+                    v, w = entry.oracle.check_witness(entry.spec, h)
                 self.cache.put(key, int(v), w)
                 pending.resolve(i, int(v), witness=w)
                 release_lane(i)
+            elif self._split_pays(entry, h):
+                if not self._submit_split(entry, h, key, pending, i,
+                                          deadline, release_lane):
+                    pending.dead = True
+                    self._release_unsubmitted(pending, release_lane)
+                    send_doc(conn, self._shed(req, "batcher full"))
+                    return
             else:
                 lane = Lane(key=key, history=h, deadline=deadline,
                             resolve=self._lane_resolver(pending, i,
@@ -509,6 +631,78 @@ class CheckServer:
                 [list(p) for p in w] if w is not None else None
                 for w in pending.witnesses]
         send_doc(conn, doc)
+
+    # -- P-compositional split lanes (ops/pcomp.py) --------------------
+    def _split_pays(self, entry: _EngineEntry, h: History) -> bool:
+        """Decompose iff the spec's projection validated at engine build
+        AND this history's per-key sub-histories land in a strictly
+        smaller compile bucket (the planner's gate, per history)."""
+        if entry.proj is None:
+            return False
+        from ..ops.pcomp import split_gain
+
+        try:
+            return split_gain(entry.spec, h)
+        except ValueError:
+            return False  # runtime non-totality: refuse, never split
+
+    def _submit_split(self, entry: _EngineEntry, h: History,
+                      whole_key: str, pending: _PendingRequest, i: int,
+                      deadline: float, release_lane) -> bool:
+        """Fan one request history out as per-key sub-lanes riding the
+        PROJECTED spec's micro-batch group; verdicts recombine through a
+        :class:`_SubJoin` whose completion banks the whole-history key
+        and resolves lane ``i``.  Each sub-history has its own cache row
+        (fingerprint under the projected spec), so a later history that
+        changes one key re-checks that key only.  False = batcher full
+        (the caller sheds; in-flight sub-lanes drain into the join,
+        which still completes and releases the admission slot)."""
+        from ..ops.pcomp import split_history
+
+        subs = split_history(entry.spec, h)
+        if not subs:
+            # empty history: vacuously linearizable (the gate already
+            # refuses these, but a zero-lane join would never complete)
+            self.cache.put(whole_key, int(Verdict.LINEARIZABLE))
+            pending.resolve(i, int(Verdict.LINEARIZABLE))
+            release_lane(i)
+            return True
+        with self._pcomp_lock:
+            self.pcomp_split += 1
+            self.pcomp_subs += len(subs)
+
+        def finish(worst: int, batch: Optional[dict]) -> None:
+            if worst in (int(Verdict.VIOLATION),
+                         int(Verdict.LINEARIZABLE)):
+                # the combined verdict banks under the WHOLE history's
+                # key too: exact duplicates stay O(1) hits
+                self.cache.put(whole_key, worst)
+            pending.resolve(i, worst, batch=batch)
+            release_lane(i)
+
+        join = _SubJoin(len(subs), finish)
+        # the join owns the slot release from here on — including the
+        # shed path, where aborted sub-lanes feed BUDGET_EXCEEDED
+        pending.lane_submitted[i] = True
+        dispatched = 0
+        # sorted: deterministic sub-lane order (cache/bench replayability)
+        for key in sorted(subs):
+            sub_h = subs[key]
+            skey = fingerprint_key(entry.proj, sub_h)
+            e = self.cache.get(skey)
+            if e is not None:
+                with self._pcomp_lock:
+                    self.pcomp_sub_hits += 1
+                dispatched += 1
+                join.feed(e.verdict)
+                continue
+            lane = Lane(key=skey, history=sub_h, deadline=deadline,
+                        resolve=join.resolver(), pcomp=True)
+            if not self.batcher.submit(entry.proj_group_key, lane):
+                join.abort(len(subs) - dispatched)
+                return False
+            dispatched += 1
+        return True
 
     @staticmethod
     def _lane_resolver(pending: _PendingRequest, i: int, release_lane):
@@ -639,6 +833,13 @@ class CheckServer:
         return verdicts, why
 
     # -- observability -------------------------------------------------
+    def _pcomp_snapshot(self) -> dict:
+        with self._pcomp_lock:
+            return {"enabled": self.pcomp_enabled,
+                    "split": self.pcomp_split,
+                    "sub_lanes": self.pcomp_subs,
+                    "sub_cache_hits": self.pcomp_sub_hits}
+
     def stats(self) -> dict:
         """The aggregate the ``stats`` op (and ``qsm-tpu stats --serve``)
         returns: every counter a capacity decision needs, self-describing
@@ -660,6 +861,10 @@ class CheckServer:
             "requests": self.requests,
             "histories": self.histories,
             "serve_faults": self.serve_faults,
+            # split-plane accounting: how much traffic decomposed, how
+            # many sub-lanes it became, and how many of those the
+            # per-sub-history cache rows answered without re-checking
+            "pcomp": self._pcomp_snapshot(),
             "worker_faults": (self.pool.worker_faults
                               if self.pool is not None else 0),
             "budget_resolved": self.budget_resolved,

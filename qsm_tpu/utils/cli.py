@@ -460,7 +460,8 @@ def cmd_serve(args) -> int:
         engine=args.engine, max_lanes=args.max_lanes,
         flush_s=args.flush_ms / 1000.0, queue_depth=args.queue_depth,
         cache_path=args.cache, cache_entries=args.cache_entries,
-        workers=args.workers, quarantine_after=args.quarantine_after)
+        workers=args.workers, quarantine_after=args.quarantine_after,
+        pcomp=not args.no_pcomp)
     warm = [m.strip() for m in args.warm.split(",")] if args.warm else []
     warm = [m for m in warm if m]
     unknown = sorted(set(warm) - set(MODELS))
@@ -557,7 +558,9 @@ def cmd_stats(args) -> int:
         spec, (entry.impls["atomic"], entry.impls["racy"]),
         n=args.corpus, n_pids=args.pids or entry.default_pids,
         max_ops=args.ops or entry.default_ops, seed_prefix="stats")
-    profile = profile_corpus(hists)
+    # spec included: the profile then measures the per-key decomposition
+    # shape too, and the printed plan says whether (and why) it splits
+    profile = profile_corpus(hists, spec)
     plan = plan_search(spec, profile,
                        platform=None if args.planned else "cpu")
     if args.planned:
@@ -1132,6 +1135,11 @@ def main(argv=None) -> int:
     p.add_argument("--cache-entries", type=int, default=4096)
     p.add_argument("--warm", default=None,
                    help="comma list of models to pre-build engines for")
+    p.add_argument("--no-pcomp", action="store_true",
+                   help="disable P-compositional request splitting: "
+                        "long histories of decomposable specs check "
+                        "whole instead of as per-key sub-lanes "
+                        "(docs/PCOMP.md)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(

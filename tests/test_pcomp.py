@@ -1,0 +1,373 @@
+"""P-compositional check plane (ISSUE 9): declared projections validate
+at compile time, the planner decomposes exactly when the split buys
+smaller buckets (and REFUSES with provenance when it must), decomposed
+verdicts are bit-identical to the undecomposed host ladder across
+engines and spec families, and every decomposed LINEARIZABLE history
+carries a stitched whole-history witness that ``verify_witness`` replays
+search-free (docs/PCOMP.md)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from qsm_tpu import Verdict, WingGongCPU
+from qsm_tpu.core.spec import CmdSig, KeyProj, projection_report
+from qsm_tpu.models import (AtomicKvSUT, AtomicMultiCasSUT,
+                            AtomicMultiRegisterSUT, KvSpec, MultiCasSpec,
+                            MultiRegisterSpec, RacyMultiCasSUT,
+                            ShardedStaleMultiRegisterSUT, StaleCacheKvSUT)
+from qsm_tpu.ops.backend import verify_witness
+from qsm_tpu.ops.pcomp import (NotDecomposableError, PComp, split_gain,
+                               split_history, stitch_witness)
+from qsm_tpu.utils.corpus import build_corpus
+
+# a corpus with real per-cell races: 2 cells concentrate conflicting ops
+FAMILIES = (
+    (MultiRegisterSpec(n_cells=2, n_values=4),
+     (AtomicMultiRegisterSUT, ShardedStaleMultiRegisterSUT)),
+    (MultiCasSpec(n_cells=2, n_values=4),
+     (AtomicMultiCasSUT, RacyMultiCasSUT)),
+    (KvSpec(n_keys=2, n_values=4),
+     (AtomicKvSUT, StaleCacheKvSUT)),
+)
+
+
+def _corpus(spec, suts, n=24, pids=8, ops=28, seed_base=0):
+    return build_corpus(spec, suts, n=n, n_pids=pids, max_ops=ops,
+                        seed_base=seed_base, seed_prefix=f"pc_{spec.name}")
+
+
+# ---------------------------------------------------------------------------
+# compile-time projection validation
+# ---------------------------------------------------------------------------
+
+def test_projection_report_clean_for_declared_families():
+    for spec, _ in FAMILIES:
+        assert projection_report(spec) == [], spec.name
+
+
+def test_projection_report_refuses_undeclared_spec():
+    from qsm_tpu.models import CasSpec
+
+    report = projection_report(CasSpec())
+    assert report and "no per-key projection" in report[0]
+
+
+def test_non_total_partition_refused_everywhere():
+    """The refusal path: a spec whose partition_key is not total must
+    never decompose — not in PComp, not in the planner, not silently."""
+    from qsm_tpu.analysis.fixtures import NonTotalPartitionKvSpec
+
+    spec = NonTotalPartitionKvSpec()
+    report = projection_report(spec)
+    assert report and "not total" in report[0]
+    with pytest.raises(NotDecomposableError, match="decomposable"):
+        PComp(spec)
+
+
+def test_unfaithful_projection_refused():
+    from qsm_tpu.analysis.fixtures import UnfaithfulProjectionKvSpec
+
+    assert projection_report(UnfaithfulProjectionKvSpec())
+    with pytest.raises(NotDecomposableError):
+        PComp(UnfaithfulProjectionKvSpec())
+
+
+def test_sanctioned_projection_twin_stays_clean():
+    from qsm_tpu.analysis.fixtures import SanctionedProjectionKvSpec
+    from qsm_tpu.analysis.spec_passes import check_projection
+
+    assert check_projection(SanctionedProjectionKvSpec(), "twin") == []
+
+
+def test_lint_pass_flags_seeded_projection_bugs():
+    from qsm_tpu.analysis.fixtures import (NonTotalPartitionKvSpec,
+                                           UnfaithfulProjectionKvSpec)
+    from qsm_tpu.analysis.spec_passes import check_projection
+
+    for cls in (NonTotalPartitionKvSpec, UnfaithfulProjectionKvSpec):
+        findings = check_projection(cls(), f"fixture:{cls.__name__}")
+        assert findings, cls.__name__
+        assert all(f.rule_id == "QSM-SPEC-PCOMP" for f in findings)
+
+
+def test_faithfulness_catches_resp_domain_mismatch():
+    """A projected command with a different response domain would let a
+    pending completion replay out-of-domain — the validator pins it."""
+
+    class BadRespKv(KvSpec):
+        name = "bad_resp_kv"
+
+        def __init__(self):
+            super().__init__(n_keys=2, n_values=4)
+            get, put = self.CMDS
+            # GET declares 2 resps while the projected READ has 4
+            self.CMDS = (dataclasses.replace(get, n_resps=2), put)
+
+    assert any("response domain" in p for p in projection_report(BadRespKv()))
+
+
+def test_keyproj_partition_key_override_consistency():
+    """A hand-written partition_key that disagrees with the declared
+    KeyProj splits one way and projects another — refused."""
+
+    class SkewedKv(KvSpec):
+        name = "skewed_kv"
+
+        def partition_key(self, cmd, arg):
+            k = super().partition_key(cmd, arg)
+            return (k + 1) % self.n_keys if k is not None else None
+
+    assert any("KeyProj derives" in p for p in projection_report(SkewedKv()))
+
+
+# ---------------------------------------------------------------------------
+# decomposed parity across engines and spec families
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec,suts", FAMILIES,
+                         ids=[s.name for s, _ in FAMILIES])
+def test_decomposed_bit_identical_to_host_ladder(spec, suts):
+    """Decomposed verdicts == undecomposed host-ladder verdicts, across
+    the memo oracle AND the native ladder as inner engines (the ISSUE 9
+    parity pin for the second spec family)."""
+    from qsm_tpu.resilience.failover import host_fallback
+
+    hists = _corpus(spec, suts)
+    direct = WingGongCPU(memo=True).check_histories(spec, hists)
+    assert (direct == Verdict.VIOLATION).any(), "sample vacuous: no fails"
+    for make_inner in (None, host_fallback):
+        pc = PComp(spec) if make_inner is None \
+            else PComp(spec, make_inner=make_inner)
+        got = pc.check_histories(spec, hists)
+        assert (np.asarray(got) == np.asarray(direct)).all(), pc.name
+
+
+@pytest.mark.parametrize("spec,suts", FAMILIES,
+                         ids=[s.name for s, _ in FAMILIES])
+def test_stitched_witness_roundtrip(spec, suts):
+    """Per family: every decomposed LINEARIZABLE history yields a
+    stitched witness verify_witness accepts; violations yield None and
+    match the direct oracle's verdict."""
+    hists = _corpus(spec, suts, n=16)
+    pc = PComp(spec)
+    direct = WingGongCPU(memo=True).check_histories(spec, hists)
+    n_ok = 0
+    for h, want in zip(hists, direct):
+        v, w = pc.check_witness(spec, h)
+        assert int(v) == int(want)
+        if v == Verdict.LINEARIZABLE:
+            assert w is not None and verify_witness(spec, h, w)
+            n_ok += 1
+        else:
+            assert w is None
+    assert n_ok, "witness sample vacuous"
+    st = pc.search_stats()
+    assert st.pcomp_subs >= len(hists)
+    assert st.pcomp_max_sub > 0
+
+
+def test_stitched_witness_with_pending_ops():
+    """Pending ops: completed-by-the-witness per key or pruned entirely —
+    the stitched whole witness must replay either way."""
+    from qsm_tpu.core.history import NO_RESP, History, Op
+    from qsm_tpu.models.kv import GET, PUT
+
+    spec = KvSpec(n_keys=2, n_values=4)
+    big = 1 << 30  # pending sentinel response time
+    h = History([
+        Op(pid=0, cmd=PUT, arg=spec.put_arg(0, 3), resp=0,
+           invoke_time=0, response_time=1),
+        # pending PUT on key 1: the per-key witness may complete or
+        # prune it — either way the stitched whole witness must replay
+        Op(pid=1, cmd=PUT, arg=spec.put_arg(1, 2), resp=NO_RESP,
+           invoke_time=2, response_time=big),
+        Op(pid=0, cmd=GET, arg=0, resp=3, invoke_time=3,
+           response_time=4),
+        Op(pid=2, cmd=GET, arg=1, resp=2, invoke_time=5,
+           response_time=6),
+    ])
+    assert h.n_pending == 1
+    v, w = PComp(spec).check_witness(spec, h)
+    assert v == Verdict.LINEARIZABLE
+    # GET(1) == 2 is only explainable by the pending PUT taking effect,
+    # so the witness must have completed it
+    assert any(j == 1 for j, _ in w)
+    assert verify_witness(spec, h, w)
+    # and a pruned variant: the pending PUT never observed — witness may
+    # omit it and must still replay
+    h2 = History(h.ops[:1] + h.ops[1:2]
+                 + [Op(pid=2, cmd=GET, arg=1, resp=0, invoke_time=5,
+                       response_time=6)])
+    v2, w2 = PComp(spec).check_witness(spec, h2)
+    assert v2 == Verdict.LINEARIZABLE
+    assert verify_witness(spec, h2, w2)
+
+
+def test_stitch_witness_is_deterministic_and_ordered():
+    from qsm_tpu.core.history import sequential_history
+    from qsm_tpu.models.kv import GET, PUT
+
+    spec = KvSpec(n_keys=2, n_values=4)
+    h = sequential_history([
+        (0, PUT, spec.put_arg(0, 3), 0),
+        (1, PUT, spec.put_arg(1, 2), 0),
+        (0, GET, 0, 3),
+        (1, GET, 1, 2),
+    ])
+    v, w = PComp(spec).check_witness(spec, h)
+    assert v == Verdict.LINEARIZABLE
+    # a sequential history admits exactly its own order
+    assert [j for j, _ in w] == [0, 1, 2, 3]
+    assert verify_witness(spec, h, w)
+
+
+def test_stitch_witness_refuses_cycles():
+    from qsm_tpu.core.history import sequential_history
+
+    h = sequential_history([(0, 0, 0, 0), (0, 1, 1, 0)])
+    # op 0 precedes op 1 in real time, but a (corrupt) chain claims the
+    # reverse — the stitcher must refuse, never emit a false certificate
+    with pytest.raises(RuntimeError, match="cycle"):
+        stitch_witness(h, [[(1, 0), (0, 0)]])
+
+
+# ---------------------------------------------------------------------------
+# the planner gate
+# ---------------------------------------------------------------------------
+
+def test_planner_decomposes_long_kv_with_provenance():
+    from qsm_tpu.search.planner import plan_search, profile_corpus
+
+    spec = KvSpec(n_keys=8, n_values=4)
+    hists = build_corpus(spec, (AtomicKvSUT, StaleCacheKvSUT), n=6,
+                         n_pids=16, max_ops=96, seed_base=3,
+                         seed_prefix="plan")
+    profile = profile_corpus(hists, spec)
+    assert profile.sub_max_ops > 0
+    plan = plan_search(spec, profile, platform="cpu")
+    assert plan.decompose_keys
+    assert any("decompose_keys=on" in w for w in plan.why)
+
+
+def test_planner_refuses_short_histories_and_stamps_why():
+    """Equal buckets = the split only adds lanes: gate off, why says so."""
+    from qsm_tpu.search.planner import plan_search, profile_corpus
+
+    spec = KvSpec(n_keys=2, n_values=4)
+    hists = build_corpus(spec, (AtomicKvSUT, StaleCacheKvSUT), n=6,
+                         n_pids=4, max_ops=10, seed_base=3,
+                         seed_prefix="short")
+    plan = plan_search(spec, profile_corpus(hists, spec), platform="cpu")
+    assert not plan.decompose_keys
+    assert any("decompose_keys=off" in w for w in plan.why)
+
+
+def test_planner_refusal_why_for_invalid_projection():
+    from qsm_tpu.analysis.fixtures import NonTotalPartitionKvSpec
+    from qsm_tpu.search.planner import plan_search, profile_corpus
+
+    spec = NonTotalPartitionKvSpec()
+    hists = build_corpus(spec, (AtomicKvSUT, StaleCacheKvSUT), n=4,
+                         n_pids=8, max_ops=48, seed_base=3,
+                         seed_prefix="nt")
+    plan = plan_search(spec, profile_corpus(hists, spec), platform="cpu")
+    assert not plan.decompose_keys
+    assert any("decompose_keys=off (refused" in w for w in plan.why)
+
+
+def test_build_backend_wraps_pcomp_outermost_with_parity():
+    from qsm_tpu.search.planner import (build_backend, plan_search,
+                                        profile_corpus)
+
+    spec = KvSpec(n_keys=4, n_values=4)
+    hists = build_corpus(spec, (AtomicKvSUT, StaleCacheKvSUT), n=8,
+                         n_pids=8, max_ops=48, seed_base=11,
+                         seed_prefix="bb")
+    plan = plan_search(spec, profile_corpus(hists, spec), platform="cpu")
+    assert plan.decompose_keys
+    backend = build_backend(spec, plan, budget=100_000)
+    assert isinstance(backend, PComp)
+    direct = WingGongCPU(memo=True).check_histories(spec, hists)
+    got = np.asarray(backend.check_histories(spec, hists))
+    und = got == int(Verdict.BUDGET_EXCEEDED)
+    # the device kernel may defer under budget; resolved like the
+    # property layer does, verdicts must be bit-identical
+    assert (np.where(und, direct, got) == direct).all()
+
+
+def test_split_gain_gate():
+    spec = KvSpec(n_keys=8, n_values=4)
+    hists = build_corpus(spec, (AtomicKvSUT,), n=1, n_pids=16,
+                         max_ops=96, seed_base=1, seed_prefix="g")
+    assert split_gain(spec, hists[0])
+    short = build_corpus(spec, (AtomicKvSUT,), n=1, n_pids=2,
+                         max_ops=6, seed_base=1, seed_prefix="g2")
+    assert not split_gain(spec, short[0])
+
+
+# ---------------------------------------------------------------------------
+# pcomp_* accounting
+# ---------------------------------------------------------------------------
+
+def test_pcomp_counters_ride_stats_and_timings():
+    spec = KvSpec(n_keys=4, n_values=4)
+    hists = _corpus(spec, (AtomicKvSUT, StaleCacheKvSUT), n=8, pids=8,
+                    ops=24)
+    pc = PComp(spec)
+    pc.check_histories(spec, hists)
+    st = pc.search_stats()
+    assert st.pcomp_split > 0
+    assert st.pcomp_subs >= st.pcomp_split
+    assert st.pcomp_max_sub > 0
+    compact = st.to_compact()
+    assert compact["pcs"] == st.pcomp_split
+    assert compact["pcn"] == st.pcomp_subs
+    assert compact["pcm"] == st.pcomp_max_sub
+    t = st.to_timings()
+    assert t["pcomp_subs"] == float(st.pcomp_subs)
+    assert "pcomp_recombine_ms" in t
+
+
+def test_stats_absorb_max_merges_pcomp_max_sub():
+    from qsm_tpu.search.stats import SearchStats, stats_delta
+
+    a = SearchStats(pcomp_split=1, pcomp_subs=4, pcomp_max_sub=9)
+    b = SearchStats(pcomp_split=2, pcomp_subs=3, pcomp_max_sub=17)
+    a.absorb(b)
+    assert (a.pcomp_split, a.pcomp_subs, a.pcomp_max_sub) == (3, 7, 17)
+    # delta keeps `after`'s maximum (a max has no per-run difference)
+    d = stats_delta(SearchStats(pcomp_subs=10, pcomp_max_sub=33),
+                    SearchStats(pcomp_subs=4, pcomp_max_sub=33))
+    assert d.pcomp_subs == 6 and d.pcomp_max_sub == 33
+
+
+def test_property_run_carries_pcomp_timings():
+    from qsm_tpu import PropertyConfig, prop_concurrent
+
+    spec = KvSpec(n_keys=4, n_values=4)
+    cfg = PropertyConfig(n_trials=8, n_pids=8, max_ops=24, seed=5)
+    res = prop_concurrent(spec, AtomicKvSUT(spec), cfg,
+                          backend=PComp(spec), oracle=WingGongCPU())
+    assert res.ok, res.counterexample
+    assert res.timings.get("pcomp_subs", 0) > 0
+
+
+def test_declarative_kv_matches_legacy_split():
+    """The KeyProj-derived split must produce exactly the sub-histories
+    the old hand-written partition_key/project_op produced."""
+    spec = KvSpec(n_keys=4, n_values=4)
+    hists = _corpus(spec, (AtomicKvSUT, StaleCacheKvSUT), n=6, pids=8,
+                    ops=24)
+    for h in hists:
+        subs = split_history(spec, h)
+        for key, sub in subs.items():
+            for op in sub.ops:
+                assert 0 <= key < spec.n_keys
+                # projected ops are register ops: READ arg 0 / WRITE v
+                assert op.cmd in (0, 1)
+                if op.cmd == 0:
+                    assert op.arg == 0
+                else:
+                    assert 0 <= op.arg < spec.n_values

@@ -27,7 +27,8 @@ def test_registry_covers_all_five_configs():
     # the five milestone configs (BASELINE.json:7-11) + extra families
     assert {"register", "ticket", "cas", "queue", "kv"} <= set(MODELS)
     assert set(MODELS) == {"register", "ticket", "cas", "queue", "kv",
-                           "set", "stack", "failover"}
+                           "set", "stack", "failover",
+                           "multireg", "multicas"}
     for name, entry in MODELS.items():
         spec, sut = make(name, "racy")
         assert hasattr(sut, "perform")

@@ -1,0 +1,398 @@
+"""P-compositionality bench — long-history kv corpora, decomposed vs whole.
+
+Search cost is exponential in history length, so the repo's corpora
+stalled at 64 ops: a 256-op kv history fits no native 64-bit taken mask
+and no useful memo budget whole, while its per-key sub-histories are
+16 short register histories.  Round 9 (ISSUE 9) wires the per-key split
+(ops/pcomp.py, Horn & Kroening PAPERS.md:5) end-to-end; this tool prices
+it on the CPU platform — no window required — at 64/256/1024 ops:
+
+* ``decomp_{ops}`` — ``PComp`` over the host cpp→memo ladder (the serve
+  plane's ``auto`` shape): one planned batch of ALL per-key
+  sub-histories.  EVERY verdict is independently verified: LINEARIZABLE
+  must yield a stitched whole-history witness that ``verify_witness``
+  replays (the decomposed path's certificate), VIOLATION must be
+  re-found by a FRESH memo oracle on at least one per-key sub-history.
+* ``whole_{ops}`` — the undecomposed host ladder (native C++ when the
+  toolchain is present, bounded memo oracle past its 64-op mask),
+  per-history under a node budget and a per-cell time box: the honest
+  "what this cost before" denominator.  Histories the box cuts are
+  ``unattempted`` (never silently skipped), so the per-history cost is
+  a LOWER bound and every ratio derived from it is conservative.
+* ``serve_pool`` — split lanes riding the WORKER POOL: a 2-worker
+  ``CheckServer`` decomposes kv-256 requests into register sub-lanes,
+  micro-batches them across 2 clients, banks per-sub-history cache
+  rows, and a one-key change to a checked history re-checks exactly
+  one key.  Verdict names are pinned to the direct decomposed run.
+
+Win condition (ISSUE 9 acceptance): kv-256 decomposed ≥10× the whole
+path on wall-clock AND search nodes/history, kv-1024 fully decided by
+the decomposed path (the whole path cannot), zero wrong verdicts, and
+every decomposed LINEARIZABLE history carrying a verified stitched
+witness.  Output: a resumable ``CellJournal`` (``--resume`` re-runs
+zero completed cells) committed as ``BENCH_PCOMP_<tag>.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_KEYS = 16
+N_VALUES = 4
+N_PIDS = 16
+# (ops, corpus size, whole-path node budget): the budget shrinks with
+# length because the whole path's per-node cost grows with the taken
+# tuple — the box, not the budget, is the real bound past 256 ops
+SIZES = ((64, 24, 20_000_000), (256, 16, 1_000_000), (1024, 8, 200_000))
+TIME_BOX_S = 150.0      # per whole_{ops} cell
+SERVE_OPS = 256
+SERVE_CLIENTS = 2
+SERVE_WORKERS = 2
+SERVE_DEADLINE_S = 300.0
+
+
+def _spec():
+    from qsm_tpu.models import KvSpec
+
+    return KvSpec(n_keys=N_KEYS, n_values=N_VALUES)
+
+
+def _corpus(spec, n_ops: int, n: int):
+    from qsm_tpu.models import AtomicKvSUT, StaleCacheKvSUT
+    from qsm_tpu.utils.corpus import build_corpus
+
+    return build_corpus(
+        spec, (AtomicKvSUT, StaleCacheKvSUT), n=n, n_pids=N_PIDS,
+        max_ops=n_ops, seed_base=n_ops * 1000,
+        seed_prefix=f"bench_pcomp_{n_ops}")
+
+
+def _host_ladder(spec, node_budget: int):
+    """The undecomposed host path exactly as shipped (cpp→memo), with
+    an explicit node budget so 256/1024-op cells terminate honestly
+    (BUDGET_EXCEEDED, never a guess)."""
+    from qsm_tpu.native import CppOracle, native_available
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+
+    if native_available():
+        return CppOracle(spec, node_budget=node_budget)
+    return WingGongCPU(memo=True, node_budget=node_budget)
+
+
+def bench_decomposed(spec, corpus, n_ops: int) -> dict:
+    """One planned decomposed batch + independent verification of every
+    verdict (module docstring)."""
+    from qsm_tpu.ops.backend import Verdict, verify_witness
+    from qsm_tpu.ops.pcomp import PComp, split_history
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+    from qsm_tpu.resilience.failover import host_fallback
+    from qsm_tpu.search.planner import plan_search, profile_corpus
+
+    profile = profile_corpus(corpus, spec)
+    plan = plan_search(spec, profile, platform="cpu")
+    pc = PComp(spec, make_inner=host_fallback)
+    t0 = time.perf_counter()
+    verdicts = np.asarray(pc.check_histories(spec, corpus))
+    wall = time.perf_counter() - t0
+    st = pc.search_stats()
+
+    # -- verification (outside the timed region: it is audit, not cost)
+    wrong = 0
+    witnesses_verified = 0
+    violations_reconfirmed = 0
+    t_verify = time.perf_counter()
+    for h, v in zip(corpus, verdicts):
+        if v == int(Verdict.LINEARIZABLE):
+            wv, w = pc.check_witness(spec, h)
+            if (wv != Verdict.LINEARIZABLE or w is None
+                    or not verify_witness(spec, h, w)):
+                wrong += 1
+            else:
+                witnesses_verified += 1
+        elif v == int(Verdict.VIOLATION):
+            # a fresh, memo-only oracle must re-find the violation in
+            # some per-key sub-history — independent of the ladder that
+            # produced the verdict
+            subs = list(split_history(spec, h).values())
+            fresh = WingGongCPU(memo=True)
+            sub_v = fresh.check_histories(spec.projected_spec(), subs)
+            if int((np.asarray(sub_v) == int(Verdict.VIOLATION)).sum()):
+                violations_reconfirmed += 1
+            else:
+                wrong += 1
+    verify_s = time.perf_counter() - t_verify
+    n = len(corpus)
+    return {
+        "engine": pc.name,
+        "ops": n_ops, "histories": n,
+        "seconds": round(wall, 3),
+        "seconds_per_history": round(wall / n, 4),
+        "histories_per_sec": round(n / wall, 1),
+        "undecided": int((verdicts == int(Verdict.BUDGET_EXCEEDED)).sum()),
+        "violations": int((verdicts == int(Verdict.VIOLATION)).sum()),
+        "nodes_per_history": round(st.nodes_per_history, 1),
+        "wrong_verdicts": wrong,
+        "witnesses_verified": witnesses_verified,
+        "violations_reconfirmed": violations_reconfirmed,
+        "verify_seconds": round(verify_s, 3),
+        "plan": plan.describe(),
+        "search": st.to_compact(),
+    }
+
+
+def bench_whole(spec, corpus, n_ops: int, node_budget: int) -> dict:
+    """The undecomposed denominator: history by history so the time box
+    can cut between histories — a cut history is ``unattempted``, never
+    half-measured."""
+    from qsm_tpu.ops.backend import Verdict
+    from qsm_tpu.search.stats import collect_search_stats
+
+    ladder = _host_ladder(spec, node_budget)
+    verdicts = []
+    t0 = time.perf_counter()
+    attempted = 0
+    for h in corpus:
+        if time.perf_counter() - t0 > TIME_BOX_S:
+            break
+        verdicts.append(int(ladder.check_histories(spec, [h])[0]))
+        attempted += 1
+    wall = time.perf_counter() - t0
+    st = collect_search_stats(ladder)
+    v = np.asarray(verdicts)
+    row = {
+        "engine": getattr(ladder, "name", type(ladder).__name__),
+        "ops": n_ops, "histories": len(corpus),
+        "attempted": attempted,
+        "unattempted": len(corpus) - attempted,
+        "node_budget": node_budget,
+        "time_box_s": TIME_BOX_S,
+        "seconds": round(wall, 3),
+        "undecided": int((v == int(Verdict.BUDGET_EXCEEDED)).sum()),
+        "violations": int((v == int(Verdict.VIOLATION)).sum()),
+        "nodes_per_history": (round(st.nodes_explored / attempted, 1)
+                              if st is not None and attempted else None),
+    }
+    if attempted:
+        row["seconds_per_history"] = round(wall / attempted, 4)
+        row["histories_per_sec"] = round(attempted / wall, 1)
+        if len(corpus) - attempted or row["undecided"]:
+            row["note"] = ("time-boxed/budgeted: per-history cost is a "
+                           "LOWER bound, ratios derived from it are "
+                           "conservative")
+    return row
+
+
+def _one_key_variant(spec, h):
+    """A copy of ``h`` with ONE op's value changed on its own key — the
+    sub-cache demo input (every other key's sub-history fingerprint is
+    unchanged)."""
+    import dataclasses
+
+    from qsm_tpu.core.history import History
+    from qsm_tpu.models.kv import PUT
+
+    ops = list(h.ops)
+    for j, op in enumerate(ops):
+        if op.cmd == PUT:
+            ops[j] = dataclasses.replace(
+                op, arg=(op.arg - op.arg % N_VALUES)
+                + ((op.arg % N_VALUES) + 1) % N_VALUES)
+            break
+    return History(ops)
+
+
+def bench_serve_pool(spec, corpus, expected_names) -> dict:
+    """Split lanes riding the worker pool (module docstring)."""
+    import tempfile
+
+    from qsm_tpu.serve.client import CheckClient
+    from qsm_tpu.serve.server import CheckServer
+
+    kw = {"n_keys": N_KEYS, "n_values": N_VALUES}
+    tmp = tempfile.mkdtemp(prefix="qsm_bench_pcomp_")
+    srv = CheckServer(unix_path=os.path.join(tmp, "sock"),
+                      workers=SERVE_WORKERS,
+                      cache_path=os.path.join(tmp, "bank.jsonl")).start()
+    try:
+        halves = [corpus[::2], corpus[1::2]]
+        results: list = [None] * SERVE_CLIENTS
+        t0 = time.perf_counter()
+
+        def client(ci: int) -> None:
+            c = CheckClient(srv.address, timeout_s=SERVE_DEADLINE_S + 30)
+            try:
+                results[ci] = c.check("kv", halves[ci], spec_kwargs=kw,
+                                      deadline_s=SERVE_DEADLINE_S)
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(SERVE_CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        served = {0: results[0]["verdicts"], 1: results[1]["verdicts"]}
+        want = {0: [expected_names[i] for i in range(0, len(corpus), 2)],
+                1: [expected_names[i] for i in range(1, len(corpus), 2)]}
+        wrong = sum(a != b for ci in (0, 1)
+                    for a, b in zip(served[ci], want[ci]))
+        # one-key change: only the touched key's sub-lane may re-check
+        c = CheckClient(srv.address, timeout_s=SERVE_DEADLINE_S + 30)
+        try:
+            st1 = c.stats()["stats"]
+            res3 = c.check("kv", [_one_key_variant(spec, corpus[0])],
+                           spec_kwargs=kw, deadline_s=SERVE_DEADLINE_S)
+            st2 = c.stats()["stats"]
+        finally:
+            c.close()
+        d_subs = (st2["pcomp"]["sub_lanes"] - st1["pcomp"]["sub_lanes"])
+        d_hits = (st2["pcomp"]["sub_cache_hits"]
+                  - st1["pcomp"]["sub_cache_hits"])
+        pool_rows = st2.get("pool") or {}
+        n = len(corpus)
+        return {
+            "workers": SERVE_WORKERS, "clients": SERVE_CLIENTS,
+            "ops": SERVE_OPS, "histories": n,
+            "seconds": round(wall, 3),
+            "histories_per_sec": round(n / wall, 1),
+            "wrong_verdicts": wrong + (0 if res3.get("ok") else 1),
+            "pcomp": st2["pcomp"],
+            "one_key_change": {
+                "sub_lanes": d_subs, "sub_cache_hits": d_hits,
+                "recheck_keys": d_subs - d_hits},
+            "pool": pool_rows,
+            "batches": results[0].get("batches"),
+        }
+    finally:
+        srv.stop()
+
+
+def run(tag: str, out_path: str | None, resume: bool) -> dict:
+    from qsm_tpu.resilience.checkpoint import CellJournal
+
+    spec = _spec()
+    path = out_path or os.path.join(REPO, f"BENCH_PCOMP_{tag}.json")
+    header = {
+        "artifact": "BENCH_PCOMP",
+        "device_fallback": None,   # host-only bench: no window involved
+        "platform": "cpu",
+        "model": "kv", "n_keys": N_KEYS, "n_values": N_VALUES,
+        "pids": N_PIDS,
+        "sizes": [s[0] for s in SIZES],
+        "captured_iso": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+    }
+    journal = CellJournal(path, header, resume=resume)
+    corpora = {}
+
+    def corpus_for(n_ops: int, n: int):
+        if n_ops not in corpora:
+            corpora[n_ops] = _corpus(spec, n_ops, n)
+        return corpora[n_ops]
+
+    for n_ops, n, budget in SIZES:
+        if journal.complete(f"decomp_{n_ops}") is None:
+            journal.emit(f"decomp_{n_ops}",
+                         bench_decomposed(spec, corpus_for(n_ops, n),
+                                          n_ops))
+        if journal.complete(f"whole_{n_ops}") is None:
+            journal.emit(f"whole_{n_ops}",
+                         bench_whole(spec, corpus_for(n_ops, n), n_ops,
+                                     budget))
+    if journal.complete("serve_pool") is None:
+        n = dict((s[0], s[1]) for s in SIZES)[SERVE_OPS]
+        corpus = corpus_for(SERVE_OPS, n)
+        dec = journal.complete(f"decomp_{SERVE_OPS}")
+        # the decomposed cell is the serve cell's verdict reference —
+        # recompute the names the same engine produced
+        from qsm_tpu.ops.pcomp import PComp
+        from qsm_tpu.resilience.failover import host_fallback
+        from qsm_tpu.serve.protocol import VERDICT_NAMES
+
+        ref = PComp(spec, make_inner=host_fallback).check_histories(
+            spec, corpus)
+        names = [VERDICT_NAMES[int(v)] for v in ref]
+        assert dec is not None
+        journal.emit("serve_pool", bench_serve_pool(spec, corpus, names))
+
+    d256 = journal.complete("decomp_256")
+    w256 = journal.complete("whole_256")
+    d1024 = journal.complete("decomp_1024")
+    w1024 = journal.complete("whole_1024")
+    serve = journal.complete("serve_pool")
+    wall_ratio = (w256["seconds_per_history"]
+                  / max(d256["seconds_per_history"], 1e-9)
+                  if w256.get("seconds_per_history") else None)
+    nodes_ratio = (w256["nodes_per_history"]
+                   / max(d256["nodes_per_history"], 1e-9)
+                   if w256.get("nodes_per_history") else None)
+    rows = [journal.complete(f"{kind}_{s[0]}")
+            for s in SIZES for kind in ("decomp", "whole")]
+    wrong_total = sum((r or {}).get("wrong_verdicts", 0) for r in rows) \
+        + serve.get("wrong_verdicts", 0)
+    summary = {
+        "metric": "kv256_decomposed_vs_whole",
+        "wall_ratio_256": round(wall_ratio, 1) if wall_ratio else None,
+        "nodes_ratio_256": round(nodes_ratio, 1) if nodes_ratio else None,
+        "gate_10x_wall": bool(wall_ratio and wall_ratio >= 10),
+        "gate_10x_nodes": bool(nodes_ratio and nodes_ratio >= 10),
+        "kv1024_decomposed_decided": (d1024["undecided"] == 0
+                                      and d1024["wrong_verdicts"] == 0),
+        "kv1024_whole_out_of_reach": bool(
+            w1024["unattempted"] or w1024["undecided"]),
+        "wrong_verdicts": wrong_total,
+        "witnesses_verified": sum((journal.complete(f"decomp_{s[0]}")
+                                   or {}).get("witnesses_verified", 0)
+                                  for s in SIZES),
+        "serve_pool_split_lanes": serve["pcomp"]["sub_lanes"],
+        "one_key_recheck_keys": serve["one_key_change"]["recheck_keys"],
+        "resumed_cells": journal.resumed_cells,
+    }
+    if journal.complete("summary") is None:
+        journal.emit("summary", summary)
+    print(json.dumps({"metric": summary["metric"],
+                      "wall_ratio_256": summary["wall_ratio_256"],
+                      "nodes_ratio_256": summary["nodes_ratio_256"],
+                      "kv1024_decided": summary[
+                          "kv1024_decomposed_decided"],
+                      "wrong_verdicts": wrong_total,
+                      "artifact": os.path.basename(path)}))
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tag", default="r09")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--resume", action="store_true",
+                    help="adopt completed cells from an existing "
+                         "artifact (CellJournal rails)")
+    args = ap.parse_args(argv)
+
+    from qsm_tpu.utils.device import force_cpu_platform
+
+    force_cpu_platform()
+    try:
+        run(args.tag, args.out, args.resume)
+    except Exception as e:  # noqa: BLE001 — diagnostic line, not a traceback
+        print(json.dumps({"metric": "kv256_decomposed_vs_whole",
+                          "error": f"{type(e).__name__}: {e}"}))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,7 +55,7 @@ def run(n_corpus: int, tag: str, out_path: str | None) -> dict:
     corpus = build_corpus(spec, (AtomicCasSUT, RacyCasSUT), n=n_corpus,
                           n_pids=N_PIDS, max_ops=N_OPS, seed_base=1000,
                           seed_prefix="bench")
-    profile = profile_corpus(corpus)
+    profile = profile_corpus(corpus, spec)
     plan = plan_search(spec, profile, platform="cpu")
 
     rows = []
