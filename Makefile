@@ -19,7 +19,13 @@ LINT_ARTIFACT ?= LINT_r07.json
 # oracle-verified, stitched witnesses replayed)
 PCOMP_ARTIFACT ?= BENCH_PCOMP_r09.json
 
-.PHONY: lint-gate lint-changed lint-sarif test bench-pcomp
+# Batched-shrink bench (tools/bench_shrink.py): host-only, CellJournal
+# --resume rails; refreshes the committed BENCH_SHRINK artifact
+# (frontier-at-once vs one-at-a-time on racy kv/cas 64-op failing
+# corpora: engine-call ratio, audited 1-minimality, serve-verb parity)
+SHRINK_ARTIFACT ?= BENCH_SHRINK_r10.json
+
+.PHONY: lint-gate lint-changed lint-sarif test bench-pcomp bench-shrink
 
 lint-gate:
 	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT)
@@ -34,6 +40,10 @@ lint-sarif:
 bench-pcomp:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_pcomp.py \
 		--out $(PCOMP_ARTIFACT) --resume
+
+bench-shrink:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_shrink.py \
+		--out $(SHRINK_ARTIFACT) --resume
 
 # the tier-1 quick lane (ROADMAP.md has the full pinned command)
 test:

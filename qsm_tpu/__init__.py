@@ -19,6 +19,10 @@ Layers (mirroring SURVEY.md §1, redesigned TPU-first):
 * ``qsm_tpu.serve``    — the serving plane: long-lived check server
   with warm engines, cross-request micro-batching, a persistent
   verdict cache and bounded admission (docs/SERVING.md)
+* ``qsm_tpu.shrink``   — the batched shrink plane: frontier-at-once
+  counterexample minimization to 1-minimal histories with
+  verify_witness-replayable certificates, served as the ``shrink``
+  verb (docs/SHRINK.md)
 * ``qsm_tpu.utils``    — config, structured logging, CLI
 """
 

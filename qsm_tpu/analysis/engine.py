@@ -89,8 +89,20 @@ DEFAULT_RACE_FILES = (
     # and the combinator/planner it rides — one closed program with the
     # rest of the serving stack
     "qsm_tpu/ops/pcomp.py", "qsm_tpu/search/planner.py",
+    # the shrink plane: the shrink verb runs the greedy loop on a
+    # connection thread while its candidate lanes resolve from
+    # dispatcher threads, and the shrink bank/counters are shared
+    # across connections — same closed program
+    "qsm_tpu/shrink/frontier.py", "qsm_tpu/shrink/shrinker.py",
     "tools/bench_serve.py", "tools/bench_pcomp.py",
+    "tools/bench_shrink.py",
     "tools/probe_watcher.py", "tools/soak_prune.py")
+
+# the shrink-plane modules the frontier-bound pass covers (family h):
+# the plane itself plus its bench driver
+DEFAULT_SHRINK_FILES = (
+    "qsm_tpu/shrink/frontier.py", "qsm_tpu/shrink/shrinker.py",
+    "tools/bench_shrink.py")
 
 
 def default_whitelist_path() -> str:
@@ -249,6 +261,12 @@ def _run_race(_ctx: _LintRun, files: List[str]) -> List[Finding]:
     return check_race_project(files, root=REPO_ROOT)
 
 
+def _per_file_shrink(path: str, root: str) -> List[Finding]:
+    from .shrink_passes import check_shrink_file
+
+    return check_shrink_file(path, root=root)
+
+
 FAMILIES: Dict[str, Family] = {f.fid: f for f in (
     Family(fid="a", key="spec",
            title="spec soundness (parity, domains, bounds, dtypes, "
@@ -257,8 +275,12 @@ FAMILIES: Dict[str, Family] = {f.fid: f for f in (
            triggers=("qsm_tpu/models/", "qsm_tpu/core/",
                      # projection consumers: a pcomp/planner change can
                      # shift what QSM-SPEC-PCOMP must hold, so --changed
-                     # runs re-validate the spec family too
+                     # runs re-validate the spec family too — and the
+                     # shrink plane's drop-key axis trusts the same
+                     # validated projection, so a shrink change
+                     # re-validates it as well
                      "qsm_tpu/ops/pcomp.py", "qsm_tpu/search/planner.py",
+                     "qsm_tpu/shrink/", "tools/bench_shrink.py",
                      "qsm_tpu/analysis/spec_passes.py",
                      "qsm_tpu/analysis/kernel_passes.py")),
     Family(fid="b", key="kernel",
@@ -295,6 +317,11 @@ FAMILIES: Dict[str, Family] = {f.fid: f for f in (
            files=DEFAULT_RACE_FILES, whole=_run_race,
            triggers=("qsm_tpu/analysis/callgraph.py",
                      "qsm_tpu/analysis/race_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+    Family(fid="h", key="shrink",
+           title="shrink-plane frontier bounds",
+           files=DEFAULT_SHRINK_FILES, per_file=_per_file_shrink,
+           triggers=("qsm_tpu/analysis/shrink_passes.py",
                      "qsm_tpu/analysis/astutil.py")),
 )}
 

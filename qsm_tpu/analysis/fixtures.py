@@ -402,6 +402,43 @@ class UnboundedServeAcceptStub:
             conn.close()
 
 
+class UnboundedFrontierStub:
+    """Seeded bug for the shrink passes (family h): a while-True loop
+    that grows the shrink frontier with no round or size cap
+    (QSM-SHRINK-UNBOUNDED — one shrink request becomes an unbounded CPU
+    burn on micro-batch lanes shared with paying traffic).  Never
+    executed; tests point the shrink AST pass at this file and assert
+    the rule fires exactly once."""
+
+    def frontier_forever(self, history):
+        out = []
+        while True:                      # <-- bug: no cap, no break
+            out.append(list(history))
+        return out
+
+
+class BoundedFrontierStub:
+    """The sanctioned twins the shrink pass must NOT flag: a bounded
+    ``for`` sweep over the ops (the frontier.py shape), and a while-True
+    accumulator whose break is gated on an explicit size cap."""
+
+    MAX_LANES = 512
+
+    def frontier_over_ops(self, history):
+        out = []
+        for j in range(len(history)):    # bounded by the ops themselves
+            out.append(history[:j] + history[j + 1:])
+        return out
+
+    def frontier_capped(self, history):
+        out = []
+        while True:
+            out.append(list(history))
+            if len(out) >= self.MAX_LANES:   # explicit cap: sanctioned
+                break
+        return out
+
+
 # ---------------------------------------------------------------------------
 # P-compositionality projection fixtures (QSM-SPEC-PCOMP — pass family a)
 # ---------------------------------------------------------------------------

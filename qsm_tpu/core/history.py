@@ -87,6 +87,17 @@ class History:
         return tuple((o.pid, o.cmd, o.arg, o.resp, o.invoke_time,
                       o.response_time) for o in self.ops)
 
+    def subhistory(self, keep: Sequence[int]) -> "History":
+        """The history restricted to op indices ``keep`` (sorted, original
+        timestamps preserved).  Dropping ops can only RELAX the real-time
+        precedence partial order on the survivors — the shrink plane's
+        op-subset candidates (qsm_tpu/shrink) are built from exactly this,
+        so a candidate's constraints are always a sub-order of the
+        original's."""
+        idx = sorted(set(keep))
+        return History([self.ops[i] for i in idx], seed=self.seed,
+                       program_id=self.program_id)
+
     def precedes_matrix(self) -> np.ndarray:
         """bool[n, n]: strict real-time precedence (resp_i < inv_j)."""
         n = len(self.ops)

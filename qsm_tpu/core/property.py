@@ -75,6 +75,16 @@ class PropertyConfig:
     # "tcp" (real loopback sockets, sched/transport.py).  Histories are
     # bit-identical across transports — the scheduler owns ordering.
     transport: str = "memory"
+    # After the program-level shrink, additionally minimize the failing
+    # HISTORY itself through the batched shrink plane (qsm_tpu/shrink,
+    # docs/SHRINK.md): op-subset + schedule shrinks decided frontier-at-
+    # once on the run's own backend.  The result lands in
+    # ``Counterexample.minimized_history`` (the program-level
+    # counterexample is untouched — it is what replays), and the
+    # shrink_* counters ride ``PropertyResult.timings``.  Off by
+    # default: the artifact is a second, smaller violation of the
+    # history, not a replayable (program, schedule).
+    minimize_history: bool = False
     # Worker processes for schedule execution (sched/pool.py).  0 = serial.
     # Histories are pure functions of (sut, program, seed, faults), so
     # fan-out changes wall-clock only — results stay bit-identical.
@@ -90,6 +100,11 @@ class Counterexample:
     trial: int
     trial_seed: str  # replay key
     shrink_steps: int
+    # 1-minimal history from the batched shrink plane when
+    # ``PropertyConfig.minimize_history`` asked for it (qsm_tpu/shrink):
+    # a sub-history/reschedule of ``history`` that still violates —
+    # smaller to read, but NOT a (program, schedule) replay artifact
+    minimized_history: Optional[History] = None
 
 
 @dataclasses.dataclass
@@ -254,6 +269,30 @@ def shrink_failure(
     return program, history, steps, checked
 
 
+def _minimize_history(spec, backend, history, timings):
+    """The opt-in batched history minimization pass (qsm_tpu/shrink):
+    run on the property's OWN backend (frontier candidates are just
+    another batch to it), counters merged into the per-run timings.
+    Returns (minimized_history | None, lanes_checked)."""
+    from ..shrink.shrinker import shrink_history as _shrink_history
+
+    t0 = time.perf_counter()
+    res = _shrink_history(spec, history, backend=backend,
+                          certificate=False)
+    timings["shrink_minimize"] = (timings.get("shrink_minimize", 0.0)
+                                  + time.perf_counter() - t0)
+    if res.ok:
+        # flat str -> float by the timings contract; ONLY the shrink_*
+        # keys merge here — the search_* entries stay owned by the
+        # backend-wrapper delta prop_concurrent computes at the end
+        # (which already includes the frontier dispatches' cost)
+        timings.update({k: v
+                        for k, v in res.search_stats().to_timings().items()
+                        if k.startswith("shrink_")})
+        return res.history, res.lanes_checked
+    return None, res.lanes_checked
+
+
 def prop_concurrent(
     spec: Spec,
     sut: ConcurrentSUT,
@@ -412,6 +451,11 @@ def _prop_concurrent_body(spec, sut, cfg, backend, oracle, transport,
                 spec, sut, backend, oracle, cfg, progs[gi],
                 hists_all[fail_at], seeds_all[gi][j], timings, transport,
                 executor)
+            minimized = None
+            if cfg.minimize_history:
+                minimized, c3 = _minimize_history(spec, backend, mh,
+                                                  timings)
+                c2 += c3
             return PropertyResult(
                 ok=False, trials_run=ti + 1,
                 histories_checked=checked + c2,
@@ -419,7 +463,8 @@ def _prop_concurrent_body(spec, sut, cfg, backend, oracle, transport,
                 distinct_histories=distinct, timings=timings,
                 counterexample=Counterexample(
                     program=mp, history=mh, trial=ti,
-                    trial_seed=seeds_all[gi][j], shrink_steps=steps))
+                    trial_seed=seeds_all[gi][j], shrink_steps=steps,
+                    minimized_history=minimized))
         t += len(group)
         group_n = min(group_target, group_n * 2)
     return PropertyResult(ok=True, trials_run=cfg.n_trials,

@@ -111,6 +111,23 @@ def longest_sub(spec: Spec, history: History) -> int:
     return max(counts.values(), default=0)
 
 
+def history_keys(spec: Spec, history: History) -> List[int]:
+    """Sorted distinct partition keys a history's ops touch — the shrink
+    plane's drop-key candidate axis (qsm_tpu/shrink/frontier.py): with a
+    VALIDATED projection, dropping every op of one key is the coarsest
+    sound op-subset shrink.  Raises on a non-total partition_key, like
+    :func:`longest_sub`."""
+    keys = set()
+    for op in history.ops:
+        key = spec.partition_key(op.cmd, op.arg)
+        if key is None:
+            raise ValueError(
+                f"{spec.name}: partition_key is not total "
+                f"(cmd={op.cmd}, arg={op.arg}); cannot decompose")
+        keys.add(key)
+    return sorted(keys)
+
+
 def split_gain(spec: Spec, history: History) -> bool:
     """True when decomposing ``history`` buys a strictly smaller compile
     bucket (or makes an unencodable/over-mask history checkable at all).

@@ -30,8 +30,8 @@ iters-per-history win on the CAS-32 bench corpus.
 """
 
 from .ordering import OrderingTable, ordering_table, permute_history
-from .planner import (CorpusProfile, SearchPlan, build_backend, plan_search,
-                      profile_corpus)
+from .planner import (CorpusProfile, SearchPlan, build_backend,
+                      build_host_backend, plan_search, profile_corpus)
 from .stats import SearchStats, collect_search_stats
 
 __all__ = [
@@ -40,6 +40,7 @@ __all__ = [
     "SearchPlan",
     "SearchStats",
     "build_backend",
+    "build_host_backend",
     "collect_search_stats",
     "ordering_table",
     "permute_history",

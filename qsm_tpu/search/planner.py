@@ -74,6 +74,21 @@ _TPU_SCHEDULE = (256, 2048, 16384, 65536)
 # is overhead on a corpus that mostly cannot cut.  1.15 ≈ "at least one
 # history in 7 cuts once"; the CAS-32 bench corpus profiles at ~1.69.
 _DECOMPOSE_MEAN_SEGMENTS = 1.15
+# The DECOMPOSED-corpus twin (ROADMAP item 3 leftover): with per-key
+# decomposition on, the inner kernel only ever sees sub-histories, so
+# the segdc gate must be measured on THEM, not on the whole corpus —
+# whole-history segment density systematically understates the split
+# shape (per-key sub-histories are sparser in time, so quiescent cuts
+# are denser).  Measured on the r10 corpora: kv-16-keys × 16-pids
+# sub-histories profile at 1.65 mean segments/sub at 64 ops and 4.26 at
+# 256 ops (whole: 1.44 / 2.25); multireg-64 subs at 1.77.  The gate
+# sits HIGHER than the whole-history one because a cut on an
+# already-short sub-history buys less (exhaustion cost is exponential
+# in segment length, and the split already shortened the segments):
+# 1.35 ≈ "at least one sub-history in 3 cuts once", comfortably below
+# every measured decomposed corpus and above non-cutting ones.
+# Pinned by tests/test_shrink.py::test_planner_sub_segment_gate.
+_DECOMPOSE_MEAN_SEGMENTS_SUB = 1.35
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +108,12 @@ class CorpusProfile:
     # measured" — the decompose_keys gate then stays off.
     sub_max_ops: int = 0
     mean_partitions: float = 0.0
+    # segment density OF THE SUB-HISTORIES (segments per per-key
+    # sub-history): what the inner kernel actually sees when
+    # decompose_keys is on — the segdc gate must be judged on this, not
+    # on the whole-history density above (ROADMAP item 3 leftover).
+    # 0.0 means "not measured" (no spec / invalid projection).
+    sub_mean_segments: float = 0.0
 
 
 def profile_corpus(histories: Sequence[History],
@@ -108,16 +129,29 @@ def profile_corpus(histories: Sequence[History],
     segs = [len(split_at_quiescent_cuts(h)) for h in histories]
     sub_max = 0
     mean_parts = 0.0
+    sub_mean_segs = 0.0
     if spec is not None:
         from ..core.spec import projection_report
-        from ..ops.pcomp import longest_sub
+        from ..ops.pcomp import split_history
 
         if not projection_report(spec):
-            subs = [longest_sub(spec, h) for h in histories]
-            parts = [len({spec.partition_key(o.cmd, o.arg)
-                          for o in h.ops}) for h in histories]
-            sub_max = max(subs, default=0)
+            # ONE split per history yields all three decomposition
+            # statistics: the longest sub-history (compile-bucket
+            # gate), the key count, and the decomposed corpus's own
+            # segment profile — what segdc would see UNDER the per-key
+            # split (the decompose gate's input when decompose_keys
+            # fires)
+            parts = []
+            sub_segs = []
+            for h in histories:
+                subs = split_history(spec, h)
+                parts.append(len(subs))
+                for s in subs.values():
+                    sub_max = max(sub_max, len(s))
+                    sub_segs.append(len(split_at_quiescent_cuts(s)))
             mean_parts = sum(parts) / len(histories)
+            if sub_segs:
+                sub_mean_segs = sum(sub_segs) / len(sub_segs)
     return CorpusProfile(
         n=len(histories),
         max_ops=max(lens),
@@ -128,6 +162,7 @@ def profile_corpus(histories: Sequence[History],
         mean_segments=sum(segs) / len(histories),
         sub_max_ops=sub_max,
         mean_partitions=mean_parts,
+        sub_mean_segments=sub_mean_segs,
     )
 
 
@@ -218,16 +253,30 @@ def plan_search(spec, profile: Optional[CorpusProfile] = None,
                f"({spec.name} {'has' if orderable else 'lacks'} a scalar "
                f"selectivity domain)")
 
+    decompose_keys, dk_why = _plan_decompose_keys(spec, profile)
+
     decompose = False
     if profile is not None and profile.n:
-        decompose = profile.mean_segments >= _DECOMPOSE_MEAN_SEGMENTS
-        why.append(f"decompose={'on' if decompose else 'off'} "
-                   f"(mean {profile.mean_segments:.2f} segments/history "
-                   f"over {profile.n} histories)")
+        if decompose_keys and profile.sub_mean_segments:
+            # with the per-key split on, the inner kernel only ever
+            # sees sub-histories — the segdc gate is judged on THEIR
+            # segment density, against the decomposed-corpus threshold
+            # (whole-history density understates the split shape)
+            decompose = (profile.sub_mean_segments
+                         >= _DECOMPOSE_MEAN_SEGMENTS_SUB)
+            why.append(
+                f"decompose={'on' if decompose else 'off'} "
+                f"(mean {profile.sub_mean_segments:.2f} segments/"
+                f"sub-history under the per-key split over {profile.n} "
+                f"histories; decomposed-corpus gate "
+                f"{_DECOMPOSE_MEAN_SEGMENTS_SUB})")
+        else:
+            decompose = profile.mean_segments >= _DECOMPOSE_MEAN_SEGMENTS
+            why.append(f"decompose={'on' if decompose else 'off'} "
+                       f"(mean {profile.mean_segments:.2f} segments/history "
+                       f"over {profile.n} histories)")
     else:
         why.append("decompose=off (no corpus profile)")
-
-    decompose_keys, dk_why = _plan_decompose_keys(spec, profile)
     why.append(dk_why)
 
     if on_device:
@@ -299,3 +348,21 @@ def build_backend(spec, plan: SearchPlan, budget: int = 2_000, **device_kw):
 
         return PComp(spec, make_inner=make_core)
     return make_core(spec)
+
+
+def build_host_backend(spec, plan: SearchPlan):
+    """The planned checker's HOST shape — the serving plane's ``auto``
+    semantics as one construction site: ``PComp`` outermost over the
+    exact cpp→memo host ladder when the plan splits per key, the ladder
+    wrapped in ``FailoverBackend`` otherwise.  No device is touched and
+    no compile bucket warmed; verdicts are bit-identical to the device
+    path by the resolution contract.  Consumed by the shrink plane
+    (qsm_tpu/shrink) and anything else that wants today's honest fast
+    path driven by the same plan gates as :func:`build_backend`."""
+    from ..resilience.failover import FailoverBackend, host_fallback
+
+    if plan.decompose_keys:
+        from ..ops.pcomp import PComp
+
+        return PComp(spec, make_inner=host_fallback)
+    return FailoverBackend(spec, host_fallback(spec))

@@ -53,6 +53,17 @@ class SearchStats:
     pcomp_subs: int = 0         # per-key sub-histories produced
     pcomp_max_sub: int = 0      # longest sub-history (ops) — max-merged
     pcomp_recombine_ms: int = 0  # verdict recombine + witness stitch
+    # Shrink plane (qsm_tpu/shrink): frontier-at-once counterexample
+    # minimization — how many greedy rounds ran, how many candidate
+    # lanes the frontier dispatches carried, how many candidates the
+    # fingerprint memo answered without re-checking, and how small the
+    # minimized history ended up relative to the input (percent of the
+    # initial op count; min-merged — the record's best shrink).  A
+    # shrink run's cost record must say it shrank, and to what.
+    shrink_rounds: int = 0      # greedy frontier rounds
+    shrink_lanes: int = 0       # candidate lanes dispatched
+    shrink_memo_hits: int = 0   # candidates answered from the memo
+    shrink_ratio_pct: int = 0   # 100 * final_ops / initial_ops (0 = none)
     ordering: bool = False      # postcondition-aware ordering active
     plan: str = ""              # planner provenance ("" = hand-tuned)
     # resilience plane (qsm_tpu/resilience): device-loss accounting —
@@ -88,11 +99,18 @@ class SearchStats:
                   "deferred", "tail_histories", "segments_split",
                   "segments_total", "degradations", "retries",
                   "worker_faults", "pcomp_split", "pcomp_subs",
-                  "pcomp_recombine_ms"):
+                  "pcomp_recombine_ms", "shrink_rounds", "shrink_lanes",
+                  "shrink_memo_hits"):
             setattr(self, f, getattr(self, f) + getattr(other, f))
         # a maximum, not a tally: the composed record's worst sub-history
         # is the worst either side saw
         self.pcomp_max_sub = max(self.pcomp_max_sub, other.pcomp_max_sub)
+        # a ratio, not a tally: the composed record keeps the BEST
+        # (smallest) shrink either side achieved; 0 means "never shrank"
+        if other.shrink_ratio_pct:
+            self.shrink_ratio_pct = (
+                other.shrink_ratio_pct if not self.shrink_ratio_pct
+                else min(self.shrink_ratio_pct, other.shrink_ratio_pct))
         if count_histories:
             self.histories += other.histories
         self.ordering = self.ordering or other.ordering
@@ -133,6 +151,13 @@ class SearchStats:
             "pcs": self.pcomp_split,
             "pcn": self.pcomp_subs,
             "pcm": self.pcomp_max_sub,
+            # shrink counters ride every compact record the same way: a
+            # bench row produced during minimization must say how many
+            # rounds/lanes the shrink plane spent and what it bought
+            "shr": self.shrink_rounds,
+            "shl": self.shrink_lanes,
+            "shm": self.shrink_memo_hits,
+            "sho": self.shrink_ratio_pct,
         }
 
     def to_timings(self) -> Dict[str, float]:
@@ -163,6 +188,13 @@ class SearchStats:
             out["pcomp_subs"] = float(self.pcomp_subs)
             out["pcomp_max_sub"] = float(self.pcomp_max_sub)
             out["pcomp_recombine_ms"] = float(self.pcomp_recombine_ms)
+        # shrink accounting only when minimization actually ran — zeros
+        # would claim "shrank to nothing" on every plain check run
+        if self.shrink_rounds:
+            out["shrink_rounds"] = float(self.shrink_rounds)
+            out["shrink_lanes"] = float(self.shrink_lanes)
+            out["shrink_memo_hits"] = float(self.shrink_memo_hits)
+            out["shrink_ratio"] = round(self.shrink_ratio_pct / 100.0, 3)
         return out
 
 
@@ -171,9 +203,11 @@ _COUNTER_FIELDS = ("histories", "lockstep_iters", "nodes_explored",
                    "chunk_rounds", "rescued", "deferred", "tail_histories",
                    "segments_split", "segments_total", "degradations",
                    "retries", "worker_faults", "pcomp_split", "pcomp_subs",
-                   "pcomp_recombine_ms")
-# pcomp_max_sub is deliberately NOT a delta field: a maximum has no
-# meaningful "per-run difference", so stats_delta keeps `after`'s value.
+                   "pcomp_recombine_ms", "shrink_rounds", "shrink_lanes",
+                   "shrink_memo_hits")
+# pcomp_max_sub and shrink_ratio_pct are deliberately NOT delta fields:
+# a maximum/ratio has no meaningful "per-run difference", so stats_delta
+# keeps `after`'s value.
 
 
 def stats_delta(after: Optional[SearchStats],

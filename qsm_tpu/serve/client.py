@@ -52,6 +52,28 @@ class CheckClient:
             req["deadline_s"] = deadline_s
         return self._round_trip(req)
 
+    def shrink(self, model: str,
+               history: Union[History, Sequence[Sequence[int]]],
+               *, spec_kwargs: Optional[dict] = None,
+               certificate: bool = False,
+               deadline_s: Optional[float] = None,
+               req_id: Optional[str] = None) -> dict:
+        """Minimize one failing history (the ``shrink`` verb,
+        docs/SHRINK.md): the response carries the 1-minimal history's
+        rows plus rounds/lanes/memo counters; ``certificate=True`` adds
+        the per-neighbor ``verify_witness``-replayable proof."""
+        rows = (history_to_rows(history) if isinstance(history, History)
+                else list(history))
+        req = {"op": "shrink", "id": req_id or f"q{next(_ids)}",
+               "model": model, "history": rows}
+        if spec_kwargs:
+            req["spec_kwargs"] = spec_kwargs
+        if certificate:
+            req["certificate"] = True
+        if deadline_s is not None:
+            req["deadline_s"] = deadline_s
+        return self._round_trip(req)
+
     def stats(self) -> dict:
         return self._round_trip({"op": "stats"})
 

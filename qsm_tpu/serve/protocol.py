@@ -11,6 +11,8 @@ Requests::
 
     {"op": "check", "id": "c0-3", "model": "cas", "histories": [[...]],
      "spec_kwargs": {}, "witness": false, "deadline_s": 30.0}
+    {"op": "shrink", "id": "s1", "model": "kv", "history": [...],
+     "spec_kwargs": {}, "certificate": false, "deadline_s": 300.0}
     {"op": "stats"}
     {"op": "shutdown"}
 
@@ -25,6 +27,18 @@ A ``shed`` response is the load-shedding contract (admission.py): the
 server refuses work it cannot finish inside the request's deadline —
 explicitly, never by silent latency collapse, and NEVER by a wrong or
 partial verdict.
+
+The ``shrink`` verb (qsm_tpu/shrink, docs/SHRINK.md) answers with the
+1-minimal history's rows plus rounds/lanes/memo counters::
+
+    {"id": "s1", "ok": true, "verdict": "VIOLATION", "initial_ops": 64,
+     "final_ops": 2, "rounds": 9, "history": [[...]], "one_minimal": true,
+     "complete": true, "why": [...], "certificate": [...]?}
+
+Its admission/SHED semantics match ``check``, with one documented
+difference: a deadline firing MID-shrink returns the best-so-far
+history with ``complete: false`` and an honest ``why`` instead of
+discarding the rounds already paid for.
 """
 
 from __future__ import annotations

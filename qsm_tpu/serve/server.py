@@ -46,6 +46,7 @@ import os
 import socket
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -60,8 +61,8 @@ from ..search.stats import collect_search_stats, stats_delta
 from .admission import AdmissionController
 from .batcher import Lane, MicroBatcher
 from .cache import VerdictCache, fingerprint_key
-from .protocol import (VERDICT_NAMES, LineChannel, rows_to_history,
-                       send_doc)
+from .protocol import (VERDICT_NAMES, LineChannel, history_to_rows,
+                       rows_to_history, send_doc)
 
 
 class _EngineEntry:
@@ -254,6 +255,20 @@ class CheckServer:
         self.pcomp_split = 0        # request histories decomposed
         self.pcomp_subs = 0         # sub-lanes produced from them
         self.pcomp_sub_hits = 0     # sub-lanes answered from the cache
+        # Shrink verb (qsm_tpu/shrink, docs/SHRINK.md): a failing
+        # history submitted as {"op": "shrink"} is minimized with its
+        # frontier candidates riding the SAME micro-batch lanes as
+        # paying check traffic (and banking in the same verdict cache),
+        # and the minimized result banks under the original history's
+        # fingerprint so duplicate shrink requests answer O(1)
+        self._shrink_lock = threading.Lock()
+        self._shrink_bank: "OrderedDict[str, dict]" = OrderedDict()
+        self.shrink_bank_entries = 1024
+        self.shrink_requests = 0
+        self.shrink_bank_hits = 0
+        self.shrink_rounds = 0      # frontier rounds across all requests
+        self.shrink_lanes = 0      # candidate lanes those rounds carried
+        self.shrink_memo_hits = 0  # candidates answered without checking
 
     # -- lifecycle -----------------------------------------------------
     @property
@@ -458,16 +473,20 @@ class CheckServer:
             else:
                 send_doc(conn, {"ok": False,
                                 "error": "shutdown disabled"})
-        elif op == "check":
+        elif op in ("check", "shrink"):
             try:
-                self._handle_check(conn, req)
+                if op == "check":
+                    self._handle_check(conn, req)
+                else:
+                    self._handle_shrink(conn, req)
             except OSError:
                 raise  # the peer went away: let the connection close
             except Exception as e:  # noqa: BLE001 — a malformed request
                 # (bad rows, bad spec_kwargs, a failing engine build)
                 # must answer an error, not kill the connection thread;
                 # no admission slots are held here (_handle_check admits
-                # only after validation and releases on its own errors)
+                # only after validation and releases on its own errors;
+                # _handle_shrink releases in its finally)
                 send_doc(conn, {"id": req.get("id"), "ok": False,
                                 "error": f"{type(e).__name__}: {e}"})
         else:
@@ -704,6 +723,162 @@ class CheckServer:
             dispatched += 1
         return True
 
+    # -- the shrink verb (qsm_tpu/shrink, docs/SHRINK.md) --------------
+    def _handle_shrink(self, conn: socket.socket, req: dict) -> None:
+        """Minimize one failing history.  Admission/deadline/SHED
+        semantics are the check path's: unknown model / bad rows answer
+        an error, a full queue answers SHED, a deadline that fires
+        BEFORE the first frontier round answers SHED — but a deadline
+        (or full batcher) that fires MID-shrink returns the best
+        history found so far with ``complete: false`` and an honest
+        ``why`` (a partial minimization is still a violation; throwing
+        it away would waste every lane already paid for).  Frontier
+        candidates ride the shared micro-batcher and bank in the
+        verdict cache; the minimized result banks under the ORIGINAL
+        history's fingerprint."""
+        from ..models.registry import MODELS
+        from ..shrink.shrinker import Shrinker, minimality_certificate
+
+        t_req = time.perf_counter()
+        model = req.get("model")
+        if model not in MODELS:
+            send_doc(conn, {"id": req.get("id"), "ok": False,
+                            "error": f"unknown model {model!r}; one of "
+                                     f"{sorted(MODELS)}"})
+            return
+        rows = req.get("history")
+        if rows is None and isinstance(req.get("histories"), list) \
+                and len(req["histories"]) == 1:
+            rows = req["histories"][0]
+        if not isinstance(rows, list) or not rows:
+            send_doc(conn, {"id": req.get("id"), "ok": False,
+                            "error": "shrink needs ONE non-empty "
+                                     "'history' rows array"})
+            return
+        h = rows_to_history(rows)
+        spec_kwargs = req.get("spec_kwargs") or {}
+        want_cert = bool(req.get("certificate"))
+        deadline = self.admission.deadline_for(req.get("deadline_s"))
+        self.requests += 1
+        entry = self._engine_for(model, spec_kwargs)
+        spec_key = self._spec_key(model, spec_kwargs)
+        whole_key = fingerprint_key(entry.spec, h)
+        with self._shrink_lock:
+            self.shrink_requests += 1
+            banked = self._shrink_bank.get(whole_key)
+            if banked is not None:
+                self._shrink_bank.move_to_end(whole_key)
+        if banked is not None and not (want_cert
+                                       and "certificate" not in banked):
+            with self._shrink_lock:
+                self.shrink_bank_hits += 1
+            doc = {**banked, "id": req.get("id"), "cached": True,
+                   "seconds": round(time.perf_counter() - t_req, 4)}
+            if not want_cert:
+                # a banked certificate (O(n²) witness payload) must not
+                # inflate a duplicate answer that never asked for one
+                doc.pop("certificate", None)
+            send_doc(conn, doc)
+            return
+        if not self.admission.try_admit(1):
+            send_doc(conn, self._shed(req, "queue full"))
+            return
+        try:
+            if time.monotonic() >= deadline:
+                self.admission.shed_late()
+                send_doc(conn, self._shed(req, "deadline"))
+                return
+
+            def decide(hists):
+                return self._decide_candidates(entry, spec_key, hists,
+                                               deadline)
+
+            # bank = the verdict cache (candidates the check path — or
+            # an earlier shrink — already decided are memo hits, and
+            # the dispatch path banks every new verdict itself, so
+            # bank_put stays off: no duplicate rows)
+            shrinker = Shrinker(entry.spec, decide, bank=self.cache,
+                                bank_put=False, deadline=deadline)
+            res = shrinker.run(h)
+            if res.ok and res.complete and want_cert:
+                # a FRESH oracle per request: engines are stateful and
+                # not thread-safe (_EngineEntry docstring), and this
+                # witness loop runs on the connection thread while the
+                # dispatcher may be driving entry.oracle — sharing it
+                # here would race the memo and corrupt stats() counters
+                res.certificate = minimality_certificate(
+                    entry.spec, res.history, deadline=deadline)
+            with self._shrink_lock:
+                self.shrink_rounds += res.rounds
+                self.shrink_lanes += res.lanes_checked
+                self.shrink_memo_hits += res.memo_hits
+            doc = {
+                "id": req.get("id"), "ok": True, "model": model,
+                "verdict": VERDICT_NAMES[int(res.verdict)],
+                "initial_ops": res.initial_ops,
+                "final_ops": res.final_ops,
+                "ratio": round(res.ratio, 3),
+                "rounds": res.rounds,
+                "engine_calls": res.engine_calls,
+                "lanes": res.lanes_checked,
+                "memo_hits": res.memo_hits,
+                "complete": res.complete,
+                "one_minimal": res.one_minimal,
+                "undecided_neighbors": res.undecided_neighbors,
+                "history": history_to_rows(res.history),
+                "why": res.why,
+                "plan_why": entry.plan_why,
+            }
+            if res.certificate is not None:
+                doc["certificate"] = res.certificate
+            if res.ok and res.complete:
+                # minimized result banked under the ORIGINAL history's
+                # fingerprint: the duplicate-shrink answer is O(1)
+                with self._shrink_lock:
+                    self._shrink_bank[whole_key] = dict(doc)
+                    self._shrink_bank.move_to_end(whole_key)
+                    while len(self._shrink_bank) > self.shrink_bank_entries:
+                        self._shrink_bank.popitem(last=False)
+            doc["seconds"] = round(time.perf_counter() - t_req, 4)
+            send_doc(conn, doc)
+        finally:
+            self.admission.release(1)
+
+    def _decide_candidates(self, entry: _EngineEntry, spec_key: str,
+                           hists, deadline: float):
+        """Decide shrink-frontier candidates through the SHARED lanes:
+        each candidate is one micro-batch lane (split into per-key
+        sub-lanes when that pays, exactly like paying check traffic),
+        banked by the dispatch path.  ``None`` = shed (full batcher or
+        deadline) — the shrinker stops with best-so-far.  Candidate
+        lanes hold no admission slots: the shrink REQUEST holds one,
+        and the batcher's bounded queue is the frontier's backstop."""
+
+        def _noop(_i: int) -> None:
+            return None
+
+        pending = _PendingRequest(len(hists))
+        for i, h in enumerate(hists):
+            key = fingerprint_key(entry.spec, h)
+            if self._split_pays(entry, h):
+                if not self._submit_split(entry, h, key, pending, i,
+                                          deadline, _noop):
+                    pending.dead = True
+                    return None
+            else:
+                lane = Lane(key=key, history=h, deadline=deadline,
+                            resolve=self._lane_resolver(pending, i,
+                                                        _noop))
+                pending.lane_submitted[i] = True
+                if not self.batcher.submit(spec_key, lane):
+                    pending.lane_submitted[i] = False
+                    pending.dead = True
+                    return None
+        if not pending.wait(deadline - time.monotonic()):
+            pending.dead = True
+            return None
+        return [int(v) for v in pending.verdicts]
+
     @staticmethod
     def _lane_resolver(pending: _PendingRequest, i: int, release_lane):
         def _resolve(verdict: int, batch: dict) -> None:
@@ -840,6 +1015,15 @@ class CheckServer:
                     "sub_lanes": self.pcomp_subs,
                     "sub_cache_hits": self.pcomp_sub_hits}
 
+    def _shrink_snapshot(self) -> dict:
+        with self._shrink_lock:
+            return {"requests": self.shrink_requests,
+                    "rounds": self.shrink_rounds,
+                    "lanes": self.shrink_lanes,
+                    "memo_hits": self.shrink_memo_hits,
+                    "bank_entries": len(self._shrink_bank),
+                    "bank_hits": self.shrink_bank_hits}
+
     def stats(self) -> dict:
         """The aggregate the ``stats`` op (and ``qsm-tpu stats --serve``)
         returns: every counter a capacity decision needs, self-describing
@@ -865,6 +1049,10 @@ class CheckServer:
             # many sub-lanes it became, and how many of those the
             # per-sub-history cache rows answered without re-checking
             "pcomp": self._pcomp_snapshot(),
+            # shrink-verb accounting: how many minimizations ran, what
+            # their frontiers cost in shared lanes, and how much the
+            # fingerprint memo + result bank saved (docs/SHRINK.md)
+            "shrink": self._shrink_snapshot(),
             "worker_faults": (self.pool.worker_faults
                               if self.pool is not None else 0),
             "budget_resolved": self.budget_resolved,

@@ -1,0 +1,38 @@
+"""qsm_tpu.shrink — the batched shrink plane (counterexample minimization).
+
+The source paper's fifth capability: QuickCheck shrinking re-checks
+thousands of candidate histories per failure, one at a time on CPU —
+precisely the workload the batched checkers exist for.  This package
+minimizes a failing HISTORY (not the program): the whole shrink frontier
+— op-subset shrinks (drop-one / drop-pid / drop-key via the validated
+``KeyProj`` projection) and schedule shrinks (commute adjacent
+non-overlapping ops) — is generated host-side, deduped against a
+fingerprint memo riding the serve verdict cache's row format, decided in
+ONE planned dispatch per round, and recursed greedily on the smallest
+still-failing candidate.  The result is a 1-MINIMAL history (every
+further single-op drop passes) plus a certificate — one
+``verify_witness``-replayable linearization per drop-one neighbor — so
+the minimization is audited, not trusted (docs/SHRINK.md).
+
+Surfaces: ``shrink_history`` (in-process), the ``shrink`` serve verb
+(qsm_tpu/serve — frontier lanes ride the shared micro-batcher and bank
+in the verdict cache), the ``qsm-tpu shrink`` CLI subcommand, and
+``shrink_*`` counters in ``SearchStats`` / bench rows.
+"""
+
+from .frontier import Candidate, inversions, shrink_frontier
+from .shrinker import (ShrinkResult, Shrinker, collect_shrink_stats,
+                       minimality_certificate, shrink_history,
+                       verify_certificate)
+
+__all__ = [
+    "Candidate",
+    "ShrinkResult",
+    "Shrinker",
+    "collect_shrink_stats",
+    "inversions",
+    "minimality_certificate",
+    "shrink_frontier",
+    "shrink_history",
+    "verify_certificate",
+]

@@ -261,6 +261,12 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
                         "the exact host ladder (cpp -> memo) and the run "
                         "completes with identical verdicts; degradations "
                         "are reported in the timings log")
+    p.add_argument("--minimize", action="store_true",
+                   help="after the program-level shrink, minimize the "
+                        "failing HISTORY itself through the batched "
+                        "shrink plane (qsm_tpu/shrink): a 1-minimal "
+                        "sub-history/reschedule printed alongside the "
+                        "replayable counterexample (docs/SHRINK.md)")
     p.add_argument("--transport", default="memory",
                    choices=["memory", "tcp"],
                    help="scheduler-plane message transport (tcp = real "
@@ -293,7 +299,8 @@ def cmd_run(args) -> int:
         schedules_per_program=args.schedules,
         transport=args.transport,
         executor_workers=args.workers,
-        trial_batch=args.trial_batch)
+        trial_batch=args.trial_batch,
+        minimize_history=getattr(args, "minimize", False))
     log = JsonlLogger(path=args.log) if args.log else JsonlLogger()
     try:
         t0 = time.perf_counter()
@@ -336,6 +343,13 @@ def cmd_run(args) -> int:
     cx = res.counterexample
     print(f"FAIL: {args.model}/{args.impl} — linearizability violation")
     print(format_counterexample(spec, cx))
+    if cx.minimized_history is not None:
+        # the batched shrink plane's 1-minimal artifact — smaller to
+        # read; the (program, schedule) counterexample above is what
+        # replays
+        print(f"history-minimized to {len(cx.minimized_history)} op(s) "
+              "(qsm_tpu/shrink; still a VIOLATION, not replayable):")
+        print(format_history(spec, cx.minimized_history))
     fault_flags = ""
     if faults is not None:
         fault_flags = (f" --p-drop {args.p_drop}"
@@ -724,6 +738,128 @@ def cmd_check(args) -> int:
     return 2 if v == int(Verdict.BUDGET_EXCEEDED) else 1
 
 
+def cmd_shrink(args) -> int:
+    """Minimize a failing external trace (qsm_tpu/shrink,
+    docs/SHRINK.md): the whole shrink frontier — drop-one/drop-pid/
+    drop-key op subsets plus adjacent-commute schedule shrinks — decides
+    in ONE planned batched dispatch per greedy round, and the result is
+    a 1-minimal history whose certificate (one verify_witness-replayable
+    linearization per drop-one neighbor) proves the minimality claim.
+    With ``--addr`` the request is served by a running check server's
+    ``shrink`` verb instead (frontier lanes ride its shared
+    micro-batches and verdict cache).  Exit codes: 0 minimized, 1
+    incomplete (best-so-far returned), 2 input not a violation,
+    3 shed/error."""
+    from ..ops.backend import Verdict
+    from ..serve.protocol import history_to_rows
+    from .report import history_from_rows
+
+    with open(args.trace) as f:
+        doc = json.load(f)
+    model = args.model or doc.get("model")
+    if not model:
+        raise SystemExit("trace has no 'model'; pass --model")
+    if model not in MODELS:
+        raise SystemExit(
+            f"unknown model {model!r}; one of {sorted(MODELS)}")
+    if "history" not in doc:
+        raise SystemExit(
+            "shrink minimizes ONE failing trace: the file needs a "
+            "'history' array of [pid, cmd, arg, resp, invoke_time, "
+            "response_time] rows")
+    h = history_from_rows(doc["history"])
+    spec_kwargs = doc.get("spec_kwargs") or None
+    if args.addr:
+        # the server runs its own loop: the in-process-only knobs must
+        # fail loudly, never be silently dropped
+        if args.max_rounds is not None or args.max_lanes is not None:
+            raise SystemExit("--max-rounds/--max-lanes tune the "
+                             "in-process shrinker; the server uses its "
+                             "own bounds (drop them, or drop --addr)")
+        if args.emit_certificate:
+            raise SystemExit("--emit-certificate is in-process only; "
+                             "with --addr use --certificate (the "
+                             "response carries the witnesses)")
+        from ..serve.client import CheckClient
+
+        client = CheckClient(args.addr, timeout_s=args.timeout)
+        try:
+            out = client.shrink(model, doc["history"],
+                                spec_kwargs=spec_kwargs,
+                                certificate=args.certificate,
+                                deadline_s=args.deadline)
+        finally:
+            client.close()
+        print(json.dumps(out))
+        if not out.get("ok"):
+            return 3
+        if args.save and out.get("verdict") == "VIOLATION":
+            from ..resilience.checkpoint import atomic_write_json
+
+            atomic_write_json(args.save, {
+                "model": model,
+                "spec_kwargs": out.get("spec_kwargs")
+                or doc.get("spec_kwargs") or {},
+                "history": out["history"]})
+            print(f"minimized trace saved to {args.save}",
+                  file=sys.stderr)
+        if out.get("verdict") != "VIOLATION":
+            return 2
+        return 0 if out.get("complete") else 1
+    spec, _ = make(model, "atomic", spec_kwargs)
+    from ..search.stats import collect_search_stats
+    from ..shrink import (collect_shrink_stats, shrink_history,
+                          verify_certificate)
+
+    from ..shrink.shrinker import DEFAULT_MAX_LANES, DEFAULT_MAX_ROUNDS
+
+    res = shrink_history(spec, h,
+                         max_rounds=(args.max_rounds
+                                     if args.max_rounds is not None
+                                     else DEFAULT_MAX_ROUNDS),
+                         max_lanes=(args.max_lanes
+                                    if args.max_lanes is not None
+                                    else DEFAULT_MAX_LANES),
+                         deadline_s=args.deadline,
+                         certificate=(args.certificate
+                                      or args.emit_certificate))
+    st = collect_shrink_stats(res)
+    out = {
+        "model": model,
+        "verdict": _VERDICT_NAMES[int(res.verdict)],
+        "initial_ops": res.initial_ops, "final_ops": res.final_ops,
+        "ratio": round(res.ratio, 3), "rounds": res.rounds,
+        "engine_calls": res.engine_calls, "lanes": res.lanes_checked,
+        "memo_hits": res.memo_hits, "complete": res.complete,
+        "one_minimal": res.one_minimal,
+        "undecided_neighbors": res.undecided_neighbors,
+        "history": history_to_rows(res.history),
+        "why": res.why,
+        "search": st.to_compact(),
+    }
+    if res.certificate is not None:
+        # the certificate is replayed HERE (verify_witness, no search
+        # trusted) so the one JSON line carries the audited claim, not
+        # the raw witnesses alone
+        out["certificate_audit"] = verify_certificate(
+            spec, res.history, res.certificate)
+        if args.emit_certificate:
+            out["certificate"] = res.certificate
+    # human rendering to stderr; stdout stays one machine-readable line
+    print(format_history(spec, res.history), file=sys.stderr)
+    print(json.dumps(out))
+    if args.save and res.ok:
+        from ..resilience.checkpoint import atomic_write_json
+
+        atomic_write_json(args.save, {
+            "model": model, "spec_kwargs": spec.spec_kwargs(),
+            "history": history_to_rows(res.history)})
+        print(f"minimized trace saved to {args.save}", file=sys.stderr)
+    if not res.ok:
+        return 2 if res.verdict != int(Verdict.VIOLATION) else 3
+    return 0 if res.complete else 1
+
+
 def cmd_lint(args) -> int:
     """Static spec/kernel/determinism analysis (qsm_tpu/analysis) —
     CPU-only by contract: the process is pinned to the CPU platform
@@ -1091,6 +1227,46 @@ def main(argv=None) -> int:
                         "(one host-oracle search serves verdict AND "
                         "witness; --backend is ignored)")
     p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser(
+        "shrink",
+        help="minimize a failing external trace: frontier-at-once "
+             "batched shrinking to a 1-minimal history with a "
+             "verify_witness-replayable certificate (docs/SHRINK.md)")
+    p.add_argument("--trace", required=True,
+                   help="JSON with a 'history' array of [pid, cmd, arg, "
+                        "resp, invoke_time, response_time] rows (the "
+                        "`check` trace format)")
+    p.add_argument("--model", default=None, choices=sorted(MODELS),
+                   help="overrides the trace's own 'model' field")
+    p.add_argument("--addr", default=None,
+                   help="send to a running check server's `shrink` verb "
+                        "instead of shrinking in-process")
+    p.add_argument("--certificate", action="store_true",
+                   help="compute the 1-minimality certificate (one "
+                        "witness per drop-one neighbor) and audit it "
+                        "via verify_witness")
+    p.add_argument("--emit-certificate", action="store_true",
+                   help="also include the raw certificate witnesses in "
+                        "the JSON output (in-process only; implies the "
+                        "audit --certificate performs)")
+    p.add_argument("--max-rounds", type=int, default=None,
+                   help="greedy round cap (in-process only; default "
+                        "256)")
+    p.add_argument("--max-lanes", type=int, default=None,
+                   help="frontier candidates decided per batched "
+                        "dispatch (in-process only; default 512); a "
+                        "truncated frontier is reported in `why` and "
+                        "forfeits the 1-minimality claim, never silent")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="seconds; past it the best-so-far history is "
+                        "returned with complete=false and an honest why")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="client-side response bound (--addr mode)")
+    p.add_argument("--save", default=None,
+                   help="write the minimized history as a `check`-format "
+                        "trace file (atomic)")
+    p.set_defaults(fn=cmd_shrink)
 
     p = sub.add_parser("list", help="models, impls, and backend choices")
     p.set_defaults(fn=cmd_list)

@@ -59,12 +59,14 @@ def test_in_tree_corpus_is_clean(report):
     assert len(DEFAULT_POOL_FILES) == 3
     assert "pool" in report.passes
     # the whole-program race plane (family g): serve + resilience +
-    # tools, analyzed as one closed program
-    assert len(DEFAULT_RACE_FILES) >= 15
+    # tools, analyzed as one closed program (the shrink plane included)
+    assert len(DEFAULT_RACE_FILES) >= 17
     assert "race" in report.passes
-    # a–g all registered and all ran in the default lane
-    assert sorted(FAMILIES) == list("abcdefg")
-    assert report.families == list("abcdefg")
+    # the shrink plane's frontier-bound family (h)
+    assert "shrink" in report.passes
+    # a–h all registered and all ran in the default lane
+    assert sorted(FAMILIES) == list("abcdefgh")
+    assert report.families == list("abcdefgh")
     assert report.ok, "\n".join(
         f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
 

@@ -1,0 +1,364 @@
+"""Batched-shrink bench — frontier-at-once vs one-candidate-at-a-time.
+
+The paper's fifth capability: a failure's shrink loop re-checks
+thousands of candidate histories, and the reference pays them ONE AT A
+TIME on CPU.  The shrink plane (qsm_tpu/shrink, ISSUE 10) generates the
+whole frontier per greedy round and decides it in one planned dispatch;
+this tool prices exactly that fold on seeded-bug corpora — racy kv and
+racy cas, 64-op failing histories — on the CPU platform, no window
+required:
+
+* ``batched_{fam}`` — ``shrink_history``: planned host dispatch
+  (``build_host_backend``: PComp outermost for kv, the failover host
+  ladder for cas), fingerprint memo, one engine CALL per round.  Every
+  result is audited: minimized history re-confirmed a VIOLATION by a
+  FRESH memo oracle, 1-minimality proved by the certificate (one
+  ``verify_witness``-replayable witness per drop-one neighbor).
+* ``naive_{fam}`` — the SAME algorithm (same frontier, same
+  smallest-still-failing selection — so the minimized history is
+  bit-identical by construction, pinned per history) issuing one engine
+  call per candidate with no memo: the reference's one-at-a-time shape.
+  The gate compares ENGINE CALLS (dispatch invocations — the unit a
+  device pays launch overhead and a server pays batching latency on).
+  A first-accept greedy variant (step to the FIRST failing candidate,
+  stop scanning) is also priced per family (``first_accept_calls``):
+  it is a different algorithm — it cannot claim the smallest-candidate
+  step and decides a different (order-dependent) trajectory — but the
+  artifact reports it so the fold's win is never overstated.
+* ``serve_shrink`` — the ``shrink`` verb end-to-end: a CheckServer
+  minimizes the kv corpus over shared micro-batch lanes; every
+  minimized history must be IDENTICAL to the in-process result, and a
+  duplicate request must answer O(1) from the shrink bank.
+
+Win condition (ISSUE 10 acceptance): ≥10× fewer engine checks than the
+one-at-a-time baseline on both families, zero wrong verdicts (audits
+all green), every minimized history 1-minimal + still a VIOLATION +
+witnesses replaying through ``verify_witness``, and the serve verb
+bit-identical to the in-process API.  Output: a resumable
+``CellJournal`` committed as ``BENCH_SHRINK_<tag>.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MAX_ROUNDS = 256
+KV = {"n_keys": 16, "n_values": 4}
+KV_PIDS, KV_OPS, KV_CORPUS = 8, 64, 6
+CAS_PIDS, CAS_OPS, CAS_CORPUS = 4, 64, 4
+SEED_SCAN = 120          # seeds probed while collecting failing histories
+SERVE_DEADLINE_S = 300.0
+
+
+def _families():
+    from qsm_tpu.models.cas import CasSpec
+    from qsm_tpu.models.kv import KvSpec, StaleCacheKvSUT
+    from qsm_tpu.models.registry import MODELS
+
+    kv = KvSpec(**KV)
+    cas = MODELS["cas"].make_spec()
+    return {
+        "kv": (kv, StaleCacheKvSUT, KV_PIDS, KV_OPS, KV_CORPUS),
+        "cas": (cas, MODELS["cas"].impls["racy"], CAS_PIDS, CAS_OPS,
+                CAS_CORPUS),
+    }
+
+
+def _failing_corpus(spec, sut_cls, n, pids, ops, prefix):
+    """``n`` seeded VIOLATION histories of exactly ``ops`` ops — the
+    racy SUT run under the deterministic scheduler, kept iff the host
+    ladder says VIOLATION (seeds are scanned in order, so the corpus is
+    fully reproducible from this file alone)."""
+    from qsm_tpu.core.generator import generate_program
+    from qsm_tpu.resilience.failover import host_fallback
+    from qsm_tpu.sched.runner import run_concurrent
+
+    eng = host_fallback(spec)
+    out = []
+    for seed in range(SEED_SCAN):
+        if len(out) >= n:
+            break
+        prog = generate_program(spec, seed=seed, n_pids=pids,
+                                max_ops=ops, min_ops=ops)
+        h = run_concurrent(sut_cls(spec), prog,
+                           seed=f"{prefix}:{seed}").completed()
+        if int(eng.check_histories(spec, [h])[0]) == 0:  # VIOLATION
+            out.append(h)
+    return out
+
+
+def _engine(spec, history):
+    """The batched plane's own engine construction (shrinker.py default)
+    — built once per family so the naive twin re-uses the identical
+    verdict source."""
+    from qsm_tpu.search.planner import (build_host_backend, plan_search,
+                                        profile_corpus)
+
+    plan = plan_search(spec, profile_corpus([history], spec),
+                       platform="cpu")
+    return build_host_backend(spec, plan)
+
+
+def bench_batched(spec, corpus) -> dict:
+    from qsm_tpu.shrink import shrink_history, verify_certificate
+
+    rows = []
+    wrong = 0
+    t0 = time.perf_counter()
+    for h in corpus:
+        res = shrink_history(spec, h, max_rounds=MAX_ROUNDS,
+                             certificate=True)
+        audit = verify_certificate(spec, res.history,
+                                   res.certificate or [])
+        ok = (res.ok and res.complete and res.one_minimal
+              and audit["one_minimal_proved"]
+              and audit["violation_reconfirmed"])
+        if not ok:
+            wrong += 1
+        rows.append({
+            "initial_ops": res.initial_ops, "final_ops": res.final_ops,
+            "rounds": res.rounds, "engine_calls": res.engine_calls,
+            "lanes": res.lanes_checked, "memo_hits": res.memo_hits,
+            "one_minimal": res.one_minimal,
+            "witnesses_replayed": audit["witnesses_replayed"],
+            "violation_reconfirmed": audit["violation_reconfirmed"],
+            "fingerprint": hash(res.history.fingerprint()) & 0xffffffff,
+        })
+    return {
+        "histories": len(corpus),
+        "seconds": round(time.perf_counter() - t0, 3),
+        "engine_calls": sum(r["engine_calls"] for r in rows),
+        "lanes": sum(r["lanes"] for r in rows),
+        "rounds": sum(r["rounds"] for r in rows),
+        "memo_hits": sum(r["memo_hits"] for r in rows),
+        "mean_ratio": round(sum(r["final_ops"] / r["initial_ops"]
+                                for r in rows) / max(len(rows), 1), 4),
+        "wrong_verdicts": wrong,
+        "per_history": rows,
+    }
+
+
+def _naive_one_at_a_time(spec, engine, history):
+    """The same greedy loop as shrinker.py — same frontier, same
+    smallest-still-failing selection — but every candidate is its own
+    engine call and nothing is memoised: the reference's shrink shape.
+    Returns (minimized, engine_calls, first_accept_calls) where
+    ``first_accept_calls`` prices the stop-at-first-failure variant of
+    the same scan order (a different algorithm, reported for honesty)."""
+    from qsm_tpu.ops.backend import Verdict
+    from qsm_tpu.shrink import shrink_frontier
+
+    calls = 0
+    fa_calls = 0
+
+    def check_one(h):
+        return int(engine.check_histories(spec, [h])[0])
+
+    v = check_one(history)
+    calls += 1
+    fa_calls += 1
+    best = history
+    if v != int(Verdict.VIOLATION):
+        return best, calls, fa_calls
+    for _round in range(MAX_ROUNDS):
+        cands, _trunc = shrink_frontier(spec, best)
+        if not cands:
+            break
+        verdicts = []
+        fa_counted = False
+        for c in cands:  # one engine call per candidate: the baseline
+            verdicts.append(check_one(c.history))
+            calls += 1
+            if not fa_counted:
+                fa_calls += 1
+                if verdicts[-1] == int(Verdict.VIOLATION):
+                    fa_counted = True  # first-accept would stop here
+        fail = next((i for i, vv in enumerate(verdicts)
+                     if vv == int(Verdict.VIOLATION)), None)
+        if fail is None:
+            break
+        best = cands[fail].history
+    return best, calls, fa_calls
+
+
+def bench_naive(spec, corpus, batched_row) -> dict:
+    eng = _engine(spec, corpus[0])
+    rows = []
+    mismatches = 0
+    t0 = time.perf_counter()
+    for h, brow in zip(corpus, batched_row["per_history"]):
+        mh, calls, fa_calls = _naive_one_at_a_time(spec, eng, h)
+        same = (hash(mh.fingerprint()) & 0xffffffff
+                == brow["fingerprint"])
+        if not same:
+            mismatches += 1
+        rows.append({"engine_calls": calls,
+                     "first_accept_calls": fa_calls,
+                     "final_ops": len(mh), "identical_to_batched": same})
+    return {
+        "histories": len(corpus),
+        "seconds": round(time.perf_counter() - t0, 3),
+        "engine_calls": sum(r["engine_calls"] for r in rows),
+        "first_accept_calls": sum(r["first_accept_calls"] for r in rows),
+        "mismatched_results": mismatches,
+        "per_history": rows,
+    }
+
+
+def bench_serve(corpus, batched_row) -> dict:
+    """The shrink verb over shared lanes: identical minimized rows to
+    the in-process path, duplicate answered from the bank."""
+    import tempfile
+
+    from qsm_tpu.serve.client import CheckClient
+    from qsm_tpu.serve.protocol import history_to_rows, rows_to_history
+    from qsm_tpu.serve.server import CheckServer
+    from qsm_tpu.shrink import shrink_history
+
+    tmp = tempfile.mkdtemp(prefix="qsm_bench_shrink_")
+    srv = CheckServer(unix_path=os.path.join(tmp, "sock"),
+                      cache_path=os.path.join(tmp, "bank.jsonl")).start()
+    from qsm_tpu.models.kv import KvSpec
+
+    spec = KvSpec(**KV)
+    wrong = 0
+    t0 = time.perf_counter()
+    try:
+        c = CheckClient(srv.address, timeout_s=SERVE_DEADLINE_S + 30)
+        try:
+            for h, brow in zip(corpus, batched_row["per_history"]):
+                r = c.shrink("kv", h, spec_kwargs=KV,
+                             deadline_s=SERVE_DEADLINE_S)
+                served = rows_to_history(r["history"]).fingerprint()
+                inproc = shrink_history(spec, h,
+                                        certificate=False).history
+                if not (r.get("ok") and r.get("complete")
+                        and served == inproc.fingerprint()
+                        and hash(served) & 0xffffffff
+                        == brow["fingerprint"]):
+                    wrong += 1
+            dup = c.shrink("kv", corpus[0], spec_kwargs=KV,
+                           deadline_s=SERVE_DEADLINE_S)
+            stats = c.stats()["stats"]
+        finally:
+            c.close()
+    finally:
+        srv.stop()
+    return {
+        "histories": len(corpus),
+        "seconds": round(time.perf_counter() - t0, 3),
+        "mismatched_results": wrong,
+        "duplicate_banked": bool(dup.get("cached")),
+        "shrink": stats["shrink"],
+        "batcher": {k: stats["batcher"][k]
+                    for k in ("batches", "lanes", "mean_occupancy")},
+    }
+
+
+def run(tag: str, out_path: str | None, resume: bool) -> dict:
+    from qsm_tpu.resilience.checkpoint import CellJournal
+
+    path = out_path or os.path.join(REPO, f"BENCH_SHRINK_{tag}.json")
+    header = {
+        "artifact": "BENCH_SHRINK",
+        "device_fallback": None,   # host-only bench: no window involved
+        "platform": "cpu",
+        "families": {"kv": {**KV, "pids": KV_PIDS, "ops": KV_OPS,
+                            "corpus": KV_CORPUS},
+                     "cas": {"pids": CAS_PIDS, "ops": CAS_OPS,
+                             "corpus": CAS_CORPUS}},
+        "max_rounds": MAX_ROUNDS,
+        "captured_iso": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+    }
+    journal = CellJournal(path, header, resume=resume)
+    fams = _families()
+    corpora = {}
+
+    def corpus_for(fam):
+        if fam not in corpora:
+            spec, sut, pids, ops, n = fams[fam]
+            corpora[fam] = _failing_corpus(spec, sut, n, pids, ops,
+                                           f"bench_shrink_{fam}")
+        return corpora[fam]
+
+    for fam in ("kv", "cas"):
+        spec = fams[fam][0]
+        if journal.complete(f"batched_{fam}") is None:
+            journal.emit(f"batched_{fam}",
+                         bench_batched(spec, corpus_for(fam)))
+        if journal.complete(f"naive_{fam}") is None:
+            journal.emit(f"naive_{fam}",
+                         bench_naive(spec, corpus_for(fam),
+                                     journal.complete(f"batched_{fam}")))
+    if journal.complete("serve_shrink") is None:
+        journal.emit("serve_shrink",
+                     bench_serve(corpus_for("kv"),
+                                 journal.complete("batched_kv")))
+
+    ratios = {}
+    wrong = 0
+    for fam in ("kv", "cas"):
+        b = journal.complete(f"batched_{fam}")
+        nv = journal.complete(f"naive_{fam}")
+        ratios[fam] = round(nv["engine_calls"]
+                            / max(b["engine_calls"], 1), 1)
+        wrong += b["wrong_verdicts"] + nv["mismatched_results"]
+    serve = journal.complete("serve_shrink")
+    wrong += serve["mismatched_results"]
+    b_kv = journal.complete("batched_kv")
+    summary = {
+        "metric": "batched_vs_one_at_a_time_engine_calls",
+        "calls_ratio_kv": ratios["kv"],
+        "calls_ratio_cas": ratios["cas"],
+        "gate_10x": all(r >= 10 for r in ratios.values()),
+        "first_accept_calls_kv": journal.complete("naive_kv")[
+            "first_accept_calls"],
+        "wrong_verdicts": wrong,
+        "mean_op_ratio_kv": b_kv["mean_ratio"],
+        "serve_identical": serve["mismatched_results"] == 0,
+        "serve_duplicate_banked": serve["duplicate_banked"],
+        "resumed_cells": journal.resumed_cells,
+    }
+    if journal.complete("summary") is None:
+        journal.emit("summary", summary)
+    print(json.dumps({"metric": summary["metric"],
+                      "calls_ratio_kv": summary["calls_ratio_kv"],
+                      "calls_ratio_cas": summary["calls_ratio_cas"],
+                      "gate_10x": summary["gate_10x"],
+                      "wrong_verdicts": wrong,
+                      "artifact": os.path.basename(path)}))
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tag", default="r10")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--resume", action="store_true",
+                    help="adopt completed cells from an existing "
+                         "artifact (CellJournal rails)")
+    args = ap.parse_args(argv)
+
+    from qsm_tpu.utils.device import force_cpu_platform
+
+    force_cpu_platform()
+    try:
+        run(args.tag, args.out, args.resume)
+    except Exception as e:  # noqa: BLE001 — diagnostic line, not a traceback
+        print(json.dumps({"metric": "batched_vs_one_at_a_time_engine_calls",
+                          "error": f"{type(e).__name__}: {e}"}))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

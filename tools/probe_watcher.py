@@ -95,6 +95,17 @@ PCOMP_ARTIFACT = os.path.join(REPO, f"BENCH_PCOMP_{PCOMP_ROUND}.json")
 PCOMP_MIN_ROWS = 8
 _PCOMP_STATE: dict = {"attempted": False}
 
+# Committed archive of the batched-shrink bench (tools/bench_shrink.py):
+# HOST-ONLY like the pcomp gate — racy kv/cas failing corpora,
+# frontier-at-once vs one-at-a-time — refreshed off-window on
+# CellJournal --resume rails.  Tracks its own round tag (the shrink
+# plane landed in r10), decoupled from the window artifacts' ROUND_TAG.
+SHRINK_ROUND = "r10"
+SHRINK_ARTIFACT = os.path.join(REPO, f"BENCH_SHRINK_{SHRINK_ROUND}.json")
+# full scan = (batched + naive) × 2 families + serve_shrink + summary
+SHRINK_MIN_ROWS = 6
+_SHRINK_STATE: dict = {"attempted": False}
+
 # Cached verdict of the pre-seize lint gate, keyed on a SOURCE
 # fingerprint — not process lifetime: the watcher runs all round while
 # the builder edits the very specs/kernels the analysis covers, so a
@@ -263,6 +274,36 @@ def _maybe_archive_pcomp(timeout: float = 1800.0) -> None:
         # process resumes from there
         _log(event="pcomp_bench", ok=False,
              rows=_tool_rows(PCOMP_ARTIFACT),
+             detail=f"{type(e).__name__}: {e}")
+
+
+def _maybe_archive_shrink(timeout: float = 1800.0) -> None:
+    """Off-window: (re)bank the batched-shrink artifact when it is
+    missing or incomplete — the pcomp gate's twin (host CPU only, once
+    per watcher process, CellJournal --resume finishes a killed
+    partial instead of re-paying it)."""
+    if _SHRINK_STATE["attempted"]:
+        return
+    _SHRINK_STATE["attempted"] = True
+    if _tool_rows(SHRINK_ARTIFACT) >= SHRINK_MIN_ROWS:
+        _log(event="shrink_bench", ok=True, detail="already banked; kept")
+        return
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bench_shrink.py")
+    try:
+        r = subprocess.run(
+            [sys.executable, script, "--out", SHRINK_ARTIFACT,
+             "--resume"],
+            capture_output=True, text=True, timeout=timeout, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        detail = (r.stdout or r.stderr or "").strip()[-200:]
+        _log(event="shrink_bench", ok=r.returncode == 0,
+             rows=_tool_rows(SHRINK_ARTIFACT), detail=detail)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        # the journal keeps every completed cell; the next watcher
+        # process resumes from there
+        _log(event="shrink_bench", ok=False,
+             rows=_tool_rows(SHRINK_ARTIFACT),
              detail=f"{type(e).__name__}: {e}")
 
 
@@ -642,9 +683,11 @@ def main() -> int:
         # the CPU while the tunnel is (typically) wedged anyway, so a
         # later healed window is never spent on it
         _preflight_lint()
-        # same logic for the host-only pcomp bench artifact: bank it
-        # off-window so no healed window ever waits behind it
+        # same logic for the host-only pcomp/shrink bench artifacts:
+        # bank them off-window so no healed window ever waits behind
+        # them
         _maybe_archive_pcomp()
+        _maybe_archive_shrink()
     while True:
         t0 = time.time()
         _maybe_compact_probe_log()  # bounded; no-op below the threshold
