@@ -1,8 +1,8 @@
 # qsm_tpu CI/tooling entry points.
 #
 # `lint-gate` is the static-analysis gate: it runs every registered
-# qsmlint pass family (a–g, docs/ANALYSIS.md) over the full tree,
-# archives the JSON findings document to LINT_r07.json (the artifact
+# qsmlint pass family (a–i, docs/ANALYSIS.md) over the full tree,
+# archives the JSON findings document to LINT_r11.json (the artifact
 # probe_watcher also refreshes before every window seize) and FAILS
 # (exit 1) on any non-whitelisted error-severity finding.  The on-disk
 # result cache (.qsmlint-cache.json) keeps a warm full-tree run in the
@@ -11,7 +11,7 @@
 PYTHON ?= python
 # keep in lockstep with tools/probe_watcher.py LINT_ROUND (the watcher
 # archives the same document before every window seize)
-LINT_ARTIFACT ?= LINT_r07.json
+LINT_ARTIFACT ?= LINT_r11.json
 
 # P-compositionality bench (tools/bench_pcomp.py): host-only — no TPU
 # window needed — on CellJournal --resume rails; refreshes the
@@ -25,7 +25,14 @@ PCOMP_ARTIFACT ?= BENCH_PCOMP_r09.json
 # corpora: engine-call ratio, audited 1-minimality, serve-verb parity)
 SHRINK_ARTIFACT ?= BENCH_SHRINK_r10.json
 
-.PHONY: lint-gate lint-changed lint-sarif test bench-pcomp bench-shrink
+# Obs-overhead bench (tools/bench_obs.py): host-only, CellJournal
+# --resume rails; refreshes the committed BENCH_OBS artifact (serve
+# path with obs absent / tracing off / tracing on — the ≤5%
+# tracing-off gate of docs/OBSERVABILITY.md)
+OBS_ARTIFACT ?= BENCH_OBS_r11.json
+
+.PHONY: lint-gate lint-changed lint-sarif test bench-pcomp \
+	bench-shrink bench-obs bench-report
 
 lint-gate:
 	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT)
@@ -44,6 +51,15 @@ bench-pcomp:
 bench-shrink:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_shrink.py \
 		--out $(SHRINK_ARTIFACT) --resume
+
+bench-obs:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_obs.py \
+		--out $(OBS_ARTIFACT) --resume
+
+# Aggregate every committed BENCH_*.json into one per-round trend
+# table (BENCH_REPORT.md + BENCH_REPORT.json, atomic + deterministic)
+bench-report:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_report.py
 
 # the tier-1 quick lane (ROADMAP.md has the full pinned command)
 test:

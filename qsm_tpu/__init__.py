@@ -23,6 +23,10 @@ Layers (mirroring SURVEY.md §1, redesigned TPU-first):
   counterexample minimization to 1-minimal histories with
   verify_witness-replayable certificates, served as the ``shrink``
   verb (docs/SHRINK.md)
+* ``qsm_tpu.obs``      — the observability plane: request-scoped
+  spans with trace-id propagation through the whole serving stack,
+  a live metrics registry (Prometheus ``/metrics``, ``stats
+  --watch``), and a crash flight recorder (docs/OBSERVABILITY.md)
 * ``qsm_tpu.utils``    — config, structured logging, CLI
 """
 

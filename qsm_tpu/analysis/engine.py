@@ -104,6 +104,22 @@ DEFAULT_SHRINK_FILES = (
     "qsm_tpu/shrink/frontier.py", "qsm_tpu/shrink/shrinker.py",
     "tools/bench_shrink.py")
 
+# the trace-plane discipline beat (family i): everything that opens
+# spans or writes metrics — the obs plane itself, the serving stack
+# that emits through it, and the resilience layers that report into
+# the global sink
+DEFAULT_OBS_FILES = (
+    "qsm_tpu/obs/__init__.py", "qsm_tpu/obs/trace.py",
+    "qsm_tpu/obs/metrics.py", "qsm_tpu/obs/flight.py",
+    "qsm_tpu/serve/server.py", "qsm_tpu/serve/batcher.py",
+    "qsm_tpu/serve/admission.py", "qsm_tpu/serve/cache.py",
+    "qsm_tpu/serve/client.py", "qsm_tpu/serve/protocol.py",
+    "qsm_tpu/serve/pool.py", "qsm_tpu/serve/worker.py",
+    "qsm_tpu/serve/frames.py",
+    "qsm_tpu/resilience/policy.py", "qsm_tpu/resilience/failover.py",
+    "qsm_tpu/resilience/faults.py", "qsm_tpu/resilience/checkpoint.py",
+    "tools/bench_obs.py")
+
 
 def default_whitelist_path() -> str:
     return os.path.join(REPO_ROOT, ".qsmlint")
@@ -267,6 +283,12 @@ def _per_file_shrink(path: str, root: str) -> List[Finding]:
     return check_shrink_file(path, root=root)
 
 
+def _per_file_obs(path: str, root: str) -> List[Finding]:
+    from .obs_passes import check_obs_file
+
+    return check_obs_file(path, root=root)
+
+
 FAMILIES: Dict[str, Family] = {f.fid: f for f in (
     Family(fid="a", key="spec",
            title="spec soundness (parity, domains, bounds, dtypes, "
@@ -322,6 +344,12 @@ FAMILIES: Dict[str, Family] = {f.fid: f for f in (
            title="shrink-plane frontier bounds",
            files=DEFAULT_SHRINK_FILES, per_file=_per_file_shrink,
            triggers=("qsm_tpu/analysis/shrink_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+    Family(fid="i", key="obs",
+           title="trace-plane discipline (span close, metric "
+                 "cardinality)",
+           files=DEFAULT_OBS_FILES, per_file=_per_file_obs,
+           triggers=("qsm_tpu/analysis/obs_passes.py",
                      "qsm_tpu/analysis/astutil.py")),
 )}
 

@@ -488,3 +488,64 @@ class SanctionedProjectionKvSpec(KvSpec):
     flags unsound declarations, not subclassing)."""
 
     name = "sanctioned_projection_kv"
+
+
+class UnclosedSpanStub:
+    """Seeded bug for QSM-OBS-SPAN: a span opened by hand (assigned,
+    manually entered) instead of through a ``with`` — a raise between
+    open and close orphans it and the causal tree loses the stage.
+    Never executed; tests point the obs pass at this file and assert
+    the rule fires here and NOT on the sanctioned twin below."""
+
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def work(self, trace):
+        sp = self.tracer.span("work", trace)  # <-- bug: no with/return
+        sp.__enter__()
+        result = len(trace)  # a raise here would orphan the span
+        sp.__exit__(None, None, None)
+        return result
+
+
+class ClosedSpanStub:
+    """Sanctioned twin: the with-statement close (exception-safe) and
+    the delegating-return form — must stay CLEAN under QSM-OBS-SPAN."""
+
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def work(self, trace):
+        with self.tracer.span("work", trace) as sp:
+            sp.add(ok=True)
+            return len(trace)
+
+    def make_span(self, trace):
+        # the wrapper form: the CALLER's with owns the close
+        return self.tracer.span("work", trace)
+
+
+class UnboundedMetricStub:
+    """Seeded bug for QSM-OBS-CARDINALITY: metric identity synthesized
+    from per-request data — an f-string metric name keyed by a history
+    fingerprint, and a concatenated label value — each distinct value
+    mints a new time series (cardinality explosion).  Never executed."""
+
+    def bump(self, registry, fingerprint):
+        # <-- bug: one time series PER FINGERPRINT
+        registry.counter(f"qsm_hits_{fingerprint}").inc()
+
+    def observe(self, hist, key, dt):
+        hist.observe(dt, bucket="k:" + key)  # <-- bug: unbounded label
+
+
+class BoundedMetricStub:
+    """Sanctioned twin: fixed metric names, bounded label values
+    (a worker id cast with str()) — must stay CLEAN under
+    QSM-OBS-CARDINALITY."""
+
+    def bump(self, registry, wid):
+        registry.counter("qsm_hits_total").inc(wid=str(wid))
+
+    def observe(self, hist, wid, dt):
+        hist.observe(dt, wid=str(wid))

@@ -144,6 +144,15 @@ class FailoverBackend:
         self.fallback_engine = getattr(self.fallback, "name",
                                        type(self.fallback).__name__)
         self.last_error = f"{type(err).__name__}: {err}"[:200]
+        # trace plane (qsm_tpu/obs): a degradation is a component event
+        # in the serving stack's flight ring — no obs handle plumbed
+        # through engine constructors, the global sink (set by the
+        # check server) receives it; a no-sink process pays one read
+        from ..obs import emit_global
+
+        emit_global("failover.degrade", engine=self.name,
+                    fallback=self.fallback_engine,
+                    error=self.last_error)
 
     # ------------------------------------------------------------------
     def resilience(self) -> dict:

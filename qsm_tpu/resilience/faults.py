@@ -173,6 +173,15 @@ def inject(site: str) -> Optional[str]:
     if not os.environ.get(ENV_VAR):
         return None
     act = active_plane().action_for(site)
+    if act is not None:
+        # a fired rule is a flight-recorder trigger (qsm_tpu/obs): a
+        # fault drill against a live server leaves an artifact.  BEFORE
+        # acting — hang/kill would otherwise erase the evidence.  The
+        # emit is a no-op without a global obs sink, and never blocks
+        # the action itself.
+        from ..obs import emit_global
+
+        emit_global("fault.hit", site=site, action=act)
     if act == "raise":
         raise InjectedFault(site, act)
     if act == "hang":
@@ -183,3 +192,11 @@ def inject(site: str) -> Optional[str]:
 
         os.kill(os.getpid(), signal.SIGKILL)
     return act
+
+
+def fired_snapshot() -> dict:
+    """Per-site fired counts of this process' fault plane (``{}`` when
+    the plane is off) — the `stats()` / metrics "fault-site hits" feed."""
+    if not os.environ.get(ENV_VAR):
+        return {}
+    return dict(active_plane().fired)

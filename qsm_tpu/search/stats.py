@@ -75,6 +75,12 @@ class SearchStats:
     worker_faults: int = 0      # pool workers shed (crash/wedge/kill)
     # while deciding these lanes — serve/pool.py stamps it so a batch
     # that survived a worker loss says so in its own cost record
+    # span<->stats bridge (qsm_tpu/obs): trace events emitted while
+    # deciding these lanes.  The serve dispatch path stamps it into the
+    # batch's compact record and the batch's `serve.dispatch` span
+    # event carries the compact record back — observability cost is
+    # accounted like any other search cost, in both directions.
+    obs_events: int = 0
 
     # -- derived -----------------------------------------------------------
     @property
@@ -100,7 +106,7 @@ class SearchStats:
                   "segments_total", "degradations", "retries",
                   "worker_faults", "pcomp_split", "pcomp_subs",
                   "pcomp_recombine_ms", "shrink_rounds", "shrink_lanes",
-                  "shrink_memo_hits"):
+                  "shrink_memo_hits", "obs_events"):
             setattr(self, f, getattr(self, f) + getattr(other, f))
         # a maximum, not a tally: the composed record's worst sub-history
         # is the worst either side saw
@@ -158,6 +164,10 @@ class SearchStats:
             "shl": self.shrink_lanes,
             "shm": self.shrink_memo_hits,
             "sho": self.shrink_ratio_pct,
+            # span<->stats bridge: trace events this record's work
+            # emitted (qsm_tpu/obs) — a traced batch's cost record
+            # says what the tracing itself cost
+            "obe": self.obs_events,
         }
 
     def to_timings(self) -> Dict[str, float]:
@@ -195,6 +205,11 @@ class SearchStats:
             out["shrink_lanes"] = float(self.shrink_lanes)
             out["shrink_memo_hits"] = float(self.shrink_memo_hits)
             out["shrink_ratio"] = round(self.shrink_ratio_pct / 100.0, 3)
+        # span-bridge accounting only when tracing actually emitted —
+        # zeros would claim "traced, emitted nothing" on every
+        # tracing-off run
+        if self.obs_events:
+            out["obs_events"] = float(self.obs_events)
         return out
 
 
@@ -204,7 +219,7 @@ _COUNTER_FIELDS = ("histories", "lockstep_iters", "nodes_explored",
                    "segments_split", "segments_total", "degradations",
                    "retries", "worker_faults", "pcomp_split", "pcomp_subs",
                    "pcomp_recombine_ms", "shrink_rounds", "shrink_lanes",
-                   "shrink_memo_hits")
+                   "shrink_memo_hits", "obs_events")
 # pcomp_max_sub and shrink_ratio_pct are deliberately NOT delta fields:
 # a maximum/ratio has no meaningful "per-run difference", so stats_delta
 # keeps `after`'s value.

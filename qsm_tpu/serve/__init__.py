@@ -22,10 +22,17 @@ ones — admission → micro-batch → dispatch → cache (docs/SERVING.md):
   host-ladder engines over a length-prefixed pipe protocol);
 * ``client``    — :class:`CheckClient` (``qsm-tpu submit`` / bench).
 
+Observability (qsm_tpu/obs, docs/OBSERVABILITY.md): every response
+carries a request-scoped trace id; ``--trace-log`` records the full
+causal tree (``qsm-tpu trace <id>`` rebuilds it), ``--metrics-port``
+serves live Prometheus metrics that reconcile with ``stats`` by
+construction, and ``--flight-dir`` arms the crash flight recorder.
+
 CLI: ``qsm-tpu serve`` / ``qsm-tpu submit`` (utils/cli.py); bench:
 tools/bench_serve.py (artifact ``BENCH_SERVE_r08.json``); static gates:
-the QSM-SERVE pass family (analysis/serve_passes.py) and the QSM-POOL
-family (analysis/pool_passes.py).
+the QSM-SERVE pass family (analysis/serve_passes.py), the QSM-POOL
+family (analysis/pool_passes.py) and the QSM-OBS family
+(analysis/obs_passes.py).
 """
 
 from .admission import AdmissionController

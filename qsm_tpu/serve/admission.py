@@ -80,11 +80,19 @@ class AdmissionController:
         with self._lock:
             self.shed_deadline += 1
 
-    def shed_doc(self, req_id, reason: str) -> dict:
+    def shed_doc(self, req_id, reason: str, trace: Optional[str] = None,
+                 flight: Optional[str] = None) -> dict:
         """THE shed response payload (serve/protocol.py's refusal
         contract): explicit reason, plus the pool-state block when a
-        worker pool serves this plane."""
+        worker pool serves this plane.  ``trace`` is the request's
+        trace id and ``flight`` the most recent flight-recorder dump
+        path (when one fired) — a shed client hands the operator
+        something actionable, not a bare SHED."""
         doc = {"id": req_id, "ok": False, "shed": True, "reason": reason}
+        if trace:
+            doc["trace"] = trace
+        if flight:
+            doc["flight"] = flight
         if self.pool_state is not None:
             state = self.pool_state()
             if state:

@@ -63,6 +63,12 @@ class Lane:
     # the batch `why` stamp so a micro-batch says how many of its lanes
     # came from decomposition.
     pcomp: bool = False
+    # trace plane (qsm_tpu/obs): the request's trace id and the span id
+    # this lane's batch events parent under — how a micro-batch lands in
+    # the right place of `qsm-tpu trace <id>`'s causal tree.  Empty when
+    # tracing is off (the default); the batcher itself never reads them.
+    trace: str = ""
+    span: str = ""
 
 
 class _Group:

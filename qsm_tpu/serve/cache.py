@@ -77,6 +77,7 @@ class VerdictCache:
         self.hits = 0
         self.misses = 0
         self.compactions = 0
+        self.bank_appends = 0  # append+fsync flushes (obs metrics feed)
         self._puts_since_flush = 0
         self._dirty: List[str] = []   # banked rows awaiting one append
         self._file_rows = 0           # rows in the on-disk log
@@ -162,6 +163,7 @@ class VerdictCache:
                     "misses": self.misses,
                     "hit_rate": round(self.hits / total, 3) if total else 0.0,
                     "bank_rows": self._file_rows,
+                    "bank_appends": self.bank_appends,
                     "compactions": self.compactions,
                     "path": self.path}
 
@@ -185,6 +187,7 @@ class VerdictCache:
                 f.flush()
                 os.fsync(f.fileno())
             self._file_rows += len(self._dirty)
+            self.bank_appends += 1
         self._dirty.clear()
         self._puts_since_flush = 0
 

@@ -36,9 +36,12 @@ class CheckClient:
               histories: Sequence[Union[History, Sequence[Sequence[int]]]],
               *, spec_kwargs: Optional[dict] = None, witness: bool = False,
               deadline_s: Optional[float] = None,
-              req_id: Optional[str] = None) -> dict:
+              req_id: Optional[str] = None,
+              trace: Optional[str] = None) -> dict:
         """Submit one corpus; returns the response document (``ok`` with
-        per-history verdict names, or ``shed``/``error``)."""
+        per-history verdict names, or ``shed``/``error``).  ``trace``
+        propagates a caller-minted trace id (qsm_tpu/obs) — omitted,
+        the server mints one and the response carries it either way."""
         rows: List[list] = [
             history_to_rows(h) if isinstance(h, History) else list(h)
             for h in histories]
@@ -50,6 +53,8 @@ class CheckClient:
             req["witness"] = True
         if deadline_s is not None:
             req["deadline_s"] = deadline_s
+        if trace:
+            req["trace"] = trace
         return self._round_trip(req)
 
     def shrink(self, model: str,
@@ -57,7 +62,8 @@ class CheckClient:
                *, spec_kwargs: Optional[dict] = None,
                certificate: bool = False,
                deadline_s: Optional[float] = None,
-               req_id: Optional[str] = None) -> dict:
+               req_id: Optional[str] = None,
+               trace: Optional[str] = None) -> dict:
         """Minimize one failing history (the ``shrink`` verb,
         docs/SHRINK.md): the response carries the 1-minimal history's
         rows plus rounds/lanes/memo counters; ``certificate=True`` adds
@@ -72,6 +78,8 @@ class CheckClient:
             req["certificate"] = True
         if deadline_s is not None:
             req["deadline_s"] = deadline_s
+        if trace:
+            req["trace"] = trace
         return self._round_trip(req)
 
     def stats(self) -> dict:

@@ -12,6 +12,13 @@ This module is deliberately import-light and OUTSIDE the package's
 ``__init__`` import graph on the worker side: ``python -m
 qsm_tpu.serve.worker`` must not find its own module pre-imported by
 ``qsm_tpu.serve`` (runpy's double-import warning).
+
+Frames are plain JSON dicts, so the schema is extensible by optional
+keys: the trace plane (qsm_tpu/obs) adds an OPTIONAL ``trace`` field
+to ``check`` frames — the trace ids of the micro-batch's request(s) —
+which new workers echo in their response and old workers simply
+ignore (a dict key nobody reads).  Version skew in either direction
+stays harmless.
 """
 
 from __future__ import annotations

@@ -232,7 +232,8 @@ class WorkerPool:
                  spawn_timeout_s: float = 60.0,
                  max_respawns: int = 8,
                  respawn_backoff_s: float = 0.5,
-                 max_backoff_s: float = 30.0):
+                 max_backoff_s: float = 30.0,
+                 obs=None):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
         self.n_workers = n_workers
@@ -254,6 +255,17 @@ class WorkerPool:
         self.worker_faults = 0     # sheds + error answers, dispatch level
         self.respawns = 0
         self.quarantines = 0
+        # trace plane (qsm_tpu/obs): span events for dispatch/shed/
+        # respawn/quarantine feed the flight-recorder ring (a SIGKILLed
+        # worker's dump shows the doomed dispatch's trace ids), and the
+        # per-worker round-trip latency histogram feeds live metrics.
+        # All emission guarded by obs.on — the no-obs path pays one
+        # attribute read per dispatch.
+        self._obs = obs
+        self._m_dispatch = (obs.metrics.histogram(
+            "qsm_pool_dispatch_seconds",
+            "per-worker micro-batch dispatch round-trip seconds")
+            if obs is not None else None)
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "WorkerPool":
@@ -340,8 +352,16 @@ class WorkerPool:
             slot.handle = WorkerHandle(slot.index, proc)
         return True
 
+    def _emit(self, name: str, trace: str = "", **attrs) -> None:
+        """One obs event (no-op without an obs handle or with tracing
+        off — a single attribute read either way)."""
+        if self._obs is None or not self._obs.on:
+            return
+        self._obs.event(name, trace=trace, **attrs)
+
     def _shed(self, handle: WorkerHandle, spec_key: Optional[str],
-              err: BaseException) -> None:
+              err: BaseException,
+              traces: Optional[List[str]] = None) -> None:
         """A worker is lost (crash or wedge): kill it like a wedged
         chip, count it, schedule the bounded respawn, and quarantine
         the spec when it has now killed ``quarantine_after`` workers."""
@@ -357,13 +377,24 @@ class WorkerPool:
             now = time.monotonic()
             slot.next_respawn_at = now + slot.backoff_s
             slot.backoff_s = min(slot.backoff_s * 2, self.max_backoff_s)
+            quarantined_now = False
+            n_crash = 0
             if spec_key is not None:
-                n = self.spec_crashes.get(spec_key, 0) + 1
-                self.spec_crashes[spec_key] = n
-                if (n >= self.quarantine_after
+                n_crash = self.spec_crashes.get(spec_key, 0) + 1
+                self.spec_crashes[spec_key] = n_crash
+                if (n_crash >= self.quarantine_after
                         and spec_key not in self.quarantined):
                     self.quarantined.add(spec_key)
                     self.quarantines += 1
+                    quarantined_now = True
+        # worker.shed / pool.quarantine are flight-recorder DUMP
+        # triggers (qsm_tpu/obs): the dump's last events include the
+        # doomed dispatch's trace ids (worker.dispatch rode the ring)
+        self._emit("worker.shed", wid=handle.wid, spec=spec_key,
+                   error=f"{type(err).__name__}: {err}"[:200],
+                   traces=traces or [])
+        if quarantined_now:
+            self._emit("pool.quarantine", spec=spec_key, crashes=n_crash)
         proc = handle.proc
         try:
             # SIGKILL, not terminate: a wedged dispatch does not honor
@@ -390,6 +421,8 @@ class WorkerPool:
                         with self._lock:
                             slot.respawns += 1
                             self.respawns += 1
+                        self._emit("worker.respawn", wid=slot.index,
+                                   respawns=slot.respawns)
                         self._spawn(slot)
                     continue
                 if (now - handle.started >= self.HEALTHY_RESET_S
@@ -447,7 +480,8 @@ class WorkerPool:
         return fallback
 
     def dispatch(self, spec_key: str, model: str, spec_kwargs: dict,
-                 rows: List[list], width: int) -> Optional[dict]:
+                 rows: List[list], width: int,
+                 traces: Optional[List[str]] = None) -> Optional[dict]:
         """Decide one micro-batch on the pool.  Returns the worker's
         response (verdicts + per-batch search/resilience stamps, plus
         ``batch_worker_faults`` — how many workers this batch burned),
@@ -455,11 +489,15 @@ class WorkerPool:
         healthy worker, ladder exhausted): the caller falls back to its
         own in-process host ladder.  Lanes are all-or-nothing per
         attempt — a lost worker banked nothing, so the whole batch is
-        the undecided remainder."""
+        the undecided remainder.  ``traces`` (the batch's request trace
+        ids, qsm_tpu/obs) ride the worker frame's optional ``trace``
+        field and every dispatch/shed event."""
         if self.is_quarantined(spec_key):
             return None
         doc = {"op": "check", "model": model, "spec_kwargs": spec_kwargs,
                "rows": rows, "width": width}
+        if traces:
+            doc["trace"] = traces
         deadline = (time.monotonic() + self.policy.deadline_s
                     if self.policy.deadline_s else None)
         tried: Set[int] = set()
@@ -478,15 +516,22 @@ class WorkerPool:
                 return None
             tried.add(handle.wid)
             handle.specs.add(spec_key)
+            self._emit("worker.dispatch", wid=handle.wid, spec=model,
+                       lanes=len(rows), traces=traces or [])
+            t0 = time.monotonic()
             try:
                 resp = handle.request(doc, timeout_s)
             except WorkerBusy:
                 continue  # working, not wedged: never shed, try the next
             except WorkerFault as e:
                 faults += 1
-                self._shed(handle, spec_key, e)
+                self._shed(handle, spec_key, e, traces=traces)
                 continue
             if resp.get("ok"):
+                if self._m_dispatch is not None:
+                    # bounded label values by construction: wid < n
+                    self._m_dispatch.observe(time.monotonic() - t0,
+                                             wid=str(handle.wid))
                 with self._lock:
                     self.dispatches += 1
                 handle.dispatches = int(resp.get("dispatches",

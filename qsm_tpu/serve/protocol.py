@@ -28,6 +28,15 @@ server refuses work it cannot finish inside the request's deadline —
 explicitly, never by silent latency collapse, and NEVER by a wrong or
 partial verdict.
 
+Trace plane (qsm_tpu/obs, docs/OBSERVABILITY.md): every ``check`` /
+``shrink`` response — including SHED — carries a ``trace`` field, the
+request-scoped trace id minted at admission (or adopted from an
+optional client-supplied ``trace`` request field).  With the server
+tracing to a span log, ``qsm-tpu trace <trace_id>`` reconstructs the
+request's full causal tree.  SHED responses additionally carry
+``flight`` — the most recent flight-recorder dump path — when one
+fired, so a shed client can hand the operator the artifact.
+
 The ``shrink`` verb (qsm_tpu/shrink, docs/SHRINK.md) answers with the
 1-minimal history's rows plus rounds/lanes/memo counters::
 

@@ -52,10 +52,12 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..core.history import History
+from ..obs import Observability, new_span_id, new_trace_id
+from ..obs import global_obs, set_global
 from ..ops.backend import Verdict, device_error_types
 from ..resilience.failover import (FailoverBackend, collect_resilience,
                                    host_fallback)
-from ..resilience.faults import inject
+from ..resilience.faults import fired_snapshot, inject
 from ..resilience.policy import RetryPolicy, preset, watchdog
 from ..search.stats import collect_search_stats, stats_delta
 from .admission import AdmissionController
@@ -199,7 +201,11 @@ class CheckServer:
                  workers: int = 0,
                  worker_policy: Optional[RetryPolicy] = None,
                  quarantine_after: int = 2,
-                 pcomp: bool = True):
+                 pcomp: bool = True,
+                 trace_log: Optional[str] = None,
+                 flight_dir: Optional[str] = None,
+                 metrics_port: Optional[int] = None,
+                 obs: Optional[Observability] = None):
         if engine not in ("auto", "planned"):
             raise ValueError(f"unknown serve engine {engine!r}; "
                              "one of ('auto', 'planned')")
@@ -214,13 +220,27 @@ class CheckServer:
         self.max_lanes = max_lanes
         self.allow_shutdown = allow_shutdown
         self._engine_factory = engine_factory
+        # observability plane (qsm_tpu/obs, docs/OBSERVABILITY.md):
+        # metrics are ALWAYS live; span emission + the flight ring are
+        # opt-in via trace_log/flight_dir and every emit site below
+        # guards on obs.on — the tracing-off serve path must stay
+        # within noise of a no-obs build (BENCH_OBS_r11.json)
+        self.obs = obs if obs is not None else Observability(
+            trace_log=trace_log, flight_dir=flight_dir)
+        self.metrics_port = metrics_port
+        self._metrics_server = None
+        self._m_request_s = self.obs.metrics.histogram(
+            "qsm_serve_request_seconds",
+            "end-to-end request latency (admission to response)")
+        self.obs.metrics.register_collector(self._metric_samples)
         self.n_workers = max(0, int(workers))
         self.pool = None
         if self.n_workers:
             from .pool import WorkerPool
 
             self.pool = WorkerPool(self.n_workers, policy=worker_policy,
-                                   quarantine_after=quarantine_after)
+                                   quarantine_after=quarantine_after,
+                                   obs=self.obs)
         self.cache = VerdictCache(max_entries=cache_entries,
                                   path=cache_path)
         self.admission = AdmissionController(
@@ -295,6 +315,21 @@ class CheckServer:
         if self.pool is not None:
             self.pool.start()
         self.batcher.start()
+        # install as the process-global obs sink so engine layers
+        # without an obs handle (failover/hybrid degradations, the
+        # fault plane) report into this server's trace/flight rails
+        set_global(self.obs)
+        if self.metrics_port is not None:
+            from ..obs import MetricsServer
+
+            # bound to the SERVE host (loopback for unix-socket
+            # servers): the printed metrics address must be the one a
+            # scraper can actually reach
+            self._metrics_server = MetricsServer(
+                self.obs.metrics,
+                host=self.host if not self.unix_path else "127.0.0.1",
+                port=self.metrics_port).start()
+            self.metrics_port = self._metrics_server.port
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="qsm-serve-accept")
         t.start()
@@ -302,6 +337,10 @@ class CheckServer:
         return self
 
     def stop(self) -> None:
+        # the CLI stops twice by design (shutdown handler + finally);
+        # teardown below is idempotent, but the post-mortem flight dump
+        # must fire exactly once or every clean exit banks duplicates
+        first_stop = not self._stop.is_set()
         self._stop.set()
         # order matters: the batcher drains FIRST (in-flight batches
         # still need the pool), THEN the pool tears down its worker
@@ -327,6 +366,20 @@ class CheckServer:
         for t in self._threads:
             t.join(2.0)
         self.cache.flush()
+        # the post-mortem baseline dump: what was in flight at teardown
+        # (forced — a stop() artifact must not be rate-limited away)
+        if first_stop:
+            self.obs.dump_flight("server_stop", force=True)
+        # a caller-supplied Observability outlives this server: the
+        # collector must go with the server or a reused registry
+        # double-emits every serve series (and pins the dead server)
+        self.obs.metrics.unregister_collector(self._metric_samples)
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+        if global_obs() is self.obs:
+            set_global(None)
+        self.obs.close()
 
     def wait(self, timeout_s: Optional[float] = None) -> bool:
         """Block until the server stops (shutdown request / stop());
@@ -516,6 +569,16 @@ class CheckServer:
         want_witness = bool(req.get("witness"))
         deadline = self.admission.deadline_for(req.get("deadline_s"))
         self.requests += 1
+        # the request-scoped trace id: minted HERE at admission (or
+        # adopted from the client), propagated through every stage and
+        # carried by every response — docs/OBSERVABILITY.md
+        trace = str(req.get("trace") or "") or new_trace_id()
+        root = ""
+        if self.obs.on:
+            root = new_span_id()
+            self.obs.tracer.emit("request", trace=trace, span=root,
+                                 model=model, lanes=len(hists),
+                                 witness=want_witness)
 
         # engine construction/validation BEFORE admission: bad
         # spec_kwargs (or a failing device build) must never reserve
@@ -523,8 +586,12 @@ class CheckServer:
         entry = self._engine_for(model, spec_kwargs)
         spec_key = self._spec_key(model, spec_kwargs)
         if not self.admission.try_admit(len(hists)):
-            send_doc(conn, self._shed(req, "queue full"))
+            self._respond(conn, self._shed(req, "queue full", trace,
+                                           root), trace, root, t_req)
             return
+        self.obs.event("admission.admit", trace=trace, parent=root,
+                       lanes=len(hists),
+                       deadline_s=round(deadline - time.monotonic(), 3))
         pending = _PendingRequest(len(hists))
         self.histories += len(hists)
         # exactly-once release per admitted lane, whatever path resolves
@@ -545,7 +612,8 @@ class CheckServer:
         try:
             self._check_admitted(conn, req, entry, spec_key, hists,
                                  pending, deadline, want_witness,
-                                 release_lane, t_req, model)
+                                 release_lane, t_req, model, trace,
+                                 root)
         except Exception as e:
             # the request dies, its slots must not: lanes the batcher
             # owns release via their resolvers; everything else here
@@ -553,14 +621,18 @@ class CheckServer:
             for j in range(len(hists)):
                 if not pending.lane_submitted[j]:
                     release_lane(j)
-            send_doc(conn, {"id": req.get("id"), "ok": False,
-                            "error": f"{type(e).__name__}: {e}"})
+            self._respond(conn, {"id": req.get("id"), "ok": False,
+                                 "trace": trace,
+                                 "error": f"{type(e).__name__}: {e}"},
+                          trace, root, t_req, status="error")
 
     def _check_admitted(self, conn, req, entry, spec_key, hists, pending,
                         deadline, want_witness, release_lane, t_req,
-                        model) -> None:
+                        model, trace, root) -> None:
         for i, h in enumerate(hists):
             key = fingerprint_key(entry.spec, h)
+            lane_span = self.obs.event("lane", trace=trace, parent=root,
+                                       index=i, ops=len(h))
             e = self.cache.get(key)
             if e is not None and not (want_witness and e.witness is None
                                       and e.verdict
@@ -568,6 +640,9 @@ class CheckServer:
                 # O(1) banked verdict (and witness when asked for one —
                 # a hit missing a needed witness falls through to the
                 # one-search witness path below)
+                self.obs.event("cache.hit", trace=trace,
+                               parent=lane_span,
+                               verdict=VERDICT_NAMES[e.verdict])
                 pending.resolve(i, e.verdict, cached=True,
                                 witness=e.witness)
                 release_lane(i)
@@ -582,45 +657,61 @@ class CheckServer:
                     pending.dead = True
                     self.admission.shed_late()
                     self._release_unsubmitted(pending, release_lane)
-                    send_doc(conn, self._shed(req, "deadline"))
+                    self._respond(conn, self._shed(req, "deadline",
+                                                   trace, root),
+                                  trace, root, t_req)
                     return
-                if self._split_pays(entry, h):
-                    with self._pcomp_lock:
-                        if entry.pcomp is None:
-                            from ..ops.pcomp import PComp
+                with self.obs.span("witness", trace=trace,
+                                   parent=lane_span) as wsp:
+                    if self._split_pays(entry, h):
+                        with self._pcomp_lock:
+                            if entry.pcomp is None:
+                                from ..ops.pcomp import PComp
 
-                            entry.pcomp = PComp(entry.spec)
-                    before = entry.pcomp.subs_produced
-                    v, w = entry.pcomp.check_witness(entry.spec, h)
-                    with self._pcomp_lock:
-                        self.pcomp_split += 1
-                        # witness traffic's sub-histories count too, or
-                        # stats() would claim histories split into zero
-                        # sub-lanes
-                        self.pcomp_subs += (entry.pcomp.subs_produced
-                                            - before)
-                else:
-                    v, w = entry.oracle.check_witness(entry.spec, h)
+                                entry.pcomp = PComp(entry.spec)
+                        before = entry.pcomp.subs_produced
+                        v, w = entry.pcomp.check_witness(entry.spec, h)
+                        with self._pcomp_lock:
+                            self.pcomp_split += 1
+                            # witness traffic's sub-histories count
+                            # too, or stats() would claim histories
+                            # split into zero sub-lanes
+                            subs = entry.pcomp.subs_produced - before
+                            self.pcomp_subs += subs
+                        wsp.add(pcomp_subs=subs)
+                    else:
+                        v, w = entry.oracle.check_witness(entry.spec, h)
+                    wsp.add(verdict=VERDICT_NAMES[int(v)])
                 self.cache.put(key, int(v), w)
+                self.obs.event("cache.put", trace=trace,
+                               parent=lane_span,
+                               verdict=VERDICT_NAMES[int(v)])
                 pending.resolve(i, int(v), witness=w)
                 release_lane(i)
             elif self._split_pays(entry, h):
                 if not self._submit_split(entry, h, key, pending, i,
-                                          deadline, release_lane):
+                                          deadline, release_lane,
+                                          trace=trace,
+                                          parent=lane_span):
                     pending.dead = True
                     self._release_unsubmitted(pending, release_lane)
-                    send_doc(conn, self._shed(req, "batcher full"))
+                    self._respond(conn, self._shed(req, "batcher full",
+                                                   trace, root),
+                                  trace, root, t_req)
                     return
             else:
                 lane = Lane(key=key, history=h, deadline=deadline,
                             resolve=self._lane_resolver(pending, i,
-                                                        release_lane))
+                                                        release_lane),
+                            trace=trace, span=lane_span)
                 pending.lane_submitted[i] = True
                 if not self.batcher.submit(spec_key, lane):
                     pending.lane_submitted[i] = False
                     pending.dead = True
                     self._release_unsubmitted(pending, release_lane)
-                    send_doc(conn, self._shed(req, "batcher full"))
+                    self._respond(conn, self._shed(req, "batcher full",
+                                                   trace, root),
+                                  trace, root, t_req)
                     return
         if not pending.wait(deadline - time.monotonic()):
             # the deadline fired with lanes still in flight: SHED —
@@ -628,12 +719,13 @@ class CheckServer:
             # into the cache (their admission slots release there).
             pending.dead = True
             self.admission.shed_late()
-            send_doc(conn, self._shed(req, "deadline"))
+            self._respond(conn, self._shed(req, "deadline", trace,
+                                           root), trace, root, t_req)
             return
         verdicts = [int(v) for v in pending.verdicts]
         doc = {
             "id": req.get("id"), "ok": True,
-            "model": model,
+            "model": model, "trace": trace,
             "verdicts": [VERDICT_NAMES[v] for v in verdicts],
             "cached": list(pending.cached),
             "violations": sum(v == int(Verdict.VIOLATION)
@@ -649,6 +741,22 @@ class CheckServer:
             doc["witnesses"] = [
                 [list(p) for p in w] if w is not None else None
                 for w in pending.witnesses]
+        self._respond(conn, doc, trace, root, t_req)
+
+    def _respond(self, conn, doc: dict, trace: str, root: str,
+                 t_req: float, status: str = "ok") -> None:
+        """The check path's ONE terminal: closes the request's causal
+        tree with a ``response`` event and feeds the request-latency
+        histogram, then sends."""
+        dt = time.perf_counter() - t_req
+        if self.obs.on:
+            self.obs.tracer.emit(
+                "response", trace=trace, parent=root,
+                ms=round(dt * 1000.0, 3), status=status,
+                shed=bool(doc.get("shed")),
+                violations=doc.get("violations"),
+                cached=sum(bool(c) for c in doc.get("cached", ())))
+        self._m_request_s.observe(dt)
         send_doc(conn, doc)
 
     # -- P-compositional split lanes (ops/pcomp.py) --------------------
@@ -667,7 +775,8 @@ class CheckServer:
 
     def _submit_split(self, entry: _EngineEntry, h: History,
                       whole_key: str, pending: _PendingRequest, i: int,
-                      deadline: float, release_lane) -> bool:
+                      deadline: float, release_lane,
+                      trace: str = "", parent: str = "") -> bool:
         """Fan one request history out as per-key sub-lanes riding the
         PROJECTED spec's micro-batch group; verdicts recombine through a
         :class:`_SubJoin` whose completion banks the whole-history key
@@ -675,7 +784,9 @@ class CheckServer:
         (fingerprint under the projected spec), so a later history that
         changes one key re-checks that key only.  False = batcher full
         (the caller sheds; in-flight sub-lanes drain into the join,
-        which still completes and releases the admission slot)."""
+        which still completes and releases the admission slot).  The
+        request's ``trace`` rides every sub-lane: the causal tree shows
+        the split, each sub-lane's micro-batch, and the recombine."""
         from ..ops.pcomp import split_history
 
         subs = split_history(entry.spec, h)
@@ -689,13 +800,20 @@ class CheckServer:
         with self._pcomp_lock:
             self.pcomp_split += 1
             self.pcomp_subs += len(subs)
+        split_span = self.obs.event("pcomp.split", trace=trace,
+                                    parent=parent, keys=len(subs),
+                                    ops=len(h))
 
         def finish(worst: int, batch: Optional[dict]) -> None:
-            if worst in (int(Verdict.VIOLATION),
-                         int(Verdict.LINEARIZABLE)):
+            banked = worst in (int(Verdict.VIOLATION),
+                               int(Verdict.LINEARIZABLE))
+            if banked:
                 # the combined verdict banks under the WHOLE history's
                 # key too: exact duplicates stay O(1) hits
                 self.cache.put(whole_key, worst)
+            self.obs.event("pcomp.recombine", trace=trace,
+                           parent=split_span, subs=len(subs),
+                           verdict=VERDICT_NAMES[worst], banked=banked)
             pending.resolve(i, worst, batch=batch)
             release_lane(i)
 
@@ -708,15 +826,22 @@ class CheckServer:
         for key in sorted(subs):
             sub_h = subs[key]
             skey = fingerprint_key(entry.proj, sub_h)
+            sub_span = self.obs.event("sublane", trace=trace,
+                                      parent=split_span, key=key,
+                                      ops=len(sub_h))
             e = self.cache.get(skey)
             if e is not None:
                 with self._pcomp_lock:
                     self.pcomp_sub_hits += 1
+                self.obs.event("cache.hit", trace=trace,
+                               parent=sub_span,
+                               verdict=VERDICT_NAMES[e.verdict])
                 dispatched += 1
                 join.feed(e.verdict)
                 continue
             lane = Lane(key=skey, history=sub_h, deadline=deadline,
-                        resolve=join.resolver(), pcomp=True)
+                        resolve=join.resolver(), pcomp=True,
+                        trace=trace, span=sub_span)
             if not self.batcher.submit(entry.proj_group_key, lane):
                 join.abort(len(subs) - dispatched)
                 return False
@@ -760,6 +885,15 @@ class CheckServer:
         want_cert = bool(req.get("certificate"))
         deadline = self.admission.deadline_for(req.get("deadline_s"))
         self.requests += 1
+        # shrink requests are traced like check requests: one root,
+        # one `shrink.round` event per greedy frontier round, batch
+        # events for the candidate lanes parented under their round
+        trace = str(req.get("trace") or "") or new_trace_id()
+        root = ""
+        if self.obs.on:
+            root = new_span_id()
+            self.obs.tracer.emit("request", trace=trace, span=root,
+                                 model=model, op="shrink", ops=len(h))
         entry = self._engine_for(model, spec_kwargs)
         spec_key = self._spec_key(model, spec_kwargs)
         whole_key = fingerprint_key(entry.spec, h)
@@ -773,25 +907,34 @@ class CheckServer:
             with self._shrink_lock:
                 self.shrink_bank_hits += 1
             doc = {**banked, "id": req.get("id"), "cached": True,
+                   "trace": trace,
                    "seconds": round(time.perf_counter() - t_req, 4)}
             if not want_cert:
                 # a banked certificate (O(n²) witness payload) must not
                 # inflate a duplicate answer that never asked for one
                 doc.pop("certificate", None)
-            send_doc(conn, doc)
+            self._respond(conn, doc, trace, root, t_req)
             return
         if not self.admission.try_admit(1):
-            send_doc(conn, self._shed(req, "queue full"))
+            self._respond(conn, self._shed(req, "queue full", trace,
+                                           root), trace, root, t_req)
             return
         try:
             if time.monotonic() >= deadline:
                 self.admission.shed_late()
-                send_doc(conn, self._shed(req, "deadline"))
+                self._respond(conn, self._shed(req, "deadline", trace,
+                                               root), trace, root,
+                              t_req)
                 return
+            self.obs.event("admission.admit", trace=trace, parent=root,
+                           lanes=1)
 
             def decide(hists):
+                rnd = self.obs.event("shrink.round", trace=trace,
+                                     parent=root, lanes=len(hists))
                 return self._decide_candidates(entry, spec_key, hists,
-                                               deadline)
+                                               deadline, trace=trace,
+                                               parent=rnd)
 
             # bank = the verdict cache (candidates the check path — or
             # an earlier shrink — already decided are memo hits, and
@@ -814,6 +957,7 @@ class CheckServer:
                 self.shrink_memo_hits += res.memo_hits
             doc = {
                 "id": req.get("id"), "ok": True, "model": model,
+                "trace": trace,
                 "verdict": VERDICT_NAMES[int(res.verdict)],
                 "initial_ops": res.initial_ops,
                 "final_ops": res.final_ops,
@@ -840,12 +984,13 @@ class CheckServer:
                     while len(self._shrink_bank) > self.shrink_bank_entries:
                         self._shrink_bank.popitem(last=False)
             doc["seconds"] = round(time.perf_counter() - t_req, 4)
-            send_doc(conn, doc)
+            self._respond(conn, doc, trace, root, t_req)
         finally:
             self.admission.release(1)
 
     def _decide_candidates(self, entry: _EngineEntry, spec_key: str,
-                           hists, deadline: float):
+                           hists, deadline: float, trace: str = "",
+                           parent: str = ""):
         """Decide shrink-frontier candidates through the SHARED lanes:
         each candidate is one micro-batch lane (split into per-key
         sub-lanes when that pays, exactly like paying check traffic),
@@ -862,13 +1007,18 @@ class CheckServer:
             key = fingerprint_key(entry.spec, h)
             if self._split_pays(entry, h):
                 if not self._submit_split(entry, h, key, pending, i,
-                                          deadline, _noop):
+                                          deadline, _noop, trace=trace,
+                                          parent=parent):
                     pending.dead = True
                     return None
             else:
+                # candidate lanes parent their batch events directly
+                # under the frontier round (one span per candidate
+                # would flood the log at 512 lanes/round)
                 lane = Lane(key=key, history=h, deadline=deadline,
                             resolve=self._lane_resolver(pending, i,
-                                                        _noop))
+                                                        _noop),
+                            trace=trace, span=parent)
                 pending.lane_submitted[i] = True
                 if not self.batcher.submit(spec_key, lane):
                     pending.lane_submitted[i] = False
@@ -897,10 +1047,22 @@ class CheckServer:
                 release_lane(j)
                 pending.resolve(j, int(Verdict.BUDGET_EXCEEDED))
 
-    def _shed(self, req: dict, reason: str) -> dict:
+    def _shed(self, req: dict, reason: str, trace: str = "",
+              parent: str = "") -> dict:
         # the admission layer builds the payload so SHED responses gain
-        # the pool-state block when a worker pool serves this plane
-        return self.admission.shed_doc(req.get("id"), reason)
+        # the pool-state block when a worker pool serves this plane —
+        # plus the request's trace id and (when a dump fired) the
+        # flight-recorder artifact path, so a shed client hands the
+        # operator something actionable instead of a bare SHED
+        self.obs.event("admission.shed", trace=trace, parent=parent,
+                       reason=reason)
+        # a SHED storm (many sheds in a short window) is itself a
+        # flight-recorder trigger: "the server shed all night" becomes
+        # one artifact, not a grep
+        self.obs.note_shed()
+        return self.admission.shed_doc(req.get("id"), reason,
+                                       trace=trace or None,
+                                       flight=self.obs.flight_path())
 
     # -- batch dispatch (the `serve` fault site / the worker pool) -----
     def _dispatch(self, spec_key: str, lanes: List[Lane],
@@ -916,9 +1078,10 @@ class CheckServer:
                                         default=1))}
         verdicts = None
         if self.pool is not None:
+            traces = sorted({lane.trace for lane in lanes if lane.trace})
             verdicts, why = self._dispatch_pool(spec_key, model,
                                                 spec_kwargs, hists,
-                                                width, why)
+                                                width, why, traces)
         if verdicts is None:
             # no pool, a quarantined spec, or a pool that lost every
             # healthy worker for this batch: the supervisor's own host
@@ -942,18 +1105,60 @@ class CheckServer:
         # never leave a torn or wrong bank behind
         self.cache.put_many((lane.key, int(v), None)
                             for lane, v in zip(lanes, verdicts))
+        if self.obs.on:
+            # the batch lands in every member request's causal tree:
+            # one `batch` event per traced lane (flush reason + worker
+            # id — "which worker ran which micro-batch and why it
+            # flushed"), one `cache.put` per banked verdict, and ONE
+            # component-level `serve.dispatch` event carrying the
+            # batch's compact SearchStats record (the span<->stats
+            # bridge: the flight ring shows recent dispatches WITH
+            # their cost records)
+            worker = why.get("worker", "in-process")
+            # counted LOCALLY, not as a global-counter delta: concurrent
+            # dispatcher/connection threads emit through the same
+            # tracer, and a delta would book their events to this batch
+            n_emitted = 0
+            for lane, v in zip(lanes, verdicts):
+                if not lane.trace:
+                    continue
+                self.obs.event("batch", trace=lane.trace,
+                               parent=lane.span, batch=why["batch"],
+                               flush=why["flush"], lanes=why["lanes"],
+                               width=width, worker=worker, model=model)
+                n_emitted += 1
+                if int(v) in (int(Verdict.VIOLATION),
+                              int(Verdict.LINEARIZABLE)):
+                    self.obs.event("cache.put", trace=lane.trace,
+                                   parent=lane.span,
+                                   verdict=VERDICT_NAMES[int(v)])
+                    n_emitted += 1
+            self.obs.event("serve.dispatch", batch=why["batch"],
+                           flush=why["flush"], lanes=why["lanes"],
+                           worker=worker, model=model,
+                           search=why.get("search"))
+            n_emitted += 1
+            if why.get("search") is not None:
+                # the other bridge direction: the batch's own cost
+                # record says how many trace events it emitted
+                # (SearchStats.obs_events, compact key "obe")
+                why["search"]["obe"] = (why["search"].get("obe", 0)
+                                        + n_emitted)
         for lane, v in zip(lanes, verdicts):
             lane.resolve(int(v), why)
 
     def _dispatch_pool(self, spec_key: str, model: str, spec_kwargs,
-                       hists, width: int, why: dict):
+                       hists, width: int, why: dict, traces=None):
         """One micro-batch on the worker pool; ``(None, why)`` when the
-        pool cannot decide it and the host path must."""
+        pool cannot decide it and the host path must.  ``traces`` (the
+        batch's request trace ids) ride the worker frame and the pool's
+        dispatch/shed events — a SIGKILLed worker's flight dump names
+        the requests it took down."""
         from .protocol import history_to_rows
 
         pooled = self.pool.dispatch(
             spec_key, model, spec_kwargs,
-            [history_to_rows(h) for h in hists], width)
+            [history_to_rows(h) for h in hists], width, traces=traces)
         if pooled is None:
             return None, {**why, "pool": "in-process"}
         why = {**why, "worker": pooled.get("wid")}
@@ -997,6 +1202,9 @@ class CheckServer:
             # a dedicated emergency host ladder so the SERVER stays up
             # with exact verdicts, and count it
             self.serve_faults += 1
+            self.obs.event("serve.degrade", error=type(e).__name__,
+                           engine=getattr(entry.engine, "name",
+                                          type(entry.engine).__name__))
             if entry.emergency is None:
                 entry.emergency = host_fallback(entry.spec)
             verdicts = np.asarray(entry.emergency.check_histories(
@@ -1063,4 +1271,100 @@ class CheckServer:
             # quarantines) — what `qsm-tpu stats --serve` aggregates
             "pool": self.pool.snapshot() if self.pool is not None else None,
             "engines": engines,
+            # trace/flight accounting (qsm_tpu/obs): span events
+            # emitted, flight-ring occupancy, dumps fired + last path
+            "obs": self.obs.snapshot(),
+            # fault-plane hits in THIS process (resilience/faults.py) —
+            # zeros/empty unless someone is fault-drilling the server
+            "faults": fired_snapshot(),
         }
+
+    def _metric_samples(self):
+        """Scrape-time collector (obs/metrics.py): the live-metrics
+        surface derives from the SAME counters ``stats()`` reports, so
+        the ``/metrics`` endpoint and `qsm-tpu stats` reconcile by
+        construction (pinned in tests/test_obs.py)."""
+        adm = self.admission.snapshot()
+        bat = self.batcher.snapshot()
+        cache = self.cache.stats()
+        pc = self._pcomp_snapshot()
+        sh = self._shrink_snapshot()
+        c, g = "counter", "gauge"
+        out = [
+            ("qsm_serve_requests_total", c, "requests received", {},
+             float(self.requests)),
+            ("qsm_serve_histories_total", c, "history lanes received",
+             {}, float(self.histories)),
+            ("qsm_serve_faults_total", c, "serve-site degradations",
+             {}, float(self.serve_faults)),
+            ("qsm_serve_budget_resolved_total", c,
+             "engine BUDGET_EXCEEDED resolved by the oracle", {},
+             float(self.budget_resolved)),
+            ("qsm_admission_queue_depth", g, "admission lane bound",
+             {}, float(adm["queue_depth"])),
+            ("qsm_admission_in_flight", g, "admitted lanes in flight",
+             {}, float(adm["in_flight"])),
+            ("qsm_admission_admitted_lanes_total", c, "lanes admitted",
+             {}, float(adm["admitted_lanes"])),
+            ("qsm_admission_shed_total", c, "requests shed",
+             {"reason": "queue_full"}, float(adm["shed_queue"])),
+            ("qsm_admission_shed_total", c, "requests shed",
+             {"reason": "deadline"}, float(adm["shed_deadline"])),
+            ("qsm_batcher_batches_total", c, "micro-batches dispatched",
+             {}, float(bat["batches"])),
+            ("qsm_batcher_lanes_total", c, "lanes dispatched", {},
+             float(bat["lanes"])),
+            ("qsm_batcher_occupancy", g, "mean batch occupancy", {},
+             float(bat["mean_occupancy"])),
+            ("qsm_cache_entries", g, "verdict-cache live entries", {},
+             float(cache["entries"])),
+            ("qsm_cache_hits_total", c, "verdict-cache hits", {},
+             float(cache["hits"])),
+            ("qsm_cache_misses_total", c, "verdict-cache misses", {},
+             float(cache["misses"])),
+            ("qsm_cache_hit_ratio", g, "verdict-cache hit ratio", {},
+             float(cache["hit_rate"])),
+            ("qsm_pcomp_split_total", c, "request histories decomposed",
+             {}, float(pc["split"])),
+            ("qsm_pcomp_sublanes_total", c, "per-key sub-lanes produced",
+             {}, float(pc["sub_lanes"])),
+            ("qsm_shrink_requests_total", c, "shrink requests", {},
+             float(sh["requests"])),
+            ("qsm_shrink_rounds_total", c, "shrink frontier rounds",
+             {}, float(sh["rounds"])),
+            ("qsm_obs_span_events_total", c, "span events emitted", {},
+             float(self.obs.tracer.events)),
+        ]
+        if self.pool is not None:
+            pool = self.pool.snapshot()
+            out += [
+                ("qsm_pool_workers_live", g, "live pool workers", {},
+                 float(pool["live"])),
+                ("qsm_pool_dispatches_total", c, "pooled micro-batches",
+                 {}, float(pool["dispatches"])),
+                ("qsm_pool_worker_faults_total", c,
+                 "workers shed (crash/wedge/kill)", {},
+                 float(pool["worker_faults"])),
+                ("qsm_pool_respawns_total", c, "worker respawns", {},
+                 float(pool["respawns"])),
+                ("qsm_pool_quarantines_total", c, "specs quarantined",
+                 {}, float(pool["quarantines"])),
+            ]
+            out += [
+                ("qsm_pool_worker_dispatches_total", c,
+                 "per-worker dispatches", {"wid": str(w["wid"])},
+                 float(w["dispatches"]))
+                for w in pool["workers"]]
+        out += [("qsm_fault_hits_total", c, "fault-plane rules fired",
+                 {"site": site}, float(n))
+                for site, n in sorted(fired_snapshot().items())]
+        if self.obs.flight is not None:
+            fl = self.obs.flight.snapshot()
+            out += [
+                ("qsm_flight_dumps_total", c, "flight-recorder dumps",
+                 {}, float(fl["dumps"])),
+                ("qsm_flight_events_recorded_total", c,
+                 "events through the flight ring", {},
+                 float(fl["recorded"])),
+            ]
+        return out

@@ -149,12 +149,18 @@ class CheckWorker:
         verdicts = engine.check_histories(spec, padded)[:len(hists)]
         self.dispatches += 1
         st = stats_delta(collect_search_stats(engine), st0)
-        return {"seq": doc.get("seq"), "ok": True,
+        resp = {"seq": doc.get("seq"), "ok": True,
                 "verdicts": [int(v) for v in verdicts],
                 "search": st.to_compact() if st is not None else None,
                 "resilience": collect_resilience(engine),
                 "wid": self.wid, "dispatches": self.dispatches,
                 "seconds": round(time.perf_counter() - t0, 4)}
+        if "trace" in doc:
+            # the trace plane's optional frame field (serve/frames.py):
+            # echoed so a supervisor-side frame capture is attributable;
+            # workers that predate it ignore the key entirely
+            resp["trace"] = doc["trace"]
+        return resp
 
 
 def main(argv=None) -> int:

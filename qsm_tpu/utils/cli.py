@@ -475,7 +475,9 @@ def cmd_serve(args) -> int:
         flush_s=args.flush_ms / 1000.0, queue_depth=args.queue_depth,
         cache_path=args.cache, cache_entries=args.cache_entries,
         workers=args.workers, quarantine_after=args.quarantine_after,
-        pcomp=not args.no_pcomp)
+        pcomp=not args.no_pcomp,
+        trace_log=args.trace_log, flight_dir=args.flight_dir,
+        metrics_port=args.metrics_port)
     warm = [m.strip() for m in args.warm.split(",")] if args.warm else []
     warm = [m for m in warm if m]
     unknown = sorted(set(warm) - set(MODELS))
@@ -494,7 +496,12 @@ def cmd_serve(args) -> int:
                           "max_lanes": args.max_lanes,
                           "flush_ms": args.flush_ms,
                           "queue_depth": args.queue_depth,
-                          "cache": args.cache}), flush=True)
+                          "cache": args.cache,
+                          "trace_log": args.trace_log,
+                          "flight_dir": args.flight_dir,
+                          "metrics": (f"{args.host}:{server.metrics_port}"
+                                      if server.metrics_port is not None
+                                      else None)}), flush=True)
         server.wait()
     except KeyboardInterrupt:
         pass
@@ -538,6 +545,84 @@ def cmd_submit(args) -> int:
     return 2 if res.get("undecided") else 0
 
 
+def cmd_trace(args) -> int:
+    """Reconstruct ONE request's causal tree from a span log
+    (qsm_tpu/obs, docs/OBSERVABILITY.md): admission, every micro-batch
+    (flush reason + worker id), pcomp sub-lanes, the recombine, shrink
+    frontier rounds, and the cache bank — as an indented tree (default)
+    or the raw event list (``--json``).  Exit 0 when events were found,
+    1 when the trace id has none in the log."""
+    from ..obs import build_tree, load_events, render_tree
+
+    events = load_events(args.log, trace_id=args.trace_id)
+    if args.json:
+        print(json.dumps(events))
+    else:
+        if events:
+            print(f"trace {args.trace_id} ({len(events)} event(s), "
+                  f"log: {args.log})")
+            print(render_tree(build_tree(events)))
+    if not events:
+        print(f"no events for trace {args.trace_id!r} in {args.log} "
+              "(is the server running with --trace-log, and has the "
+              "log rotated twice since?)", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _render_stats_watch(doc: dict) -> str:
+    """One refresh frame of ``qsm-tpu stats --watch``: the capacity
+    numbers an operator watches, from the server's ``stats`` verb."""
+    adm = doc.get("admission") or {}
+    bat = doc.get("batcher") or {}
+    cache = doc.get("cache") or {}
+    pool = doc.get("pool")
+    pc = doc.get("pcomp") or {}
+    sh = doc.get("shrink") or {}
+    obs = doc.get("obs") or {}
+    lines = [
+        f"qsm-tpu serve {doc.get('address', '?')}  "
+        f"up {doc.get('uptime_s', 0):.0f}s  engine "
+        f"{doc.get('engine_kind', '?')}  workers "
+        f"{doc.get('workers', 0)}",
+        f"  requests {doc.get('requests', 0)}  histories "
+        f"{doc.get('histories', 0)}  serve_faults "
+        f"{doc.get('serve_faults', 0)}  worker_faults "
+        f"{doc.get('worker_faults', 0)}",
+        f"  admission: in_flight {adm.get('in_flight', 0)}/"
+        f"{adm.get('queue_depth', 0)} (peak "
+        f"{adm.get('peak_in_flight', 0)})  shed queue="
+        f"{adm.get('shed_queue', 0)} deadline="
+        f"{adm.get('shed_deadline', 0)}",
+        f"  batcher: batches {bat.get('batches', 0)}  lanes "
+        f"{bat.get('lanes', 0)}  occupancy "
+        f"{bat.get('mean_occupancy', 0.0)}",
+        f"  cache: entries {cache.get('entries', 0)}  hit_rate "
+        f"{cache.get('hit_rate', 0.0)}  bank_rows "
+        f"{cache.get('bank_rows', 0)}",
+        f"  pcomp: split {pc.get('split', 0)} -> "
+        f"{pc.get('sub_lanes', 0)} sub-lanes ("
+        f"{pc.get('sub_cache_hits', 0)} cached)  shrink: "
+        f"{sh.get('requests', 0)} req / {sh.get('rounds', 0)} rounds",
+    ]
+    if pool:
+        live = [w for w in pool.get("workers", []) if w.get("alive")]
+        lines.append(
+            f"  pool: {pool.get('live', 0)}/{pool.get('n_workers', 0)} "
+            f"live  dispatches {pool.get('dispatches', 0)}  respawns "
+            f"{pool.get('respawns', 0)}  quarantines "
+            f"{pool.get('quarantines', 0)}  "
+            + " ".join(f"w{w['wid']}:{w['dispatches']}" for w in live))
+    tracing = obs.get("tracing") or {}
+    flight = obs.get("flight") or {}
+    lines.append(
+        f"  obs: span_events {tracing.get('events', 0)}  flight_dumps "
+        f"{flight.get('dumps', 0) if flight else 0}"
+        + (f"  last_dump {flight.get('last_dump')}"
+           if flight and flight.get("last_dump") else ""))
+    return "\n".join(lines)
+
+
 def cmd_stats(args) -> int:
     """Search-cost accounting for one backend on one corpus: the
     iterations-per-history / nodes-per-history decomposition of the
@@ -554,12 +639,37 @@ def cmd_stats(args) -> int:
     if getattr(args, "serve", None):
         from ..serve.client import CheckClient
 
+        if getattr(args, "watch", False):
+            # refreshing terminal view: one frame per interval until
+            # interrupted (or --watch-count frames, for scripts/tests)
+            n = 0
+            try:
+                while True:
+                    client = CheckClient(args.serve)
+                    try:
+                        doc = client.stats().get("stats", {})
+                    finally:
+                        client.close()
+                    frame = _render_stats_watch(doc)
+                    # ANSI clear+home; a dumb pipe just gets frames
+                    # separated by the escape (still line-parseable)
+                    sys.stdout.write("\x1b[2J\x1b[H" if n else "")
+                    print(frame, flush=True)
+                    n += 1
+                    if args.watch_count and n >= args.watch_count:
+                        return 0
+                    time.sleep(max(0.2, args.interval))
+            except KeyboardInterrupt:
+                return 0
         client = CheckClient(args.serve)
         try:
             print(json.dumps(client.stats().get("stats", {})))
         finally:
             client.close()
         return 0
+    if getattr(args, "watch", False):
+        raise SystemExit("--watch needs --serve ADDR (a running "
+                         "server's stats verb is what refreshes)")
     import numpy as np
 
     from ..resilience.failover import FailoverBackend, collect_resilience
@@ -1316,7 +1426,36 @@ def main(argv=None) -> int:
                         "long histories of decomposable specs check "
                         "whole instead of as per-key sub-lanes "
                         "(docs/PCOMP.md)")
+    p.add_argument("--trace-log", default=None, metavar="PATH",
+                   help="emit request-scoped span events to this JSONL "
+                        "log (bounded rotation; qsm-tpu trace <id> "
+                        "reconstructs one request's causal tree — "
+                        "docs/OBSERVABILITY.md)")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="enable the crash flight recorder: recent span "
+                        "events per component, dumped atomically to "
+                        "FLIGHT_<ts>.json on worker crash/quarantine, "
+                        "SHED storms, fault-plane hits and stop()")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve live metrics in Prometheus exposition "
+                        "format on GET /metrics at this port (0 = "
+                        "ephemeral; printed in the serving line)")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "trace",
+        help="reconstruct one request's causal tree from a span log "
+             "(serve --trace-log; docs/OBSERVABILITY.md)")
+    p.add_argument("trace_id",
+                   help="the trace id a check/shrink/SHED response "
+                        "carried in its 'trace' field")
+    p.add_argument("--log", required=True,
+                   help="the server's --trace-log path (its .1 "
+                        "rotation predecessor is read too)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw event list instead of the tree")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser(
         "submit",
@@ -1354,7 +1493,7 @@ def main(argv=None) -> int:
                    help="comma list of registry families (default: all)")
     p.add_argument("--family", default=None,
                    help="comma list of registered pass-family ids "
-                        "(a..g; default: all — docs/ANALYSIS.md)")
+                        "(a..i; default: all — docs/ANALYSIS.md)")
     p.add_argument("--changed", nargs="?", const="HEAD", default=None,
                    metavar="REF",
                    help="lint only modules git-touched since REF "
@@ -1442,6 +1581,14 @@ def main(argv=None) -> int:
                         "(requests, batch occupancy, cache hit rate, "
                         "sheds, per-engine search/resilience counters) "
                         "instead of running a corpus")
+    p.add_argument("--watch", action="store_true",
+                   help="with --serve: a refreshing terminal view of "
+                        "the live counters (Ctrl-C exits)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--watch refresh interval seconds")
+    p.add_argument("--watch-count", type=int, default=0,
+                   help="--watch: exit after N frames (0 = forever; "
+                        "scripts/tests)")
     p.add_argument("--pids", type=int, default=None)
     p.add_argument("--ops", type=int, default=None)
     p.add_argument("--corpus", type=int, default=64)

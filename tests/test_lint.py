@@ -15,7 +15,8 @@ import pytest
 import qsm_tpu.analysis.fixtures as fixtures
 from qsm_tpu.analysis import (ERROR, FAMILIES, Finding, Whitelist,
                               run_lint)
-from qsm_tpu.analysis.engine import (DEFAULT_OPS_FILES,
+from qsm_tpu.analysis.engine import (DEFAULT_OBS_FILES,
+                                     DEFAULT_OPS_FILES,
                                      DEFAULT_POOL_FILES,
                                      DEFAULT_RACE_FILES,
                                      DEFAULT_RESILIENCE_FILES,
@@ -64,9 +65,13 @@ def test_in_tree_corpus_is_clean(report):
     assert "race" in report.passes
     # the shrink plane's frontier-bound family (h)
     assert "shrink" in report.passes
-    # a–h all registered and all ran in the default lane
-    assert sorted(FAMILIES) == list("abcdefgh")
-    assert report.families == list("abcdefgh")
+    # the trace-plane discipline family (i): span close + metric
+    # cardinality over obs/ + serve/ + resilience/
+    assert len(DEFAULT_OBS_FILES) >= 17
+    assert "obs" in report.passes
+    # a–i all registered and all ran in the default lane
+    assert sorted(FAMILIES) == list("abcdefghi")
+    assert report.families == list("abcdefghi")
     assert report.ok, "\n".join(
         f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
 
@@ -173,6 +178,45 @@ def test_unbounded_serve_loop_is_caught():
     assert len(unbounded) == 1
     assert "serve_forever_unbounded" in unbounded[0].location
     assert not by_rule  # nothing else fires on the fixture module
+
+
+def test_unclosed_span_and_unbounded_metric_are_caught():
+    """The obs pass's bulb check (family i): the hand-entered span
+    fires QSM-OBS-SPAN exactly once, and the fingerprint-minted metric
+    name + concatenated label value fire QSM-OBS-CARDINALITY exactly
+    twice; the with-statement / delegating-return span twins and the
+    constant-name / str(wid)-labeled metric twins must NOT be
+    flagged."""
+    from qsm_tpu.analysis.obs_passes import check_obs_file
+
+    findings = check_obs_file(fixtures.__file__)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule_id, []).append(f)
+    span = by_rule.pop("QSM-OBS-SPAN")
+    assert len(span) == 1 and span[0].severity == ERROR
+    assert "UnclosedSpanStub" not in span[0].location  # function-scoped
+    assert ":work:" in span[0].location
+    card = by_rule.pop("QSM-OBS-CARDINALITY")
+    assert len(card) == 2
+    assert {f.severity for f in card} == {ERROR}
+    assert not by_rule  # nothing else fires on the fixture module
+
+
+def test_obs_live_tree_is_clean():
+    """The obs plane itself, the serving stack and the resilience
+    layers all keep the span-close and bounded-cardinality
+    disciplines (the sanctioned forms the rules carve out: with-
+    statement spans, delegating returns, str()-cast bounded labels)."""
+    from qsm_tpu.analysis.obs_passes import check_obs_file
+    from qsm_tpu.analysis.engine import REPO_ROOT
+    import os
+
+    findings = []
+    for rel in DEFAULT_OBS_FILES:
+        findings += check_obs_file(os.path.join(REPO_ROOT, rel),
+                                   root=REPO_ROOT)
+    assert findings == []
 
 
 def test_unreaped_worker_pool_is_caught():

@@ -1,0 +1,217 @@
+"""SearchStats.absorb / stats_delta merge semantics over the FULL
+compact-key set (ISSUE 11 satellite).
+
+The absorb rules are load-bearing for every bench row and ``qsm-tpu
+stats`` aggregate — a composed engine's cost record is built by folding
+sub-engine records, and a field merged with the wrong rule silently
+corrupts every artifact downstream.  Three rule classes exist and each
+is pinned here field-by-field:
+
+* ADDITIVE counters (the bulk): ``a.absorb(b)`` sums them;
+* the MAX field ``pcomp_max_sub`` (compact ``pcm``): the composed
+  record's worst sub-history is the worst either side saw;
+* the MIN-merged ratio ``shrink_ratio_pct`` (compact ``sho``): the
+  composed record keeps the BEST shrink, with 0 = "never shrank"
+  treated as absent, not as a minimum;
+
+plus the first-wins strings (``plan``/``fallback_engine``), the OR'd
+``ordering`` flag, the ``count_histories`` gate, and ``stats_delta``'s
+counter-subtraction with its keep-``after`` exemptions for the
+max/ratio fields.  The new span-bridge counter ``obs_events``
+(compact ``obe``) rides the additive class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from qsm_tpu.search.stats import (SearchStats, _COUNTER_FIELDS,
+                                  collect_search_stats, stats_delta)
+
+# every additive counter absorb() folds (histories is additive too but
+# gated behind count_histories — tested separately)
+_ADDITIVE = ("lockstep_iters", "nodes_explored", "memo_prunes",
+             "memo_inserts", "compactions", "chunk_rounds", "rescued",
+             "deferred", "tail_histories", "segments_split",
+             "segments_total", "degradations", "retries",
+             "worker_faults", "pcomp_split", "pcomp_subs",
+             "pcomp_recombine_ms", "shrink_rounds", "shrink_lanes",
+             "shrink_memo_hits", "obs_events")
+
+
+def _filled(base: int) -> SearchStats:
+    """A record with every numeric field set to a distinct value
+    derived from ``base`` — any field merged with the wrong rule (or
+    dropped) produces a visibly wrong number."""
+    st = SearchStats(engine=f"e{base}", histories=base)
+    for i, f in enumerate(_ADDITIVE):
+        setattr(st, f, base * 100 + i)
+    st.pcomp_max_sub = base * 7
+    st.shrink_ratio_pct = base * 11
+    return st
+
+
+def test_every_dataclass_counter_is_classified():
+    """Completeness gate: a counter added to SearchStats without an
+    absorb/delta classification would silently merge wrong.  Every
+    non-string, non-bool numeric field must be either additive, the
+    max field, the ratio field, or the gated histories count."""
+    classified = set(_ADDITIVE) | {"histories", "pcomp_max_sub",
+                                   "shrink_ratio_pct"}
+    numeric = {
+        f.name for f in dataclasses.fields(SearchStats)
+        if f.type == "int" and f.name not in ("",)
+    }
+    assert numeric == classified
+    # stats_delta subtracts exactly the additive set + histories; the
+    # max/ratio fields keep `after` by design
+    assert set(_COUNTER_FIELDS) == set(_ADDITIVE) | {"histories"}
+
+
+def test_absorb_additive_fields_sum():
+    a, b = _filled(1), _filled(2)
+    a.absorb(b)
+    for i, f in enumerate(_ADDITIVE):
+        assert getattr(a, f) == (100 + i) + (200 + i), f
+
+
+def test_absorb_histories_gated_by_count_histories():
+    a, b = _filled(1), _filled(2)
+    a.absorb(b)
+    assert a.histories == 1                 # default: wrapper counts
+    a2, b2 = _filled(1), _filled(2)
+    a2.absorb(b2, count_histories=True)
+    assert a2.histories == 3
+
+
+def test_absorb_pcomp_max_sub_is_max_not_sum():
+    a, b = _filled(1), _filled(2)
+    a.absorb(b)
+    assert a.pcomp_max_sub == 14            # max(7, 14), never 21
+    c, d = _filled(3), _filled(1)
+    c.absorb(d)
+    assert c.pcomp_max_sub == 21            # larger side already held
+
+
+@pytest.mark.parametrize("mine,theirs,want", [
+    (30, 20, 20),   # both shrank: keep the BEST (smallest) ratio
+    (20, 30, 20),
+    (0, 40, 40),    # 0 = "never shrank" adopts the other side
+    (40, 0, 40),    # ...and is never treated as a minimum
+    (0, 0, 0),
+])
+def test_absorb_shrink_ratio_min_merges_with_zero_as_absent(
+        mine, theirs, want):
+    a, b = SearchStats(), SearchStats()
+    a.shrink_ratio_pct, b.shrink_ratio_pct = mine, theirs
+    a.absorb(b)
+    assert a.shrink_ratio_pct == want
+
+
+def test_absorb_strings_first_wins_and_ordering_ors():
+    a = SearchStats(plan="", fallback_engine="", ordering=False)
+    b = SearchStats(plan="cpu-fine-v1", fallback_engine="memo",
+                    ordering=True)
+    a.absorb(b)
+    assert a.plan == "cpu-fine-v1"
+    assert a.fallback_engine == "memo"
+    assert a.ordering is True
+    # an already-set plan/fallback is NOT overwritten by the inner's
+    c = SearchStats(plan="outer", fallback_engine="cpp")
+    c.absorb(b)
+    assert c.plan == "outer" and c.fallback_engine == "cpp"
+
+
+def test_absorb_none_is_identity():
+    a = _filled(1)
+    before = dataclasses.asdict(a)
+    assert a.absorb(None) is a
+    assert dataclasses.asdict(a) == before
+
+
+def test_stats_delta_subtracts_counters_keeps_max_and_ratio():
+    before = _filled(1)
+    after = _filled(3)
+    d = stats_delta(after, before)
+    for i, f in enumerate(_ADDITIVE):
+        assert getattr(d, f) == (300 + i) - (100 + i), f
+    assert d.histories == 2
+    # a maximum/ratio has no per-run difference: keep `after` verbatim
+    assert d.pcomp_max_sub == after.pcomp_max_sub == 21
+    assert d.shrink_ratio_pct == after.shrink_ratio_pct == 33
+    # `after`'s originals are untouched (replace, not mutate)
+    assert after.nodes_explored == 301
+
+
+def test_stats_delta_none_handling():
+    assert stats_delta(None, _filled(1)) is None
+    st = _filled(2)
+    assert stats_delta(st, None) is st
+
+
+def test_to_compact_full_key_set_and_values():
+    """The compact record bench rows embed: every key pinned, so a
+    renamed or dropped key breaks HERE, not in an archived artifact."""
+    st = _filled(2)
+    st.ordering = True
+    st.plan = "p"
+    st.fallback_engine = "memo"
+    c = st.to_compact()
+    assert sorted(c) == sorted(
+        ("iph", "nph", "prunes", "rescued", "segs", "ord", "plan",
+         "deg", "fb", "wf", "pcs", "pcn", "pcm", "shr", "shl", "shm",
+         "sho", "obe"))
+    assert c["pcm"] == st.pcomp_max_sub
+    assert c["sho"] == st.shrink_ratio_pct
+    assert c["obe"] == st.obs_events
+    assert c["wf"] == st.worker_faults
+    assert c["iph"] == round(st.lockstep_iters / st.histories, 1)
+    assert c["nph"] == round(st.nodes_explored / st.histories, 1)
+
+
+def test_to_timings_gates_optional_blocks():
+    """Zeros must NOT emit for the gated planes (pcomp/shrink/obs/
+    resilience): a zero would claim the plane ran and did nothing on
+    every unrelated run, and would clobber the property layer's own
+    additive resilience accounting."""
+    clean = SearchStats(histories=4, nodes_explored=8)
+    t = clean.to_timings()
+    assert "pcomp_subs" not in t
+    assert "shrink_rounds" not in t
+    assert "obs_events" not in t
+    assert "resilience_degradations" not in t
+    full = _filled(2)
+    t2 = full.to_timings()
+    assert t2["pcomp_max_sub"] == float(full.pcomp_max_sub)
+    assert t2["shrink_ratio"] == round(full.shrink_ratio_pct / 100, 3)
+    assert t2["obs_events"] == float(full.obs_events)
+    assert t2["resilience_worker_faults"] == float(full.worker_faults)
+
+
+def test_absorb_round_trips_through_collect_composition():
+    """The collection path engines actually ride: a wrapper whose
+    ``search_stats`` absorbs an inner's record reports the composed
+    rules (additive + max + min-ratio) through collect_search_stats."""
+    inner = _filled(2)
+
+    class _Inner:
+        def search_stats(self):
+            return dataclasses.replace(inner)
+
+    class _Wrapper:
+        def __init__(self):
+            self.inner = _Inner()
+
+        def search_stats(self):
+            st = _filled(1)
+            st.absorb(self.inner.search_stats())
+            return st
+
+    st = collect_search_stats(_Wrapper())
+    assert st.nodes_explored == 101 + 201
+    assert st.pcomp_max_sub == 14
+    assert st.shrink_ratio_pct == 11
+    assert st.obs_events == (100 + _ADDITIVE.index("obs_events")) + (
+        200 + _ADDITIVE.index("obs_events"))

@@ -1,0 +1,222 @@
+"""Obs-overhead bench — what does the trace plane cost the serve path?
+
+ISSUE 11's acceptance bar: tracing-OFF overhead on the serve bench
+path stays within noise (≤5%) of a no-obs baseline, and tracing-ON
+cost is recorded honestly rather than assumed free.  Three cells, each
+the same workload (R rounds × N distinct cas histories through a
+single-process CheckServer over one client connection — the committed
+BENCH_SERVE shape, corpus re-seeded per round so the checking path is
+measured, not the cache):
+
+* ``no_obs``       — the pre-obs build, simulated: the server's obs
+  bundle is replaced by a null object whose every emit site is a
+  no-op and whose request-latency histogram is stubbed out, so the
+  hot path runs exactly the instructions it ran before this plane
+  existed (minus the single ``if obs.on`` branches, which cannot be
+  removed without a different build — stated, not hidden).
+* ``tracing_off``  — the production default: obs constructed, tracing
+  and flight disabled.  THE GATE CELL: its throughput must be within
+  ``GATE_PCT`` of ``no_obs``.
+* ``tracing_on``   — span log + flight ring enabled (metrics are
+  always on): the honest price of full tracing, reported with the
+  span-event count so events/history is reconstructible.
+
+Output: a resumable ``CellJournal`` committed as
+``BENCH_OBS_<tag>.json`` (``make bench-obs``; probe_watcher archives
+it off-window beside the LINT/PCOMP/SHRINK artifacts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODEL = "cas"
+PIDS, OPS = 4, 10
+CORPUS_N = 32
+ROUNDS = 6
+REPS = 3           # cell repetitions; the best rep is the cell's rate
+GATE_PCT = 5.0
+
+
+class _NullSpan:
+    id = ""
+
+    def add(self, **_a):
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_e):
+        return None
+
+
+class _NullObs:
+    """The no-obs stand-in: same surface as Observability, zero work.
+    ``metrics`` stays a real registry only because the constructor
+    registers collectors against it — nothing observes into it during
+    the bench."""
+
+    on = False
+    flight = None
+
+    def __init__(self):
+        from qsm_tpu.obs import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        self.tracer = self
+        self.events = 0
+        self.enabled = False
+
+    def span(self, *_a, **_k):
+        return _NullSpan()
+
+    def event(self, *_a, **_k):
+        return ""
+
+    def emit(self, *_a, **_k):
+        return None
+
+    def note_shed(self):
+        return None
+
+    def flight_path(self):
+        return None
+
+    def dump_flight(self, *_a, **_k):
+        return None
+
+    def close(self):
+        return None
+
+    def snapshot(self):
+        return {"tracing": {"enabled": False, "events": 0},
+                "flight": None}
+
+
+def _corpus(spec, entry, seed_prefix):
+    from qsm_tpu.utils.corpus import build_corpus
+
+    return build_corpus(
+        spec, (entry.impls["atomic"], entry.impls["racy"]),
+        n=CORPUS_N, n_pids=PIDS, max_ops=OPS, seed_prefix=seed_prefix)
+
+
+def _run_cell(kind: str, workdir: str) -> dict:
+    """One cell: build the server variant, push ROUNDS distinct corpora
+    through one client, return the best-rep rate + obs accounting."""
+    from qsm_tpu.models.registry import MODELS
+    from qsm_tpu.serve.client import CheckClient
+    from qsm_tpu.serve.server import CheckServer
+
+    entry = MODELS[MODEL]
+    spec = entry.make_spec()
+    kw = {}
+    if kind == "no_obs":
+        kw["obs"] = _NullObs()
+    elif kind == "tracing_on":
+        kw["trace_log"] = os.path.join(workdir, f"trace_{kind}.jsonl")
+        kw["flight_dir"] = os.path.join(workdir, f"flight_{kind}")
+    rep_rates = []
+    events = 0
+    for rep in range(REPS):
+        server = CheckServer(max_lanes=CORPUS_N, **kw).start()
+        try:
+            if kind == "no_obs":
+                # stub the always-on request-latency histogram too: the
+                # pre-obs build had no observe() on the request path
+                server._m_request_s = _NullHist()
+            server.warm(MODEL)
+            corpora = [
+                _corpus(spec, entry, f"bench_obs_{rep}_{r}")
+                for r in range(ROUNDS)]
+            client = CheckClient(f"127.0.0.1:{server.port}")
+            t0 = time.perf_counter()
+            for hists in corpora:
+                res = client.check(MODEL, hists, deadline_s=120)
+                assert res.get("ok"), res
+            dt = time.perf_counter() - t0
+            client.close()
+            rep_rates.append(ROUNDS * CORPUS_N / dt)
+            events = server.obs.snapshot()["tracing"].get("events", 0)
+        finally:
+            server.stop()
+    return {"cell": kind, "reps": REPS, "rounds": ROUNDS,
+            "histories": ROUNDS * CORPUS_N,
+            "rates_h_per_s": [round(r, 1) for r in rep_rates],
+            "histories_per_sec": round(max(rep_rates), 1),
+            "span_events": events}
+
+
+class _NullHist:
+    def observe(self, *_a, **_k):
+        return None
+
+
+def run(tag: str, out_path, resume: bool) -> dict:
+    from qsm_tpu.resilience.checkpoint import CellJournal
+
+    path = out_path or os.path.join(REPO, f"BENCH_OBS_{tag}.json")
+    header = {
+        "artifact": "BENCH_OBS",
+        "device_fallback": None,   # host-only bench: no window involved
+        "platform": "cpu",
+        "model": MODEL, "pids": PIDS, "ops": OPS,
+        "corpus_n": CORPUS_N, "rounds": ROUNDS, "reps": REPS,
+        "gate_pct": GATE_PCT,
+        "captured_iso": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+    }
+    journal = CellJournal(path, header, resume=resume)
+    workdir = tempfile.mkdtemp(prefix="qsm_bench_obs_")
+    cells = {}
+    for kind in ("no_obs", "tracing_off", "tracing_on"):
+        row = journal.complete(kind)
+        if row is None:
+            row = journal.emit(kind, _run_cell(kind, workdir))
+        cells[kind] = row
+    base = cells["no_obs"]["histories_per_sec"]
+    off = cells["tracing_off"]["histories_per_sec"]
+    on = cells["tracing_on"]["histories_per_sec"]
+    overhead_off = round((base - off) / base * 100.0, 2) if base else 0.0
+    overhead_on = round((base - on) / base * 100.0, 2) if base else 0.0
+    summary = {
+        "no_obs_h_per_s": base,
+        "tracing_off_h_per_s": off,
+        "tracing_on_h_per_s": on,
+        # negative = the obs-off build measured FASTER than the null
+        # baseline (pure run-to-run noise); the gate is one-sided
+        "tracing_off_overhead_pct": overhead_off,
+        "tracing_on_overhead_pct": overhead_on,
+        "gate_pct": GATE_PCT,
+        "gate_ok": overhead_off <= GATE_PCT,
+        "span_events_on": cells["tracing_on"].get("span_events", 0),
+    }
+    if journal.complete("summary") is None:
+        journal.emit("summary", summary)
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tag", default="r11")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--resume", action="store_true",
+                    help="skip cells already banked in a compatible "
+                         "prior artifact (CellJournal rails)")
+    args = ap.parse_args(argv)
+    summary = run(args.tag, args.out, args.resume)
+    print(summary)
+    return 0 if summary["gate_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

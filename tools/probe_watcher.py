@@ -80,7 +80,7 @@ CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 # interprocedural race analyzer), independent of the window artifacts'
 # ROUND_TAG — renaming those retires banked measurements, renaming this
 # just says which rule set produced the findings.
-LINT_ROUND = "r07"
+LINT_ROUND = "r11"  # family (i) — trace-plane discipline — landed r11
 LINT_ARTIFACT = os.path.join(REPO, f"LINT_{LINT_ROUND}.json")
 
 # Committed archive of the P-compositionality bench (tools/
@@ -105,6 +105,18 @@ SHRINK_ARTIFACT = os.path.join(REPO, f"BENCH_SHRINK_{SHRINK_ROUND}.json")
 # full scan = (batched + naive) × 2 families + serve_shrink + summary
 SHRINK_MIN_ROWS = 6
 _SHRINK_STATE: dict = {"attempted": False}
+
+# Committed archive of the obs-overhead bench (tools/bench_obs.py):
+# HOST-ONLY like the pcomp/shrink gates — the serve path with obs
+# absent / tracing off / tracing on — refreshed off-window on
+# CellJournal --resume rails so windows archive a trace/metrics cost
+# snapshot beside the BENCH/LINT artifacts.  Tracks its own round tag
+# (the trace plane landed in r11).
+OBS_ROUND = "r11"
+OBS_ARTIFACT = os.path.join(REPO, f"BENCH_OBS_{OBS_ROUND}.json")
+# full scan = no_obs + tracing_off + tracing_on + summary
+OBS_MIN_ROWS = 4
+_OBS_STATE: dict = {"attempted": False}
 
 # Cached verdict of the pre-seize lint gate, keyed on a SOURCE
 # fingerprint — not process lifetime: the watcher runs all round while
@@ -247,64 +259,54 @@ def _maybe_compact_probe_log() -> None:
              rows_before=rows, detail=f"{type(e).__name__}: {e}")
 
 
-def _maybe_archive_pcomp(timeout: float = 1800.0) -> None:
-    """Off-window: (re)bank the P-compositionality artifact when it is
-    missing or incomplete.  Once per watcher process — the bench is
-    minutes of host CPU, and CellJournal --resume means a partial from
-    a killed attempt is finished, not re-paid.  Device probing is
-    untouched (this is host work; the tunnel's state is irrelevant)."""
-    if _PCOMP_STATE["attempted"]:
+def _maybe_archive(state: dict, artifact: str, script_name: str,
+                   min_rows: int, event: str, timeout: float) -> None:
+    """Off-window: (re)bank one host-only CellJournal bench artifact
+    when it is missing or incomplete.  Once per watcher process (the
+    benches are minutes of host CPU), and --resume means a partial
+    from a killed attempt is finished, not re-paid.  Device probing is
+    untouched (host work; the tunnel's state is irrelevant)."""
+    if state["attempted"]:
         return
-    _PCOMP_STATE["attempted"] = True
-    if _tool_rows(PCOMP_ARTIFACT) >= PCOMP_MIN_ROWS:
-        _log(event="pcomp_bench", ok=True, detail="already banked; kept")
+    state["attempted"] = True
+    if _tool_rows(artifact) >= min_rows:
+        _log(event=event, ok=True, detail="already banked; kept")
         return
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "bench_pcomp.py")
+                          script_name)
     try:
         r = subprocess.run(
-            [sys.executable, script, "--out", PCOMP_ARTIFACT, "--resume"],
+            [sys.executable, script, "--out", artifact, "--resume"],
             capture_output=True, text=True, timeout=timeout, cwd=REPO,
             env=dict(os.environ, JAX_PLATFORMS="cpu"))
         detail = (r.stdout or r.stderr or "").strip()[-200:]
-        _log(event="pcomp_bench", ok=r.returncode == 0,
-             rows=_tool_rows(PCOMP_ARTIFACT), detail=detail)
+        _log(event=event, ok=r.returncode == 0,
+             rows=_tool_rows(artifact), detail=detail)
     except (subprocess.TimeoutExpired, OSError) as e:
         # the journal keeps every completed cell; the next watcher
         # process resumes from there
-        _log(event="pcomp_bench", ok=False,
-             rows=_tool_rows(PCOMP_ARTIFACT),
+        _log(event=event, ok=False, rows=_tool_rows(artifact),
              detail=f"{type(e).__name__}: {e}")
+
+
+def _maybe_archive_pcomp(timeout: float = 1800.0) -> None:
+    """The P-compositionality gate artifact (tools/bench_pcomp.py)."""
+    _maybe_archive(_PCOMP_STATE, PCOMP_ARTIFACT, "bench_pcomp.py",
+                   PCOMP_MIN_ROWS, "pcomp_bench", timeout)
 
 
 def _maybe_archive_shrink(timeout: float = 1800.0) -> None:
-    """Off-window: (re)bank the batched-shrink artifact when it is
-    missing or incomplete — the pcomp gate's twin (host CPU only, once
-    per watcher process, CellJournal --resume finishes a killed
-    partial instead of re-paying it)."""
-    if _SHRINK_STATE["attempted"]:
-        return
-    _SHRINK_STATE["attempted"] = True
-    if _tool_rows(SHRINK_ARTIFACT) >= SHRINK_MIN_ROWS:
-        _log(event="shrink_bench", ok=True, detail="already banked; kept")
-        return
-    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "bench_shrink.py")
-    try:
-        r = subprocess.run(
-            [sys.executable, script, "--out", SHRINK_ARTIFACT,
-             "--resume"],
-            capture_output=True, text=True, timeout=timeout, cwd=REPO,
-            env=dict(os.environ, JAX_PLATFORMS="cpu"))
-        detail = (r.stdout or r.stderr or "").strip()[-200:]
-        _log(event="shrink_bench", ok=r.returncode == 0,
-             rows=_tool_rows(SHRINK_ARTIFACT), detail=detail)
-    except (subprocess.TimeoutExpired, OSError) as e:
-        # the journal keeps every completed cell; the next watcher
-        # process resumes from there
-        _log(event="shrink_bench", ok=False,
-             rows=_tool_rows(SHRINK_ARTIFACT),
-             detail=f"{type(e).__name__}: {e}")
+    """The batched-shrink gate artifact (tools/bench_shrink.py)."""
+    _maybe_archive(_SHRINK_STATE, SHRINK_ARTIFACT, "bench_shrink.py",
+                   SHRINK_MIN_ROWS, "shrink_bench", timeout)
+
+
+def _maybe_archive_obs(timeout: float = 900.0) -> None:
+    """The obs-overhead artifact (tools/bench_obs.py): windows always
+    have a current trace/metrics cost snapshot archived beside the
+    BENCH/LINT artifacts."""
+    _maybe_archive(_OBS_STATE, OBS_ARTIFACT, "bench_obs.py",
+                   OBS_MIN_ROWS, "obs_bench", timeout)
 
 
 def _run_window_bench(bench_timeout: float, extra_args, label: str,
@@ -688,6 +690,7 @@ def main() -> int:
         # them
         _maybe_archive_pcomp()
         _maybe_archive_shrink()
+        _maybe_archive_obs()
     while True:
         t0 = time.time()
         _maybe_compact_probe_log()  # bounded; no-op below the threshold
