@@ -1,8 +1,8 @@
 # qsm_tpu CI/tooling entry points.
 #
 # `lint-gate` is the static-analysis gate: it runs every registered
-# qsmlint pass family (a–i, docs/ANALYSIS.md) over the full tree,
-# archives the JSON findings document to LINT_r11.json (the artifact
+# qsmlint pass family (a–j, docs/ANALYSIS.md) over the full tree,
+# archives the JSON findings document to LINT_r12.json (the artifact
 # probe_watcher also refreshes before every window seize) and FAILS
 # (exit 1) on any non-whitelisted error-severity finding.  The on-disk
 # result cache (.qsmlint-cache.json) keeps a warm full-tree run in the
@@ -11,7 +11,7 @@
 PYTHON ?= python
 # keep in lockstep with tools/probe_watcher.py LINT_ROUND (the watcher
 # archives the same document before every window seize)
-LINT_ARTIFACT ?= LINT_r11.json
+LINT_ARTIFACT ?= LINT_r12.json
 
 # P-compositionality bench (tools/bench_pcomp.py): host-only — no TPU
 # window needed — on CellJournal --resume rails; refreshes the
@@ -31,8 +31,15 @@ SHRINK_ARTIFACT ?= BENCH_SHRINK_r10.json
 # tracing-off gate of docs/OBSERVABILITY.md)
 OBS_ARTIFACT ?= BENCH_OBS_r11.json
 
+# Fleet soak (tools/bench_fleet.py): host-only, CellJournal --resume
+# rails; refreshes the committed BENCH_FLEET artifact (1/2/3-node
+# fleets on a recorded check+shrink+pcomp mix with kill-node-mid-soak,
+# wedge, partition and rolling-restart chaos cells — zero wrong
+# verdicts, zero lost banked verdicts; docs/SERVING.md "Fleet")
+FLEET_ARTIFACT ?= BENCH_FLEET_r12.json
+
 .PHONY: lint-gate lint-changed lint-sarif test bench-pcomp \
-	bench-shrink bench-obs bench-report
+	bench-shrink bench-obs bench-fleet bench-report
 
 lint-gate:
 	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT)
@@ -55,6 +62,10 @@ bench-shrink:
 bench-obs:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_obs.py \
 		--out $(OBS_ARTIFACT) --resume
+
+bench-fleet:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_fleet.py \
+		--out $(FLEET_ARTIFACT) --resume
 
 # Aggregate every committed BENCH_*.json into one per-round trend
 # table (BENCH_REPORT.md + BENCH_REPORT.json, atomic + deterministic)

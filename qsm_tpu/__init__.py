@@ -27,6 +27,12 @@ Layers (mirroring SURVEY.md §1, redesigned TPU-first):
   spans with trace-id propagation through the whole serving stack,
   a live metrics registry (Prometheus ``/metrics``, ``stats
   --watch``), and a crash flight recorder (docs/OBSERVABILITY.md)
+* ``qsm_tpu.fleet``    — the multi-node serving tier: a
+  protocol-identical router over N check-server nodes with
+  consistent-hash routing by the verdict-cache identity, node
+  quarantine/re-admission, bounded node-loss re-dispatch, and a
+  segmented replicated verdict log with anti-entropy catch-up
+  (docs/SERVING.md "Fleet")
 * ``qsm_tpu.utils``    — config, structured logging, CLI
 """
 

@@ -66,7 +66,11 @@ DEFAULT_SERVE_FILES = (
     "qsm_tpu/serve/admission.py", "qsm_tpu/serve/cache.py",
     "qsm_tpu/serve/client.py", "qsm_tpu/serve/protocol.py",
     "qsm_tpu/serve/pool.py", "qsm_tpu/serve/worker.py",
-    "qsm_tpu/serve/frames.py", "tools/bench_serve.py")
+    "qsm_tpu/serve/frames.py", "tools/bench_serve.py",
+    # the fleet tier accepts connections and loops over node links —
+    # same accept/recv/queue discipline as the single-node plane
+    "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/membership.py",
+    "qsm_tpu/fleet/replog.py", "tools/bench_fleet.py")
 # the worker-lifecycle modules the pool passes cover: everything that
 # spawns, supervises, or benches worker processes
 DEFAULT_POOL_FILES = (
@@ -94,8 +98,13 @@ DEFAULT_RACE_FILES = (
     # dispatcher threads, and the shrink bank/counters are shared
     # across connections — same closed program
     "qsm_tpu/shrink/frontier.py", "qsm_tpu/shrink/shrinker.py",
+    # the fleet tier: router connection/group threads, the membership
+    # probe thread and the anti-entropy loop share counters, links and
+    # node records — one closed program with the serving stack
+    "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/membership.py",
+    "qsm_tpu/fleet/replog.py",
     "tools/bench_serve.py", "tools/bench_pcomp.py",
-    "tools/bench_shrink.py",
+    "tools/bench_shrink.py", "tools/bench_fleet.py",
     "tools/probe_watcher.py", "tools/soak_prune.py")
 
 # the shrink-plane modules the frontier-bound pass covers (family h):
@@ -103,6 +112,12 @@ DEFAULT_RACE_FILES = (
 DEFAULT_SHRINK_FILES = (
     "qsm_tpu/shrink/frontier.py", "qsm_tpu/shrink/shrinker.py",
     "tools/bench_shrink.py")
+
+# the fleet-tier modules the re-dispatch pass covers (family j): the
+# tier itself plus its soak bench
+DEFAULT_FLEET_FILES = (
+    "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/membership.py",
+    "qsm_tpu/fleet/replog.py", "tools/bench_fleet.py")
 
 # the trace-plane discipline beat (family i): everything that opens
 # spans or writes metrics — the obs plane itself, the serving stack
@@ -289,6 +304,12 @@ def _per_file_obs(path: str, root: str) -> List[Finding]:
     return check_obs_file(path, root=root)
 
 
+def _per_file_fleet(path: str, root: str) -> List[Finding]:
+    from .fleet_passes import check_fleet_file
+
+    return check_fleet_file(path, root=root)
+
+
 FAMILIES: Dict[str, Family] = {f.fid: f for f in (
     Family(fid="a", key="spec",
            title="spec soundness (parity, domains, bounds, dtypes, "
@@ -350,6 +371,12 @@ FAMILIES: Dict[str, Family] = {f.fid: f for f in (
                  "cardinality)",
            files=DEFAULT_OBS_FILES, per_file=_per_file_obs,
            triggers=("qsm_tpu/analysis/obs_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+    Family(fid="j", key="fleet",
+           title="fleet re-dispatch discipline (bounded attempts, "
+                 "failed-node exclusion)",
+           files=DEFAULT_FLEET_FILES, per_file=_per_file_fleet,
+           triggers=("qsm_tpu/analysis/fleet_passes.py",
                      "qsm_tpu/analysis/astutil.py")),
 )}
 

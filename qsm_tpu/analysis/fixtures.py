@@ -549,3 +549,68 @@ class BoundedMetricStub:
 
     def observe(self, hist, wid, dt):
         hist.observe(dt, wid=str(wid))
+
+
+class UnboundedRedispatchRouterStub:
+    """Seeded bug for QSM-FLEET-REDISPATCH: a while-True re-dispatch
+    loop — no attempt budget, no failed-node exclusion.  Every node
+    down (a full partition) spins this forever instead of degrading to
+    the ladder or shedding, and the ring keeps answering the same dead
+    node for the same key.  Never executed."""
+
+    def __init__(self, links):
+        self.links = links
+
+    def route(self, doc):
+        while True:  # <-- bug: unbounded, never excludes the corpse
+            link = self.links[0]
+            try:
+                return link.request(doc, 5.0)
+            except Exception:  # noqa: BLE001 — the seeded shape
+                continue
+
+
+class NonExcludingRedispatchRouterStub:
+    """Seeded bug (the second QSM-FLEET-REDISPATCH form): the attempt
+    budget exists but nothing excludes the failed node — the ring
+    walk hands the same corpse back every attempt, so the budget buys
+    nothing.  Never executed."""
+
+    def __init__(self, ring, links):
+        self.ring = ring
+        self.links = links
+
+    def route(self, key, doc):
+        for _attempt in range(3):  # bounded, but...
+            target = self.ring.node_for(key, {"n0", "n1"})
+            link = self.links[target]
+            try:
+                return link.request(doc, 5.0)
+            except Exception:  # noqa: BLE001 — ...same target next time
+                continue
+        return None
+
+
+class BoundedRedispatchRouterStub:
+    """Sanctioned twin: bounded attempts + a ``tried`` exclusion set
+    fed into the ring walk (the fleet/router.py shape) — must stay
+    CLEAN under QSM-FLEET-REDISPATCH."""
+
+    def __init__(self, ring, links):
+        self.ring = ring
+        self.links = links
+
+    def route(self, key, doc, allowed):
+        tried = set()
+        target = self.ring.node_for(key, allowed)
+        for _attempt in range(3):
+            if target is None:
+                break
+            tried.add(target)
+            link = self.links[target]
+            try:
+                return link.request(doc, 5.0)
+            except Exception:  # noqa: BLE001 — excluded, try the next
+                target = self.ring.node_for(key, allowed, exclude=tried)
+                continue
+        return None

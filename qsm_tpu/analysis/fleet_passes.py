@@ -1,0 +1,137 @@
+"""Fleet passes (pass family *j* of docs/ANALYSIS.md): re-dispatch
+discipline for the multi-node tier.
+
+The fleet router's defining move is RE-DISPATCH: a node that crashes,
+wedges or partitions mid-request loses its sub-request, and the lanes
+go to another node.  Done wrong, that move converts one dead node into
+a fleet-wide outage: an unbounded retry loop spins the router forever
+on a deterministic failure, and a loop that never EXCLUDES the node it
+just watched fail hands the same lanes back to the same corpse (the
+consistent-hash ring, by design, keeps answering the same node for the
+same key).  The router's own disciplines — bounded attempts from the
+``fleet-route`` preset, the ``tried`` exclusion set fed into the ring
+walk (fleet/router.py ``_dispatch_group``) — exist for exactly this;
+this pass family is the gate that keeps future fleet code on them.
+
+AST lint over the fleet modules and the fleet bench tool:
+
+* ``QSM-FLEET-REDISPATCH`` (error) — a loop with the re-dispatch shape
+  (a ``.request(...)``/``.dispatch(...)`` call whose exception handler
+  ``continue``s the loop) that is either:
+
+  - a constant-``True`` ``while`` — no bounded attempt budget: a
+    deterministic failure (every node partitioned) spins forever; or
+  - bounded, but with NO failed-target exclusion inside the loop — no
+    ``X.add(...)`` on a tried/excluded set and no ``exclude=`` keyword
+    on the target-picking call: the ring hands the same dead node
+    back every attempt, so the budget buys nothing.
+
+  Sanctioned form: ``for _ in range(policy.attempts)`` +
+  ``tried.add(target)`` before the request + ``node_for(key,
+  exclude=tried)`` on failure (fleet/router.py is the model).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from .astutil import attr_chain, parse_module
+from .findings import ERROR, Finding
+
+_DISPATCH_CALLS = {"request", "dispatch"}
+
+
+def _is_const_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _function_map(tree: ast.Module) -> dict:
+    owner: dict = {}
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(fn):
+                owner[id(sub)] = fn.name  # innermost wins
+    return owner
+
+
+def _loop_redispatches(loop: ast.AST) -> bool:
+    """The re-dispatch shape: a try whose body carries a dispatch-like
+    attribute call and whose handler ``continue``s the loop (failure →
+    try elsewhere), both inside this loop."""
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.Try):
+            continue
+        has_dispatch = any(
+            isinstance(sub, ast.Call)
+            and (chain := attr_chain(sub.func))
+            and chain[-1] in _DISPATCH_CALLS and len(chain) >= 2
+            for stmt in node.body for sub in ast.walk(stmt))
+        if not has_dispatch:
+            continue
+        for handler in node.handlers:
+            if any(isinstance(sub, ast.Continue)
+                   for stmt in handler.body for sub in ast.walk(stmt)):
+                return True
+    return False
+
+
+def _loop_excludes_failed(loop: ast.AST) -> bool:
+    """An exclusion discipline inside the loop: a ``X.add(...)`` call
+    (the tried/excluded set) or an ``exclude=`` keyword on any call
+    (the ring-walk form)."""
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if chain and chain[-1] == "add" and len(chain) >= 2:
+            return True
+        if any(kw.arg == "exclude" for kw in node.keywords):
+            return True
+    return False
+
+
+def check_fleet_file(path: str, root: Optional[str] = None
+                     ) -> List[Finding]:
+    tree = parse_module(path)
+    relpath = path
+    if root:
+        try:
+            relpath = os.path.relpath(path, root)
+        except ValueError:
+            pass
+    fn_of = _function_map(tree)
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.While, ast.For)):
+            continue
+        if not _loop_redispatches(node):
+            continue
+        name = fn_of.get(id(node), "<module>")
+        unbounded = (isinstance(node, ast.While)
+                     and _is_const_true(node.test))
+        if unbounded:
+            out.append(Finding(
+                ERROR, "QSM-FLEET-REDISPATCH",
+                f"{relpath}:{name}:{node.lineno}",
+                "re-dispatch loop with no bounded attempt budget — a "
+                "deterministic failure (every node down or "
+                "partitioned) spins this while-True forever instead "
+                "of degrading to the ladder or shedding",
+                "bound attempts with `for _ in range(policy.attempts)` "
+                "(the fleet-route preset) and fall through to the "
+                "in-process ladder (fleet/router.py _dispatch_group "
+                "is the model)"))
+        elif not _loop_excludes_failed(node):
+            out.append(Finding(
+                ERROR, "QSM-FLEET-REDISPATCH",
+                f"{relpath}:{name}:{node.lineno}",
+                "re-dispatch loop that never excludes the failed node "
+                "— the consistent-hash ring answers the same target "
+                "for the same key, so every attempt returns to the "
+                "corpse and the budget buys nothing",
+                "track a `tried` set (tried.add(target) before the "
+                "request) and pick the next target with "
+                "node_for(key, exclude=tried)"))
+    return out

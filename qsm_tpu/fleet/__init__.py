@@ -1,0 +1,36 @@
+"""``qsm_tpu.fleet`` — the multi-node serving tier (docs/SERVING.md
+"Fleet").
+
+The r08 worker pool scales one host; this package scales hosts while
+keeping the defining property *survival*: nodes crash, wedge,
+partition and restart while verdicts stay correct and available.
+
+* ``router``     — :class:`FleetRouter`: the existing client protocol
+  unchanged in front of N CheckServer nodes; consistent-hash routing
+  by the verdict-cache identity, bounded exclude-and-re-dispatch on
+  node loss, the router's own host ladder as the last rung, SHED with
+  the per-node health block;
+* ``membership`` — :class:`Membership` / :class:`HashRing`: bounded
+  health probes (``fleet-probe`` preset), one-way quarantine after
+  repeated wedges, re-admission on sustained health, and the
+  consistent-hash routing ring;
+* ``replog``     — :class:`SegmentedLog`: the append-only verdict
+  bank generalized into content-fingerprinted segments that an
+  anti-entropy loop replicates node-to-node, enabling rolling
+  restarts with zero dropped or wrong verdicts.
+
+CLI: ``qsm-tpu fleet`` / ``qsm-tpu stats --serve ROUTER --fleet``;
+bench: tools/bench_fleet.py (artifact ``BENCH_FLEET_r12.json``);
+static gate: the QSM-FLEET pass family (analysis/fleet_passes.py).
+"""
+
+from .membership import HashRing, Membership
+from .replog import SegmentedLog, segment_fingerprint
+from .router import (FleetRouter, NodeDead, NodeFault, NodeLink,
+                     NodePartitioned, NodeTimeout)
+
+__all__ = [
+    "FleetRouter", "HashRing", "Membership", "NodeDead", "NodeFault",
+    "NodeLink", "NodePartitioned", "NodeTimeout", "SegmentedLog",
+    "segment_fingerprint",
+]

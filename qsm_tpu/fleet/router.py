@@ -1,0 +1,1127 @@
+"""Fleet router — N CheckServer nodes behind one protocol-identical door.
+
+The r08 worker pool scales one host; this tier scales hosts.  The
+router speaks the EXISTING client protocol (serve/protocol.py JSON
+lines — clients need no changes, ``CheckClient`` points at the router
+address) and fronts N :class:`~qsm_tpu.serve.server.CheckServer`
+nodes:
+
+* **Routing is the cache identity.**  Each history routes by
+  consistent hash over ``serve.cache.fingerprint_key(spec, history)``
+  — the same key the verdict bank and PR 9's per-sub-history cache
+  rows use — so identical traffic keeps landing where its verdicts
+  (and its projected-spec sub-rows) are already banked and hot
+  (``membership.HashRing``).
+* **A lost node is a shed worker.**  A node that crashes, wedges or
+  partitions mid-request fails its sub-request; the undecided lanes
+  re-dispatch to a surviving node — bounded attempts from the
+  ``fleet-route`` :data:`~qsm_tpu.resilience.policy.PRESETS` entry,
+  the failed node EXCLUDED (the ``tried`` set; the discipline the
+  QSM-FLEET-REDISPATCH lint pass gates) — and the router's own
+  in-process host cpp→memo ladder is the last rung, exactly the
+  ``serve/pool.py`` shed ladder one level up.  Nothing a dead node
+  banked is lost (banking is per-node, replicated by anti-entropy);
+  nothing undecided is ever guessed.
+* **SHED, never wrong.**  The router has its own
+  ``AdmissionController``; overload, deadline, or a fleet with no
+  deciding path left answers ``SHED`` with the per-node health block
+  (``admission.shed_doc`` ``fleet`` entry) plus the router's node id
+  and flight-dump path.
+* **Anti-entropy.**  A background loop exchanges replog segment
+  digests between nodes (the ``replog.*`` ops) and ships missing
+  segments owner→lacker, so a joining or restarted node catches up to
+  the fleet's live verdict set without a full rewrite — the mechanism
+  behind zero-verdict-loss rolling restarts (fleet/replog.py).
+* **Chaos-testable.**  Every router→node round-trip passes the
+  ``node`` fault site (``QSM_TPU_FAULTS=partition:node@5`` etc.), so
+  node death, wedge and partition cells run on the CPU platform like
+  every other degradation path (tests/test_fleet.py,
+  tools/bench_fleet.py).
+
+Observability (qsm_tpu/obs): the request's trace id rides every
+sub-request to the nodes; the router emits ``route.request`` /
+``node.dispatch`` / ``node.shed`` / ``route.hop`` / ``route.ladder``
+/ ``route.response`` events, so ``qsm-tpu trace <id>`` on the
+router's span log shows the hop from a dead node to the surviving
+one.  Node death, quarantine and partition are flight-recorder dump
+triggers; per-node dispatch counters ride the metrics registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..obs import Observability, global_obs, new_span_id, new_trace_id, \
+    set_global
+from ..ops.backend import Verdict
+from ..resilience.faults import InjectedFault, fired_snapshot, inject
+from ..resilience.policy import RetryPolicy, preset
+from ..serve.admission import AdmissionController
+from ..serve.cache import fingerprint_key
+from ..serve.protocol import (VERDICT_NAMES, LineChannel, connect,
+                              history_to_rows, rows_to_history, send_doc)
+
+
+class NodeFault(RuntimeError):
+    """A sub-request lost to a node; its lanes are undecided and the
+    router re-dispatches them (never guesses them)."""
+
+
+class NodeDead(NodeFault):
+    """Connection refused/reset/closed: the node process is gone."""
+
+
+class NodeTimeout(NodeFault):
+    """The node missed its round-trip bound: presumed wedged."""
+
+
+class NodePartitioned(NodeFault):
+    """The fault plane dropped this exchange's frames both directions
+    (``partition:node``): the request never arrived, the answer never
+    left — indistinguishable from a dead switch, handled the same."""
+
+
+class NodeBusy(RuntimeError):
+    """Every pooled link slot to this node is mid-request: router-local
+    backpressure, NOT node-health evidence (the WorkerBusy lesson one
+    level down — penalizing a hot node's health would chase traffic
+    off exactly the node doing the most work).  Callers try another
+    node without feeding membership a failure."""
+
+
+# what a router→node exchange can fail with (InjectedFault covers
+# raise:node / hang:node drills)
+_LINK_FAULTS = (NodeFault, OSError, ConnectionError, TimeoutError,
+                ValueError, InjectedFault)
+
+
+class NodeLink:
+    """Bounded connection pool to ONE node.  Each request borrows a
+    pooled (socket, channel) pair — concurrent router connections fan
+    into the node's own micro-batcher over parallel sockets — under a
+    semaphore bound; a faulted socket is discarded, never reused."""
+
+    MAX_CONNS = 16
+
+    def __init__(self, node_id: str, address: str):
+        self.node_id = node_id
+        self.address = address
+        self._free: List[Tuple[socket.socket, LineChannel]] = []
+        self._lock = threading.Lock()
+        self._sema = threading.BoundedSemaphore(self.MAX_CONNS)
+
+    def request(self, doc: dict, timeout_s: float) -> dict:
+        """One bounded round-trip.  Raises a :class:`NodeFault` family
+        member (the caller excludes this node and re-dispatches)."""
+        act = inject("node")
+        if act == "partition":
+            raise NodePartitioned(
+                f"node {self.node_id}: frames dropped both directions "
+                "(injected partition)")
+        if act == "wedge":
+            raise NodeTimeout(f"node {self.node_id}: injected wedge")
+        timeout_s = max(0.1, float(timeout_s))
+        if not self._sema.acquire(timeout=timeout_s):
+            raise NodeBusy(
+                f"node {self.node_id}: no link slot inside "
+                f"{timeout_s:.1f}s (all {self.MAX_CONNS} mid-request)")
+        try:
+            try:
+                return self._round_trip(doc, timeout_s, pooled_ok=True)
+            except NodeDead:
+                # a POOLED socket dying is expected across a node
+                # restart (the peer that owned it is gone; the node at
+                # this address may be perfectly healthy) — drop every
+                # idle pooled sibling (they died together) and retry
+                # ONCE on a FRESH connection before declaring the node
+                # lost.  Safe because every fleet op is idempotent:
+                # check/shrink/stats are pure and replog.push
+                # re-adoption is a no-op, so a request whose response
+                # was lost can be re-asked (the same reasoning behind
+                # CellJournal resume).  A fresh-connection failure is
+                # the real signal and propagates.
+                self.close_all()
+                return self._round_trip(doc, timeout_s, pooled_ok=False)
+        finally:
+            self._sema.release()
+
+    def _round_trip(self, doc: dict, timeout_s: float,
+                    pooled_ok: bool) -> dict:
+        pair: Optional[Tuple[socket.socket, LineChannel]] = None
+        try:
+            if pooled_ok:
+                with self._lock:
+                    pair = self._free.pop() if self._free else None
+            try:
+                if pair is None:
+                    sock = connect(self.address,
+                                   timeout_s=min(timeout_s, 10.0))
+                    pair = (sock, LineChannel(sock))
+                sock, chan = pair
+                send_doc(sock, doc)
+                line = chan.read_line(timeout_s=timeout_s)
+            except socket.timeout as e:
+                raise NodeTimeout(
+                    f"node {self.node_id}: round-trip exceeded "
+                    f"{timeout_s:.1f}s") from e
+            except TimeoutError as e:
+                raise NodeTimeout(f"node {self.node_id}: {e}") from e
+            except OSError as e:
+                raise NodeDead(
+                    f"node {self.node_id}: {type(e).__name__}: {e}"
+                ) from e
+            if line is None:
+                raise NodeDead(f"node {self.node_id}: connection closed")
+            try:
+                resp = json.loads(line)
+            except ValueError as e:
+                raise NodeDead(
+                    f"node {self.node_id}: undecodable response") from e
+            with self._lock:
+                if len(self._free) < self.MAX_CONNS:
+                    self._free.append(pair)
+                    pair = None
+            return resp
+        finally:
+            if pair is not None:
+                try:
+                    pair[0].close()
+                except OSError:
+                    pass
+
+    def close_all(self) -> None:
+        with self._lock:
+            pairs, self._free = self._free, []
+        for sock, _chan in pairs:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class _GroupResult:
+    """One node group's decided lanes (or None verdicts = shed)."""
+
+    __slots__ = ("verdicts", "cached", "witnesses", "batches", "node",
+                 "faults", "sheds")
+
+    def __init__(self, n: int):
+        self.verdicts: List[Optional[int]] = [None] * n
+        self.cached: List[bool] = [False] * n
+        self.witnesses: List[Optional[list]] = [None] * n
+        self.batches: List[dict] = []
+        self.node: Optional[str] = None
+        self.faults = 0
+        self.sheds = 0
+
+
+class FleetRouter:
+    """See module docstring.  ``nodes`` is ``[(node_id, address),
+    ...]``; ``start()`` binds and returns like ``CheckServer``."""
+
+    def __init__(self, nodes, host: str = "127.0.0.1", port: int = 0,
+                 unix_path: Optional[str] = None, *,
+                 policy: Optional[RetryPolicy] = None,
+                 probe_policy: Optional[RetryPolicy] = None,
+                 serve_policy: Optional[RetryPolicy] = None,
+                 ae_policy: Optional[RetryPolicy] = None,
+                 queue_depth: int = 4096,
+                 quarantine_after: int = 3,
+                 readmit_after: int = 2,
+                 heartbeat_s: float = 1.0,
+                 anti_entropy_s: float = 3.0,
+                 ae_max_segments: int = 32,
+                 allow_shutdown: bool = True,
+                 node_id: str = "router",
+                 trace_log: Optional[str] = None,
+                 flight_dir: Optional[str] = None,
+                 metrics_port: Optional[int] = None,
+                 obs: Optional[Observability] = None):
+        from .membership import Membership
+
+        if not nodes:
+            raise ValueError("a fleet needs at least one node")
+        self.host, self.port, self.unix_path = host, port, unix_path
+        self.node_id = node_id
+        self.policy = policy or preset("fleet-route")
+        self.serve_policy = serve_policy or preset("serve")
+        self.ae_policy = ae_policy or preset("anti-entropy")
+        self.anti_entropy_s = anti_entropy_s
+        self.ae_max_segments = max(1, int(ae_max_segments))
+        self.allow_shutdown = allow_shutdown
+        self.obs = obs if obs is not None else Observability(
+            trace_log=trace_log, flight_dir=flight_dir)
+        self.metrics_port = metrics_port
+        self._metrics_server = None
+        self.membership = Membership(
+            nodes, policy=probe_policy,
+            quarantine_after=quarantine_after,
+            readmit_after=readmit_after,
+            heartbeat_s=heartbeat_s, obs=self.obs)
+        self.links: Dict[str, NodeLink] = {
+            nid: NodeLink(nid, addr) for nid, addr in nodes}
+        self.admission = AdmissionController(
+            queue_depth=queue_depth, policy=self.serve_policy,
+            fleet_state=self.membership.shed_state)
+        # the last-rung in-process ladder: one warm host engine +
+        # witness oracle per spec, built lazily, dispatch-serialized
+        # (engines are stateful — the _EngineEntry discipline)
+        self._specs: Dict[str, object] = {}
+        self._ladders: Dict[str, tuple] = {}
+        # RLock: _ladder_for's build path re-enters through _spec_for
+        self._ladders_lock = threading.RLock()
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._t0 = time.monotonic()
+        # counters shared across connection threads (QSM-RACE-UNGUARDED)
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.histories = 0
+        self.shrink_requests = 0
+        self.node_faults = 0     # node exchanges lost (death/wedge/part.)
+        self.node_sheds = 0      # node answered SHED (backpressure)
+        self.redispatches = 0    # lane groups moved to another node
+        self.ladder_batches = 0  # groups the in-process rung decided
+        self.ladder_lanes = 0
+        self.ae_sweeps = 0
+        self.ae_segments_shipped = 0
+        self.ae_rows_shipped = 0
+        self._m_route_s = self.obs.metrics.histogram(
+            "qsm_fleet_route_seconds",
+            "router end-to-end request latency")
+        self.obs.metrics.register_collector(self._metric_samples)
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def address(self) -> str:
+        if self.unix_path:
+            return self.unix_path
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "FleetRouter":
+        if self.unix_path:
+            try:
+                os.unlink(self.unix_path)
+            except OSError:
+                pass
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(self.unix_path)
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((self.host, self.port))
+            self.port = self._sock.getsockname()[1]
+        self._sock.listen(64)
+        self._sock.settimeout(0.2)
+        self.membership.start()
+        # adopt the process-global obs slot only when it is free: a
+        # co-resident CheckServer (in-process tests) owns its own —
+        # the router must not silently steal its fault/degrade events
+        if global_obs() is None:
+            set_global(self.obs)
+        if self.metrics_port is not None:
+            from ..obs import MetricsServer
+
+            self._metrics_server = MetricsServer(
+                self.obs.metrics,
+                host=self.host if not self.unix_path else "127.0.0.1",
+                port=self.metrics_port).start()
+            self.metrics_port = self._metrics_server.port
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="qsm-fleet-accept")
+        t.start()
+        self._threads.append(t)
+        if self.anti_entropy_s and self.anti_entropy_s > 0:
+            t = threading.Thread(target=self._anti_entropy_loop,
+                                 daemon=True, name="qsm-fleet-ae")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        first_stop = not self._stop.is_set()
+        self._stop.set()
+        self.membership.stop()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self.unix_path:
+            try:
+                os.unlink(self.unix_path)
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(2.0)
+        for link in self.links.values():
+            link.close_all()
+        if first_stop:
+            self.obs.dump_flight("router_stop", force=True)
+        self.obs.metrics.unregister_collector(self._metric_samples)
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+        if global_obs() is self.obs:
+            set_global(None)
+        self.obs.close()
+
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        return self._stop.wait(timeout_s)
+
+    # -- connection plumbing (the CheckServer shape) -------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve_connection,
+                             args=(conn,), daemon=True,
+                             name="qsm-fleet-conn").start()
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        chan = LineChannel(conn)
+        try:
+            while not self._stop.is_set():
+                line = chan.read_line(stop=self._stop.is_set)
+                if line is None:
+                    return
+                try:
+                    req = json.loads(line)
+                except ValueError:
+                    self._send(conn, {"ok": False, "error": "bad json"})
+                    continue
+                self._handle(conn, req)
+                if req.get("op") == "shutdown" and self.allow_shutdown:
+                    return
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _send(self, conn: socket.socket, doc: dict) -> None:
+        if "node" not in doc:
+            doc = {**doc, "node": self.node_id}
+        send_doc(conn, doc)
+
+    def _handle(self, conn: socket.socket, req: dict) -> None:
+        op = req.get("op", "check")
+        if op == "stats":
+            self._send(conn, {"ok": True, "stats": self.stats()})
+        elif op == "shutdown":
+            if self.allow_shutdown:
+                self._send(conn, {"ok": True, "stopping": True})
+                self.stop()
+            else:
+                self._send(conn, {"ok": False,
+                                  "error": "shutdown disabled"})
+        elif op in ("check", "shrink"):
+            try:
+                if op == "check":
+                    self._handle_check(conn, req)
+                else:
+                    self._handle_shrink(conn, req)
+            except OSError:
+                raise
+            except Exception as e:  # noqa: BLE001 — answer, don't die
+                self._send(conn, {"id": req.get("id"), "ok": False,
+                                  "error": f"{type(e).__name__}: {e}"})
+        else:
+            self._send(conn, {"ok": False,
+                              "error": f"unknown op {op!r}"})
+
+    # -- spec / ladder plumbing ----------------------------------------
+    def _spec_key(self, model: str, spec_kwargs: dict) -> str:
+        return json.dumps([model, spec_kwargs or {}], sort_keys=True)
+
+    def _spec_for(self, model: str, spec_kwargs: dict):
+        """The spec instance routing fingerprints against — built
+        WITHOUT the ladder engines (the healthy path needs only the
+        spec; engine/oracle construction waits for the first actual
+        ladder rung)."""
+        key = self._spec_key(model, spec_kwargs)
+        with self._ladders_lock:
+            entry = self._specs.get(key)
+            if entry is None:
+                from ..models.registry import make
+
+                entry = self._specs[key] = make(
+                    model, "atomic", spec_kwargs or None)[0]
+            return entry
+
+    def _ladder_for(self, model: str, spec_kwargs: dict):
+        """(spec, host engine, witness oracle, dispatch lock) — the
+        in-process last rung, one warm set per spec, built on first
+        ladder use only."""
+        spec = self._spec_for(model, spec_kwargs)
+        key = self._spec_key(model, spec_kwargs)
+        with self._ladders_lock:
+            entry = self._ladders.get(key)
+            if entry is None:
+                from ..ops.wing_gong_cpu import WingGongCPU
+                from ..resilience.failover import host_fallback
+
+                entry = self._ladders[key] = (
+                    spec, host_fallback(spec), WingGongCPU(memo=True),
+                    threading.Lock())
+            return entry
+
+    # -- the one failover step (check AND shrink re-dispatch loops) ----
+    def _hop_busy(self, key: str, target: str, tried: Set[str],
+                  trace: str, root: str, lanes: int = 0
+                  ) -> Optional[str]:
+        """Next target after a saturated link: no health feedback (see
+        NodeBusy), just the ring walk and its span."""
+        nxt = self.membership.node_for(key, exclude=tried)
+        self.obs.event("route.hop", trace=trace, parent=root,
+                       lanes=lanes, hop_from=target,
+                       hop_to=nxt or "ladder", busy=True,
+                       traces=[trace])
+        return nxt
+
+    def _shed_node(self, key: str, target: str, tried: Set[str],
+                   e: BaseException, trace: str, root: str,
+                   lanes: int = 0) -> Optional[str]:
+        """Account one LOST node exchange and pick the next target:
+        the fault counter, membership feedback, the flight-dump
+        trigger event (node.shed / node.partition, naming the doomed
+        traces) and the route.hop span — ONE implementation for both
+        re-dispatch loops, so the safety-critical shape the
+        QSM-FLEET-REDISPATCH pass gates cannot diverge between them."""
+        with self._lock:
+            self.node_faults += 1
+        self.membership.note_failure(target, e)
+        name = ("node.partition" if isinstance(e, NodePartitioned)
+                else "node.shed")
+        self.obs.event(name, trace=trace, parent=root, node=target,
+                       error=f"{type(e).__name__}: {e}"[:200],
+                       traces=[trace])
+        nxt = self.membership.node_for(key, exclude=tried)
+        with self._lock:
+            self.redispatches += 1
+        self.obs.event("route.hop", trace=trace, parent=root,
+                       lanes=lanes, hop_from=target,
+                       hop_to=nxt or "ladder", traces=[trace])
+        return nxt
+
+    # -- the check path ------------------------------------------------
+    def _handle_check(self, conn: socket.socket, req: dict) -> None:
+        from ..models.registry import MODELS
+
+        t_req = time.perf_counter()
+        model = req.get("model")
+        if model not in MODELS:
+            self._send(conn, {"id": req.get("id"), "ok": False,
+                              "error": f"unknown model {model!r}; one "
+                                       f"of {sorted(MODELS)}"})
+            return
+        rows_list = req.get("histories")
+        if rows_list is None and "history" in req:
+            rows_list = [req["history"]]
+        if not isinstance(rows_list, list) or not rows_list:
+            self._send(conn, {"id": req.get("id"), "ok": False,
+                              "error": "request needs a non-empty "
+                                       "'histories' (or 'history') "
+                                       "array"})
+            return
+        hists = [rows_to_history(rows) for rows in rows_list]
+        spec_kwargs = req.get("spec_kwargs") or {}
+        spec = self._spec_for(model, spec_kwargs)
+        want_witness = bool(req.get("witness"))
+        deadline = self.admission.deadline_for(req.get("deadline_s"))
+        trace = str(req.get("trace") or "") or new_trace_id()
+        root = ""
+        if self.obs.on:
+            root = new_span_id()
+            self.obs.tracer.emit("route.request", trace=trace,
+                                 span=root, model=model,
+                                 lanes=len(hists))
+        with self._lock:
+            self.requests += 1
+            self.histories += len(hists)
+        if not self.admission.try_admit(len(hists)):
+            self._respond(conn, self._shed(req, "queue full", trace,
+                                           root), trace, root, t_req)
+            return
+        try:
+            doc = self._route_check(req, model, spec, spec_kwargs,
+                                    hists, want_witness, deadline,
+                                    trace, root, t_req)
+            self._respond(conn, doc, trace, root, t_req,
+                          status="shed" if doc.get("shed") else "ok")
+        finally:
+            self.admission.release(len(hists))
+
+    def _route_check(self, req, model, spec, spec_kwargs, hists,
+                     want_witness, deadline, trace, root,
+                     t_req) -> dict:
+        # route each history by its cache identity; histories sharing a
+        # node coalesce into ONE sub-request (the node's micro-batcher
+        # takes it from there)
+        keys = [fingerprint_key(spec, h) for h in hists]
+        routable = self.membership.routable_ids()
+        groups: Dict[Optional[str], List[int]] = {}
+        for i, key in enumerate(keys):
+            nid = self.membership.ring.node_for(key, routable) \
+                if routable else None
+            groups.setdefault(nid, []).append(i)
+        if self.obs.on:
+            for nid, idxs in sorted(groups.items(),
+                                    key=lambda kv: str(kv[0])):
+                self.obs.event("route.assign", trace=trace, parent=root,
+                               node=nid or "ladder", lanes=len(idxs))
+        results: Dict[Optional[str], _GroupResult] = {}
+        group_errors: List[BaseException] = []
+
+        def run_group(nid: Optional[str], idxs: List[int]) -> None:
+            try:
+                results[nid] = self._dispatch_group(
+                    nid, [hists[i] for i in idxs], keys[idxs[0]],
+                    model, spec, spec_kwargs, want_witness, deadline,
+                    trace, root, req.get("deadline_s"))
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                # a deterministic error (bad kwargs reaching the
+                # ladder, an engine bug) must answer as an ERROR, not
+                # masquerade as a retryable SHED — swallow nothing
+                group_errors.append(e)
+
+        items = sorted(groups.items(), key=lambda kv: str(kv[0]))
+        threads = [threading.Thread(target=run_group, args=(nid, idxs),
+                                    daemon=True,
+                                    name=f"qsm-fleet-group-{nid}")
+                   for nid, idxs in items[1:]]
+        for t in threads:
+            t.start()
+        run_group(*items[0])
+        for t in threads:
+            t.join(max(0.1, deadline - time.monotonic()) + 5.0)
+        if group_errors:
+            raise group_errors[0]
+        verdicts: List[Optional[int]] = [None] * len(hists)
+        cached = [False] * len(hists)
+        witnesses: List[Optional[list]] = [None] * len(hists)
+        batches: List[dict] = []
+        nodes_used: Dict[str, int] = {}
+        faults = sheds = 0
+        for nid, idxs in items:
+            res = results.get(nid)
+            if res is None:
+                return self._shed(req, "fleet exhausted", trace, root)
+            faults += res.faults
+            sheds += res.sheds
+            for j, i in enumerate(idxs):
+                verdicts[i] = res.verdicts[j]
+                cached[i] = res.cached[j]
+                witnesses[i] = res.witnesses[j]
+            batches.extend(res.batches)
+            if res.node is not None:
+                nodes_used[res.node] = (nodes_used.get(res.node, 0)
+                                        + len(idxs))
+        if any(v is None for v in verdicts):
+            # a group shed (deadline / all nodes + ladder refused):
+            # never answer partially, never guess
+            return self._shed(req, "fleet shed", trace, root)
+        doc = {
+            "id": req.get("id"), "ok": True, "model": model,
+            "trace": trace,
+            "verdicts": [VERDICT_NAMES[v] for v in verdicts],
+            "cached": cached,
+            "violations": sum(v == int(Verdict.VIOLATION)
+                              for v in verdicts),
+            "undecided": sum(v == int(Verdict.BUDGET_EXCEEDED)
+                             for v in verdicts),
+            "batches": batches,
+            "nodes": nodes_used,
+            "seconds": round(time.perf_counter() - t_req, 4),
+        }
+        if faults:
+            doc["node_faults"] = faults
+        if sheds:
+            doc["node_sheds"] = sheds
+        if want_witness:
+            doc["witnesses"] = [
+                [list(p) for p in w] if w is not None else None
+                for w in witnesses]
+        return doc
+
+    def _dispatch_group(self, nid: Optional[str], hists, group_key: str,
+                        model: str, spec, spec_kwargs, want_witness,
+                        deadline: float, trace: str, root: str,
+                        deadline_s) -> _GroupResult:
+        """Decide one node group: bounded attempts across the ring with
+        the failed nodes EXCLUDED, then the in-process ladder.  Lanes
+        are all-or-nothing per attempt (a lost node banked nothing the
+        router saw), mirroring ``WorkerPool.dispatch``."""
+        res = _GroupResult(len(hists))
+        subreq = {"op": "check", "id": "fleet-sub", "model": model,
+                  "histories": [history_to_rows(h) for h in hists],
+                  "trace": trace}
+        if spec_kwargs:
+            subreq["spec_kwargs"] = spec_kwargs
+        if want_witness:
+            subreq["witness"] = True
+        if deadline_s is not None:
+            subreq["deadline_s"] = deadline_s
+        tried: Set[str] = set()
+        target = nid
+        for _attempt in range(max(1, self.policy.attempts)):
+            if target is None or self._stop.is_set():
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return res  # deadline: undecided lanes stay None (shed)
+            tried.add(target)
+            timeout_s = min(self.policy.timeout_s or 30.0, remaining)
+            self.obs.event("node.dispatch", trace=trace, parent=root,
+                           node=target, lanes=len(hists),
+                           traces=[trace])
+            try:
+                resp = self.links[target].request(subreq, timeout_s)
+            except NodeBusy:
+                target = self._hop_busy(group_key, target, tried,
+                                        trace, root, lanes=len(hists))
+                continue
+            except _LINK_FAULTS as e:
+                res.faults += 1
+                target = self._shed_node(group_key, target, tried, e,
+                                         trace, root, lanes=len(hists))
+                continue
+            if resp.get("ok"):
+                self.membership.note_success(target)
+                res.node = str(resp.get("node") or target)
+                names = resp.get("verdicts") or []
+                for j, name in enumerate(names[:len(hists)]):
+                    res.verdicts[j] = VERDICT_NAMES.index(name)
+                res.cached = list(resp.get("cached")
+                                  or [False] * len(hists))
+                if want_witness:
+                    res.witnesses = list(resp.get("witnesses")
+                                         or [None] * len(hists))
+                for b in resp.get("batches") or []:
+                    b = {**b, "node": res.node}
+                    if res.faults:
+                        # the batch survived a node loss: its own cost
+                        # record says so (SearchStats node_faults,
+                        # compact "ndf")
+                        search = dict(b.get("search") or {})
+                        search["ndf"] = (search.get("ndf", 0)
+                                         + res.faults)
+                        b["search"] = search
+                    res.batches.append(b)
+                return res
+            if resp.get("shed"):
+                # cross-fleet backpressure: this node refused honestly;
+                # another node (or the ladder) may have room.  NOT a
+                # health fault — shedding is the healthy overload answer.
+                with self._lock:
+                    self.node_sheds += 1
+                res.sheds += 1
+                prev, target = target, self.membership.node_for(
+                    group_key, exclude=tried)
+                self.obs.event("route.hop", trace=trace, parent=root,
+                               lanes=len(hists), hop_from=prev,
+                               hop_to=target or "ladder", shed=True,
+                               traces=[trace])
+                continue
+            # a clean error answer (bad kwargs reach every node the
+            # same way): re-dispatching cannot help — fail the group
+            # to the ladder, which will raise the same way if it is a
+            # request problem
+            break
+        return self._ladder_group(res, hists, model, spec_kwargs,
+                                  want_witness, deadline, trace, root)
+
+    def _ladder_group(self, res: _GroupResult, hists, model: str,
+                      spec_kwargs, want_witness, deadline: float,
+                      trace: str, root: str) -> _GroupResult:
+        """The last rung: the router's own warm host cpp→memo ladder —
+        exact verdicts, in-process, serialized per spec."""
+        if time.monotonic() >= deadline:
+            return res
+        spec, engine, oracle, lock = self._ladder_for(model, spec_kwargs)
+        self.obs.event("route.ladder", trace=trace, parent=root,
+                       lanes=len(hists))
+        with lock:
+            if want_witness:
+                pairs = [oracle.check_witness(spec, h) for h in hists]
+                verdicts = [int(v) for v, _w in pairs]
+                res.witnesses = [w for _v, w in pairs]
+            else:
+                verdicts = [int(v) for v in
+                            engine.check_histories(spec, hists)]
+        res.verdicts = verdicts
+        res.node = self.node_id
+        with self._lock:
+            self.ladder_batches += 1
+            self.ladder_lanes += len(hists)
+        res.batches.append({
+            "batch": f"ladder-{self.ladder_batches}",
+            "lanes": len(hists), "width": len(hists),
+            "flush": "ladder", "node": self.node_id,
+            "search": {"ndf": res.faults}})
+        return res
+
+    # -- the shrink verb -----------------------------------------------
+    def _handle_shrink(self, conn: socket.socket, req: dict) -> None:
+        """Route one minimization to the node owning the ORIGINAL
+        history's fingerprint (its verdict bank has the best chance of
+        memo hits), bounded re-dispatch on node loss, in-process
+        ladder shrink as the last rung."""
+        from ..models.registry import MODELS
+
+        t_req = time.perf_counter()
+        model = req.get("model")
+        if model not in MODELS:
+            self._send(conn, {"id": req.get("id"), "ok": False,
+                              "error": f"unknown model {model!r}; one "
+                                       f"of {sorted(MODELS)}"})
+            return
+        rows = req.get("history")
+        if not isinstance(rows, list) or not rows:
+            self._send(conn, {"id": req.get("id"), "ok": False,
+                              "error": "shrink needs ONE non-empty "
+                                       "'history' rows array"})
+            return
+        h = rows_to_history(rows)
+        spec_kwargs = req.get("spec_kwargs") or {}
+        spec = self._spec_for(model, spec_kwargs)
+        key = fingerprint_key(spec, h)
+        deadline = self.admission.deadline_for(req.get("deadline_s"))
+        trace = str(req.get("trace") or "") or new_trace_id()
+        root = ""
+        if self.obs.on:
+            root = new_span_id()
+            self.obs.tracer.emit("route.request", trace=trace,
+                                 span=root, model=model, op="shrink",
+                                 ops=len(h))
+        with self._lock:
+            self.requests += 1
+            self.shrink_requests += 1
+        if not self.admission.try_admit(1):
+            self._respond(conn, self._shed(req, "queue full", trace,
+                                           root), trace, root, t_req)
+            return
+        try:
+            subreq = {**req, "trace": trace}
+            tried: Set[str] = set()
+            target = self.membership.node_for(key)
+            faults = 0
+            for _attempt in range(max(1, self.policy.attempts)):
+                if target is None:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                tried.add(target)
+                # bounded like a check round-trip: a wedged node must
+                # cost one link timeout, not the request's whole
+                # deadline (a node mid-shrink that outlives the bound
+                # still banks its result for the re-ask to hit)
+                timeout_s = min(self.policy.timeout_s or 30.0,
+                                remaining)
+                self.obs.event("node.dispatch", trace=trace,
+                               parent=root, node=target, op="shrink",
+                               traces=[trace])
+                try:
+                    resp = self.links[target].request(subreq, timeout_s)
+                except NodeBusy:
+                    target = self._hop_busy(key, target, tried, trace,
+                                            root)
+                    continue
+                except _LINK_FAULTS as e:
+                    faults += 1
+                    target = self._shed_node(key, target, tried, e,
+                                             trace, root)
+                    continue
+                if resp.get("ok") or resp.get("shed"):
+                    self.membership.note_success(target)
+                    doc = {**resp, "id": req.get("id"), "trace": trace}
+                    if faults:
+                        doc["node_faults"] = faults
+                    self._respond(conn, doc, trace, root, t_req,
+                                  status=("shed" if resp.get("shed")
+                                          else "ok"))
+                    return
+                break  # clean error answer: the ladder will say why
+            doc = self._ladder_shrink(req, model, spec_kwargs, h,
+                                      deadline, trace, root, faults,
+                                      t_req)
+            self._respond(conn, doc, trace, root, t_req)
+        finally:
+            self.admission.release(1)
+
+    def _ladder_shrink(self, req, model, spec_kwargs, h, deadline,
+                       trace, root, faults, t_req) -> dict:
+        from ..shrink.shrinker import Shrinker
+
+        spec, engine, _oracle, lock = self._ladder_for(model,
+                                                       spec_kwargs)
+        self.obs.event("route.ladder", trace=trace, parent=root,
+                       op="shrink", ops=len(h))
+
+        def decide(hists):
+            if time.monotonic() >= deadline:
+                return None
+            with lock:
+                return np.asarray(
+                    engine.check_histories(spec, list(hists)))
+
+        shrinker = Shrinker(spec, decide, deadline=deadline)
+        res = shrinker.run(h)
+        with self._lock:
+            self.ladder_batches += 1
+        doc = {
+            "id": req.get("id"), "ok": True, "model": model,
+            "trace": trace, "node": self.node_id,
+            "verdict": VERDICT_NAMES[int(res.verdict)],
+            "initial_ops": res.initial_ops,
+            "final_ops": res.final_ops,
+            "ratio": round(res.ratio, 3),
+            "rounds": res.rounds,
+            "engine_calls": res.engine_calls,
+            "lanes": res.lanes_checked,
+            "memo_hits": res.memo_hits,
+            "complete": res.complete,
+            "one_minimal": res.one_minimal,
+            "undecided_neighbors": res.undecided_neighbors,
+            "history": history_to_rows(res.history),
+            "why": res.why + ["decided on the router's in-process "
+                              "ladder (fleet last rung)"],
+            "seconds": round(time.perf_counter() - t_req, 4),
+        }
+        if faults:
+            doc["node_faults"] = faults
+        return doc
+
+    # -- shed / respond ------------------------------------------------
+    def _shed(self, req: dict, reason: str, trace: str = "",
+              parent: str = "") -> dict:
+        self.obs.event("admission.shed", trace=trace, parent=parent,
+                       reason=reason)
+        self.obs.note_shed()
+        doc = self.admission.shed_doc(req.get("id"), reason,
+                                      trace=trace or None,
+                                      flight=self.obs.flight_path())
+        # the fleet SHED contract: the shedding node's id + dump path
+        # ride the refusal (ISSUE 12) — shed_doc added `flight`; the
+        # node id lands via _send's stamp, duplicated here for callers
+        # reading the doc without the egress stamp
+        doc["node"] = self.node_id
+        return doc
+
+    def _respond(self, conn, doc: dict, trace: str, root: str,
+                 t_req: float, status: str = "ok") -> None:
+        if doc.get("shed") and status == "ok":
+            # every shed — admission-driven included — must close its
+            # causal tree as a shed, or span tooling undercounts them
+            status = "shed"
+        dt = time.perf_counter() - t_req
+        if self.obs.on:
+            self.obs.tracer.emit("route.response", trace=trace,
+                                 parent=root,
+                                 ms=round(dt * 1000.0, 3),
+                                 status=status,
+                                 shed=bool(doc.get("shed")))
+        self._m_route_s.observe(dt)
+        self._send(conn, doc)
+
+    # -- anti-entropy --------------------------------------------------
+    def _anti_entropy_loop(self) -> None:
+        while not self._stop.wait(self.anti_entropy_s):
+            try:
+                self.anti_entropy_sweep()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                continue
+
+    def anti_entropy_sweep(self) -> dict:
+        """One digest-exchange reconciliation: collect every healthy
+        node's sealed-segment digests, ship each node the segments it
+        neither holds nor has absorbed (owner → lacker), bounded per
+        sweep (``ae_max_segments`` and the ``anti-entropy`` preset's
+        deadline) so a big backlog drains over several beats.  Public
+        so tests and the rolling-restart bench drive it synchronously."""
+        sweep_deadline = time.monotonic() + (
+            self.ae_policy.deadline_s or 60.0)
+        timeout_s = self.ae_policy.timeout_s or 15.0
+        digests: Dict[str, Tuple[dict, dict]] = {}
+        for nid in sorted(self.membership.healthy_ids()):
+            try:
+                resp = self.links[nid].request(
+                    {"op": "replog.digests"}, timeout_s)
+            except NodeBusy:
+                continue  # saturated link: catch up next beat
+            except _LINK_FAULTS as e:
+                self.membership.note_failure(nid, e)
+                continue
+            if resp.get("ok") and isinstance(resp.get("digests"), dict):
+                digests[nid] = (dict(resp["digests"]),
+                                dict(resp.get("absorbed") or {}))
+        union: Dict[str, str] = {}   # segment name -> an owner node
+        for nid, (dig, _ab) in sorted(digests.items()):
+            for name in dig:
+                union.setdefault(name, nid)
+        shipped = rows = 0
+        for nid, (dig, ab) in sorted(digests.items()):
+            missing = [n for n in sorted(union)
+                       if n not in dig and n not in ab]
+            for name in missing[:self.ae_max_segments]:
+                if time.monotonic() >= sweep_deadline:
+                    break
+                owner = union[name]
+                # pull and push legs blamed SEPARATELY: a dead lacker
+                # must not accrue failures to the healthy owner it was
+                # being caught up from (and vice versa)
+                try:
+                    pulled = self.links[owner].request(
+                        {"op": "replog.pull", "segments": [name]},
+                        timeout_s)
+                except NodeBusy:
+                    break  # saturated link: finish this node next beat
+                except _LINK_FAULTS as e:
+                    self.membership.note_failure(owner, e)
+                    break
+                segs = pulled.get("segments") or []
+                if not segs:
+                    continue
+                try:
+                    pushed = self.links[nid].request(
+                        {"op": "replog.push", "segments": segs},
+                        timeout_s)
+                except NodeBusy:
+                    break
+                except _LINK_FAULTS as e:
+                    self.membership.note_failure(nid, e)
+                    break
+                shipped += int(pushed.get("adopted", 0))
+                rows += int(pushed.get("rows", 0))
+        with self._lock:
+            self.ae_sweeps += 1
+            self.ae_segments_shipped += shipped
+            self.ae_rows_shipped += rows
+        if shipped:
+            self.obs.event("fleet.anti_entropy", nodes=len(digests),
+                           segments=shipped, rows=rows)
+        return {"nodes": len(digests), "segments_shipped": shipped,
+                "rows_shipped": rows}
+
+    # -- observability -------------------------------------------------
+    def node_stats(self, timeout_s: float = 5.0) -> Dict[str, dict]:
+        """Best-effort live per-node ``stats`` blocks (down nodes get
+        an ``error`` entry — the fleet view must show the hole, not
+        hide it).  Nodes the membership already knows are down are
+        answered from that knowledge, and the live fetches run in
+        parallel: one wedged node must cost the stats op ONE timeout,
+        not one per node."""
+        out: Dict[str, dict] = {}
+        routable = self.membership.routable_ids()
+
+        def fetch(nid: str) -> None:
+            try:
+                resp = self.links[nid].request({"op": "stats"},
+                                               timeout_s)
+                out[nid] = (resp.get("stats")
+                            if resp.get("ok") else
+                            {"error": resp.get("error", "bad answer")})
+            except (NodeBusy, *_LINK_FAULTS) as e:
+                out[nid] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+        live = [nid for nid in self.membership.all_ids()
+                if nid in routable]
+        for nid in self.membership.all_ids():
+            if nid not in routable:
+                out[nid] = {"error": "down (membership)"}
+        threads = [threading.Thread(target=fetch, args=(nid,),
+                                    daemon=True) for nid in live[1:]]
+        for t in threads:
+            t.start()
+        if live:
+            fetch(live[0])
+        for t in threads:
+            t.join(timeout_s + 1.0)
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            counters = {
+                "requests": self.requests,
+                "histories": self.histories,
+                "shrink_requests": self.shrink_requests,
+                "node_faults": self.node_faults,
+                "node_sheds": self.node_sheds,
+                "redispatches": self.redispatches,
+                "ladder_batches": self.ladder_batches,
+                "ladder_lanes": self.ladder_lanes,
+            }
+            ae = {"sweeps": self.ae_sweeps,
+                  "segments_shipped": self.ae_segments_shipped,
+                  "rows_shipped": self.ae_rows_shipped,
+                  "interval_s": self.anti_entropy_s,
+                  "policy": self.ae_policy.name}
+        return {
+            "address": self.address,
+            "role": "router",
+            "node": self.node_id,
+            "uptime_s": round(time.monotonic() - self._t0, 1),
+            **counters,
+            "policy": self.policy.name,
+            "admission": self.admission.snapshot(),
+            "membership": self.membership.snapshot(),
+            "anti_entropy": ae,
+            "fleet_nodes": self.node_stats(),
+            "obs": self.obs.snapshot(),
+            "faults": fired_snapshot(),
+        }
+
+    def _metric_samples(self):
+        """Per-node scrape-time collectors: the fleet's live health and
+        traffic, labeled by node id (bounded label set — node ids come
+        from the static fleet config)."""
+        adm = self.admission.snapshot()
+        mem = self.membership.snapshot()
+        with self._lock:
+            c, g = "counter", "gauge"
+            out = [
+                ("qsm_fleet_requests_total", c, "router requests", {},
+                 float(self.requests)),
+                ("qsm_fleet_histories_total", c, "router history lanes",
+                 {}, float(self.histories)),
+                ("qsm_fleet_node_faults_total", c,
+                 "node exchanges lost (death/wedge/partition)", {},
+                 float(self.node_faults)),
+                ("qsm_fleet_redispatches_total", c,
+                 "lane groups moved to another node", {},
+                 float(self.redispatches)),
+                ("qsm_fleet_ladder_lanes_total", c,
+                 "lanes decided on the router's in-process ladder", {},
+                 float(self.ladder_lanes)),
+                ("qsm_fleet_ae_segments_shipped_total", c,
+                 "anti-entropy segments replicated", {},
+                 float(self.ae_segments_shipped)),
+                ("qsm_fleet_in_flight", g, "router admitted lanes",
+                 {}, float(adm["in_flight"])),
+            ]
+        out += [
+            ("qsm_fleet_node_healthy", "gauge",
+             "node health (1 healthy, 0 down/quarantined)",
+             {"node": n["node"]},
+             1.0 if n["healthy"] and not n["quarantined"] else 0.0)
+            for n in mem["nodes"]]
+        out += [
+            ("qsm_fleet_node_probe_failures_total", "counter",
+             "membership probe failures", {"node": n["node"]},
+             float(n["failures"])) for n in mem["nodes"]]
+        return out

@@ -39,10 +39,17 @@ from .trace import (NULL_SPAN, Span, Tracer, build_tree, load_events,
                     new_span_id, new_trace_id, render_tree)
 
 # events that flip the flight recorder's dump trigger the moment they
-# are emitted (beyond the shed-storm window the server drives itself)
+# are emitted (beyond the shed-storm window the server drives itself).
+# The fleet tier's node-loss events (fleet/router.py, fleet/
+# membership.py) ride the same rule: a dead/partitioned/quarantined
+# NODE leaves an artifact naming the doomed dispatches' trace ids,
+# exactly like a SIGKILLed pool worker one level down.
 _DUMP_TRIGGERS = {"worker.shed": "worker_crash",
                   "pool.quarantine": "quarantine",
-                  "fault.hit": "fault_plane"}
+                  "fault.hit": "fault_plane",
+                  "node.shed": "node_death",
+                  "node.partition": "partition",
+                  "fleet.quarantine": "node_quarantine"}
 
 
 class Observability:

@@ -27,11 +27,22 @@ Syntax (the ``QSM_TPU_FAULTS`` env var, comma-separated rules)::
                          worker process, so a worker's own dispatch
                          kills that worker and the SUPERVISOR's
                          shed/re-dispatch path is what gets tested)
+             | "partition" (returned to the caller: the frames of this
+                         exchange are dropped BOTH directions — the
+                         request never arrives, the response never
+                         comes back.  Meant for the ``node`` site
+                         (fleet router→node I/O): the router treats it
+                         as the link being out, not the node having
+                         answered, so its re-dispatch/quarantine path
+                         is what gets tested.  ``@nth`` = the link
+                         STAYS partitioned from that hit on)
     nth     := fire on the nth hit of the site AND every later one
                (a lost device stays lost — "mid-scan crash" semantics;
                for kill:worker the count is PER PROCESS, so a respawned
                worker dies again at the same dispatch ordinal — the
-               crash-loop the quarantine path exists for)
+               crash-loop the quarantine path exists for; for
+               partition:node the link stays down — "switch died
+               mid-soak" semantics)
 
 Probability draws come from ONE ``random.Random`` seeded by
 ``QSM_TPU_FAULTS_SEED`` (default 0), so a fault schedule is replayable —
@@ -50,7 +61,10 @@ device engine entry), ``seize`` (tools/probe_watcher.py), ``serve``
 the check server's degrade-to-host-ladder path on the CPU platform,
 tests/test_serve.py), ``worker`` (serve/worker.py pool-worker dispatch
 — hang/raise/kill INSIDE a worker process exercises the supervisor's
-shed → re-dispatch → respawn/quarantine ladder, tests/test_serve_pool.py).
+shed → re-dispatch → respawn/quarantine ladder, tests/test_serve_pool.py),
+``node`` (fleet/router.py router→node round-trips — partition/hang/raise
+there exercises the fleet tier's exclude-and-re-dispatch ladder down to
+the router's own in-process host ladder, tests/test_fleet.py).
 """
 
 from __future__ import annotations
@@ -65,7 +79,7 @@ ENV_VAR = "QSM_TPU_FAULTS"
 SEED_VAR = "QSM_TPU_FAULTS_SEED"
 HANG_VAR = "QSM_TPU_FAULT_HANG_S"
 
-ACTIONS = ("hang", "raise", "wedge", "kill")
+ACTIONS = ("hang", "raise", "wedge", "kill", "partition")
 
 
 class InjectedFault(RuntimeError):
@@ -168,8 +182,10 @@ def inject(site: str) -> Optional[str]:
     ``hang`` sleeps ``QSM_TPU_FAULT_HANG_S`` (default 3600 — long enough
     that any watchdog fires first) then raises; ``kill`` SIGKILLs the
     current process (a crash leaves no traceback and runs no cleanup —
-    the supervisor side is what survives to be tested); ``wedge`` is
-    RETURNED so the site applies its own unavailability semantics."""
+    the supervisor side is what survives to be tested); ``wedge`` and
+    ``partition`` are RETURNED so the site applies its own
+    unavailability semantics (a partitioned fleet link drops the frames
+    without the wait a real timeout would cost the test)."""
     if not os.environ.get(ENV_VAR):
         return None
     act = active_plane().action_for(site)

@@ -215,6 +215,35 @@ PRESETS = {
     # is nothing to wait out.
     "worker-dispatch": RetryPolicy(name="worker-dispatch", attempts=3,
                                    timeout_s=30.0, deadline_s=60.0),
+    # one sub-request routed to a fleet NODE (qsm_tpu/fleet/router.py):
+    # timeout_s bounds the router→node round-trip over the socket —
+    # past it the node is presumed wedged/partitioned and the lanes
+    # re-dispatch to a DIFFERENT node (the failed one is excluded, so
+    # backoff_s stays 0 like worker-dispatch: there is nothing to wait
+    # out); attempts bounds how many nodes one sub-request may burn
+    # before the router's own in-process host ladder is the last rung;
+    # deadline_s caps the whole route/re-dispatch ladder inside the
+    # request's serve deadline.
+    "fleet-route": RetryPolicy(name="fleet-route", attempts=3,
+                               timeout_s=20.0, deadline_s=45.0),
+    # the membership health probe (qsm_tpu/fleet/membership.py): one
+    # cheap bounded stats round-trip per node per beat — a node that
+    # misses it repeatedly is quarantined one-way (routing stops) and
+    # re-admitted only on sustained health.  backoff_s here is the
+    # probe's RE-PROBE spacing while a node is down (the membership
+    # loop multiplies it per consecutive failure, capped), not a retry
+    # of one probe.
+    "fleet-probe": RetryPolicy(name="fleet-probe", attempts=1,
+                               timeout_s=5.0, backoff_s=1.0,
+                               backoff_factor=2.0),
+    # the router's anti-entropy beat (qsm_tpu/fleet/replog.py digest
+    # exchange): timeout_s bounds one digest/pull/push round-trip —
+    # catch-up traffic must never hold a connection past it (a wedged
+    # node's catch-up is abandoned and retried next beat); deadline_s
+    # caps one whole reconciliation sweep across the fleet so a big
+    # backlog ships over several beats instead of one unbounded one.
+    "anti-entropy": RetryPolicy(name="anti-entropy", attempts=1,
+                                timeout_s=15.0, deadline_s=60.0),
 }
 
 

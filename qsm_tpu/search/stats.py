@@ -75,6 +75,10 @@ class SearchStats:
     worker_faults: int = 0      # pool workers shed (crash/wedge/kill)
     # while deciding these lanes — serve/pool.py stamps it so a batch
     # that survived a worker loss says so in its own cost record
+    node_faults: int = 0        # fleet nodes lost (death/wedge/partition)
+    # while deciding these lanes — fleet/router.py stamps it so a batch
+    # that survived a node loss (re-dispatched to a surviving node or
+    # the router's own ladder) says so in its own cost record
     # span<->stats bridge (qsm_tpu/obs): trace events emitted while
     # deciding these lanes.  The serve dispatch path stamps it into the
     # batch's compact record and the batch's `serve.dispatch` span
@@ -104,9 +108,9 @@ class SearchStats:
                   "memo_inserts", "compactions", "chunk_rounds", "rescued",
                   "deferred", "tail_histories", "segments_split",
                   "segments_total", "degradations", "retries",
-                  "worker_faults", "pcomp_split", "pcomp_subs",
-                  "pcomp_recombine_ms", "shrink_rounds", "shrink_lanes",
-                  "shrink_memo_hits", "obs_events"):
+                  "worker_faults", "node_faults", "pcomp_split",
+                  "pcomp_subs", "pcomp_recombine_ms", "shrink_rounds",
+                  "shrink_lanes", "shrink_memo_hits", "obs_events"):
             setattr(self, f, getattr(self, f) + getattr(other, f))
         # a maximum, not a tally: the composed record's worst sub-history
         # is the worst either side saw
@@ -151,6 +155,7 @@ class SearchStats:
             "deg": self.degradations,
             "fb": self.fallback_engine,
             "wf": self.worker_faults,
+            "ndf": self.node_faults,
             # P-compositionality counters ride every compact record too:
             # a bench row from a decomposed run must say it decomposed
             # (and into what) or its rate reads as a whole-history rate
@@ -190,6 +195,8 @@ class SearchStats:
             out["resilience_retries"] = float(self.retries)
         if self.worker_faults:
             out["resilience_worker_faults"] = float(self.worker_faults)
+        if self.node_faults:
+            out["resilience_node_faults"] = float(self.node_faults)
         # pcomp accounting only when decomposition actually happened —
         # zeros would claim "pcomp ran, split nothing" on every
         # whole-history run
@@ -217,9 +224,10 @@ _COUNTER_FIELDS = ("histories", "lockstep_iters", "nodes_explored",
                    "memo_prunes", "memo_inserts", "compactions",
                    "chunk_rounds", "rescued", "deferred", "tail_histories",
                    "segments_split", "segments_total", "degradations",
-                   "retries", "worker_faults", "pcomp_split", "pcomp_subs",
-                   "pcomp_recombine_ms", "shrink_rounds", "shrink_lanes",
-                   "shrink_memo_hits", "obs_events")
+                   "retries", "worker_faults", "node_faults",
+                   "pcomp_split", "pcomp_subs", "pcomp_recombine_ms",
+                   "shrink_rounds", "shrink_lanes", "shrink_memo_hits",
+                   "obs_events")
 # pcomp_max_sub and shrink_ratio_pct are deliberately NOT delta fields:
 # a maximum/ratio has no meaningful "per-run difference", so stats_delta
 # keeps `after`'s value.

@@ -28,11 +28,20 @@ causal tree (``qsm-tpu trace <id>`` rebuilds it), ``--metrics-port``
 serves live Prometheus metrics that reconcile with ``stats`` by
 construction, and ``--flight-dir`` arms the crash flight recorder.
 
-CLI: ``qsm-tpu serve`` / ``qsm-tpu submit`` (utils/cli.py); bench:
-tools/bench_serve.py (artifact ``BENCH_SERVE_r08.json``); static gates:
-the QSM-SERVE pass family (analysis/serve_passes.py), the QSM-POOL
-family (analysis/pool_passes.py) and the QSM-OBS family
-(analysis/obs_passes.py).
+Fleet tier (qsm_tpu/fleet, docs/SERVING.md "Fleet"): N of these
+servers — started with ``node_id`` / ``replog_dir`` so responses are
+node-stamped and the verdict bank is a segmented REPLICATED log
+serving the ``replog.*`` anti-entropy ops — sit behind a
+protocol-identical ``fleet.FleetRouter``; clients need no changes.
+
+CLI: ``qsm-tpu serve`` / ``qsm-tpu submit`` / ``qsm-tpu fleet``
+(utils/cli.py); bench: tools/bench_serve.py (artifact
+``BENCH_SERVE_r08.json``) and tools/bench_fleet.py
+(``BENCH_FLEET_r12.json``); static gates: the QSM-SERVE pass family
+(analysis/serve_passes.py), the QSM-POOL family
+(analysis/pool_passes.py), the QSM-OBS family
+(analysis/obs_passes.py) and the QSM-FLEET family
+(analysis/fleet_passes.py).
 """
 
 from .admission import AdmissionController

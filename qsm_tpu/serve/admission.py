@@ -37,14 +37,23 @@ class AdmissionController:
     running 1-of-4 workers is a degradation story, not an overload
     story, and the client deciding whether to back off or fail over
     needs to tell them apart.
+
+    ``fleet_state`` (optional) is the fleet tier's twin
+    (``fleet/membership.py shed_state``): a router's SHED under
+    cross-fleet backpressure carries the per-node health block — live/
+    quarantined node counts and the shedding node's own id — so "the
+    fleet is overloaded" and "the fleet is down to one node" read
+    differently to the client and the operator.
     """
 
     def __init__(self, queue_depth: int = 1024,
                  policy: Optional[RetryPolicy] = None,
-                 pool_state: Optional[Callable[[], dict]] = None):
+                 pool_state: Optional[Callable[[], dict]] = None,
+                 fleet_state: Optional[Callable[[], dict]] = None):
         self.queue_depth = queue_depth
         self.policy = policy or preset("serve")
         self.pool_state = pool_state
+        self.fleet_state = fleet_state
         self._lock = threading.Lock()
         self.in_flight = 0
         self.peak_in_flight = 0
@@ -97,6 +106,10 @@ class AdmissionController:
             state = self.pool_state()
             if state:
                 doc["pool"] = state
+        if self.fleet_state is not None:
+            state = self.fleet_state()
+            if state:
+                doc["fleet"] = state
         return doc
 
     # ------------------------------------------------------------------
@@ -114,4 +127,8 @@ class AdmissionController:
             state = self.pool_state()
             if state:
                 snap["pool"] = state
+        if self.fleet_state is not None:
+            state = self.fleet_state()
+            if state:
+                snap["fleet"] = state
         return snap

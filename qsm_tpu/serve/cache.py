@@ -30,6 +30,12 @@ module banks (verdict, witness) under that identity:
   banked.  A BUDGET_EXCEEDED is an engine-relative statement, not a
   property of the history; banking it would freeze "undecided" past
   engine upgrades.
+* **Fleet** — with ``store=`` a :class:`~qsm_tpu.fleet.replog.
+  SegmentedLog` replaces the single file: same append/compact
+  discipline, but the bank becomes content-fingerprinted SEGMENTS a
+  fleet replicates via anti-entropy (docs/SERVING.md "Fleet");
+  :meth:`VerdictCache.adopt_rows` folds replicated rows into the live
+  set without re-banking them.
 """
 
 from __future__ import annotations
@@ -68,9 +74,16 @@ class VerdictCache:
     batcher's dispatch thread share one instance."""
 
     def __init__(self, max_entries: int = 4096, path: Optional[str] = None,
-                 persist_every: int = 1):
+                 persist_every: int = 1, store=None):
         self.max_entries = max_entries
         self.path = path
+        # the fleet tier's segmented bank (fleet/replog.py SegmentedLog):
+        # when set, persistence routes through the store's append/
+        # compact/load contract instead of the single-file log — the
+        # bank becomes replicable segment-by-segment while this class
+        # keeps owning WHAT is banked (decided verdicts, post-merge
+        # rows, later-row-wins)
+        self.store = store
         self.persist_every = max(1, persist_every)
         self._od: "OrderedDict[str, CacheEntry]" = OrderedDict()
         self._lock = threading.Lock()
@@ -78,11 +91,14 @@ class VerdictCache:
         self.misses = 0
         self.compactions = 0
         self.bank_appends = 0  # append+fsync flushes (obs metrics feed)
+        self.adopted = 0       # rows folded in from replicated segments
         self._puts_since_flush = 0
         self._dirty: List[str] = []   # banked rows awaiting one append
         self._file_rows = 0           # rows in the on-disk log
         self._file_exists = False
-        if path:
+        if store is not None:
+            self._load_store()
+        elif path:
             self._load(path)
 
     # ------------------------------------------------------------------
@@ -103,7 +119,8 @@ class VerdictCache:
             if not self._put_locked(key, verdict, witness):
                 return
             self._puts_since_flush += 1
-            if self.path and self._puts_since_flush >= self.persist_every:
+            if (self._persistent
+                    and self._puts_since_flush >= self.persist_every):
                 self._flush_locked()
 
     def put_many(self, items) -> None:
@@ -116,7 +133,7 @@ class VerdictCache:
             wrote = False
             for key, verdict, witness in items:
                 wrote = self._put_locked(key, verdict, witness) or wrote
-            if wrote and self.path:
+            if wrote and self._persistent:
                 self._flush_locked()
 
     def _put_locked(self, key: str, verdict: int,
@@ -137,7 +154,7 @@ class VerdictCache:
                 witness=list(witness) if witness is not None else None)
             while len(self._od) > self.max_entries:
                 self._od.popitem(last=False)
-        if self.path:
+        if self._persistent:
             # serialize the POST-merge entry (not the put's arguments):
             # the last row for a key wins on load, so a verdict-only
             # refresh row must still carry the banked witness
@@ -147,10 +164,46 @@ class VerdictCache:
                              if e.witness is not None else None)}))
         return True
 
+    @property
+    def _persistent(self) -> bool:
+        return self.store is not None or bool(self.path)
+
     def flush(self) -> None:
         with self._lock:
-            if self.path:
+            if self._persistent:
                 self._flush_locked()
+
+    def adopt_rows(self, rows) -> int:
+        """Fold replicated rows (fleet/replog.py segment adoption) into
+        the live set WITHOUT re-banking: the rows are already durable in
+        the adopted segment, so marking them dirty would bank each
+        verdict twice.  An existing entry only gains a witness it was
+        missing — local rows stay authoritative (later-row-wins is a
+        local ordering; a remote row for the same key can only agree on
+        the verdict, verdicts being pure functions of (spec, history)).
+        Returns rows actually folded in."""
+        n = 0
+        with self._lock:
+            for row in rows:
+                key, verdict = row.get("key"), row.get("verdict")
+                if not key or verdict not in (0, 1):
+                    continue
+                w = row.get("witness")
+                e = self._od.get(key)
+                if e is not None:
+                    if e.witness is None and w is not None:
+                        e.witness = [tuple(p) for p in w]
+                        n += 1
+                    continue
+                self._od[key] = CacheEntry(
+                    verdict=verdict,
+                    witness=[tuple(p) for p in w] if w is not None
+                    else None)
+                n += 1
+                while len(self._od) > self.max_entries:
+                    self._od.popitem(last=False)
+            self.adopted += n
+        return n
 
     def __len__(self) -> int:
         with self._lock:
@@ -159,13 +212,17 @@ class VerdictCache:
     def stats(self) -> dict:
         with self._lock:
             total = self.hits + self.misses
-            return {"entries": len(self._od), "hits": self.hits,
-                    "misses": self.misses,
-                    "hit_rate": round(self.hits / total, 3) if total else 0.0,
-                    "bank_rows": self._file_rows,
-                    "bank_appends": self.bank_appends,
-                    "compactions": self.compactions,
-                    "path": self.path}
+            out = {"entries": len(self._od), "hits": self.hits,
+                   "misses": self.misses,
+                   "hit_rate": round(self.hits / total, 3) if total else 0.0,
+                   "bank_rows": self._file_rows,
+                   "bank_appends": self.bank_appends,
+                   "compactions": self.compactions,
+                   "adopted": self.adopted,
+                   "path": self.path}
+        if self.store is not None:
+            out["replog"] = self.store.snapshot()
+        return out
 
     # ------------------------------------------------------------------
     def _flush_locked(self) -> None:
@@ -177,6 +234,23 @@ class VerdictCache:
             self._puts_since_flush = 0
             return
         live = len(self._od)
+        if self.store is not None:
+            # segmented bank (fleet/replog.py): O(batch) append into
+            # the active segment; when the fleet-wide row count
+            # outgrows the live set, fold into ONE fresh segment (the
+            # store remembers what it absorbed, so anti-entropy never
+            # re-pulls the compacted-away segments)
+            if (self.store.total_rows + len(self._dirty)
+                    > max(2 * live, self.max_entries)):
+                self.store.compact(self._live_lines())
+                self.compactions += 1
+            else:
+                self.store.append(self._dirty)
+                self.bank_appends += 1
+            self._file_rows = self.store.total_rows
+            self._dirty.clear()
+            self._puts_since_flush = 0
+            return
         if (not self._file_exists
                 or self._file_rows + len(self._dirty)
                 > max(2 * live, self.max_entries)):
@@ -191,20 +265,44 @@ class VerdictCache:
         self._dirty.clear()
         self._puts_since_flush = 0
 
+    def _live_lines(self) -> List[str]:
+        """The live set serialized in LRU order (oldest first — append
+        order IS recency order on reload, like the single-file bank)."""
+        return [json.dumps({"key": k, "verdict": e.verdict,
+                            "witness": ([list(p) for p in e.witness]
+                                        if e.witness is not None
+                                        else None)})
+                for k, e in self._od.items()]
+
     def _compact_locked(self) -> None:
         from ..resilience.checkpoint import atomic_write_text
 
         header = {"artifact": _ARTIFACT, "version": _VERSION,
                   "entries": len(self._od)}
-        rows = [json.dumps({"key": k, "verdict": e.verdict,
-                            "witness": ([list(p) for p in e.witness]
-                                        if e.witness is not None else None)})
-                for k, e in self._od.items()]
+        rows = self._live_lines()
         atomic_write_text(self.path,
                           "\n".join([json.dumps(header)] + rows) + "\n")
         self._file_rows = len(rows)
         self._file_exists = True
         self.compactions += 1
+
+    def _load_store(self) -> None:
+        """Adopt the segmented bank's merged row stream (fleet/replog.py
+        handles torn tails and corrupt segments itself — what arrives
+        here is clean).  Later rows supersede earlier ones, exactly
+        like the single-file load."""
+        for row in self.store.load():
+            key, verdict = row.get("key"), row.get("verdict")
+            if not key or verdict not in (0, 1):
+                continue
+            w = row.get("witness")
+            self._od[key] = CacheEntry(
+                verdict=verdict,
+                witness=[tuple(p) for p in w] if w is not None else None)
+            self._od.move_to_end(key)
+        while len(self._od) > self.max_entries:
+            self._od.popitem(last=False)
+        self._file_rows = self.store.total_rows
 
     def _load(self, path: str) -> None:
         """Adopt a prior bank; CellJournal's tolerance rules — a garbled

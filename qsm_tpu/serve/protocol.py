@@ -48,6 +48,17 @@ Its admission/SHED semantics match ``check``, with one documented
 difference: a deadline firing MID-shrink returns the best-so-far
 history with ``complete: false`` and an honest ``why`` instead of
 discarding the rounds already paid for.
+
+Fleet tier (qsm_tpu/fleet, docs/SERVING.md "Fleet"): a server started
+with a ``node_id`` stamps ``node`` on EVERY response (ok/SHED/error),
+so router-merged answers say which node decided which lanes; a server
+started with a ``replog_dir`` additionally answers the
+``replog.digests`` / ``replog.pull`` / ``replog.push`` ops — the
+segment-exchange surface the router's anti-entropy loop reconciles
+replicated verdict banks through.  The ``FleetRouter`` itself speaks
+exactly this protocol, so clients point at a router address unchanged;
+its SHED responses carry the per-node health block (``fleet``) beside
+the ``pool`` block a single node would send.
 """
 
 from __future__ import annotations

@@ -205,7 +205,10 @@ class CheckServer:
                  trace_log: Optional[str] = None,
                  flight_dir: Optional[str] = None,
                  metrics_port: Optional[int] = None,
-                 obs: Optional[Observability] = None):
+                 obs: Optional[Observability] = None,
+                 node_id: Optional[str] = None,
+                 replog_dir: Optional[str] = None,
+                 replog_seal_rows: int = 256):
         if engine not in ("auto", "planned"):
             raise ValueError(f"unknown serve engine {engine!r}; "
                              "one of ('auto', 'planned')")
@@ -241,8 +244,31 @@ class CheckServer:
             self.pool = WorkerPool(self.n_workers, policy=worker_policy,
                                    quarantine_after=quarantine_after,
                                    obs=self.obs)
+        # fleet tier (qsm_tpu/fleet): the node id stamps every response
+        # (a router-merged answer says which node decided which lanes),
+        # and replog_dir swaps the single-file bank for the segmented
+        # replicated log so this node can serve the replog.* anti-
+        # entropy ops (docs/SERVING.md "Fleet")
+        self.node_id = node_id
+        self.replog = None
+        if replog_dir is not None:
+            if cache_path is not None:
+                # refuse, don't silently pick: --cache's single file
+                # would never be written (or loaded) once the store
+                # owns persistence, and prior banked verdicts in it
+                # would be silently abandoned
+                raise ValueError(
+                    "cache_path and replog_dir are mutually exclusive "
+                    "banks; the segmented replog replaces the single "
+                    "file (migrate by serving once from --cache, then "
+                    "re-banking under --replog-dir)")
+            from ..fleet.replog import SegmentedLog
+
+            self.replog = SegmentedLog(replog_dir,
+                                       node_id=node_id or "n0",
+                                       seal_rows=replog_seal_rows)
         self.cache = VerdictCache(max_entries=cache_entries,
-                                  path=cache_path)
+                                  path=cache_path, store=self.replog)
         self.admission = AdmissionController(
             queue_depth=queue_depth, policy=self.policy,
             pool_state=self.pool.shed_state if self.pool else None)
@@ -502,7 +528,7 @@ class CheckServer:
                 try:
                     req = json.loads(line)
                 except ValueError:
-                    send_doc(conn, {"ok": False, "error": "bad json"})
+                    self._send(conn, {"ok": False, "error": "bad json"})
                     continue
                 self._handle(conn, req)
                 if req.get("op") == "shutdown" and self.allow_shutdown:
@@ -515,16 +541,27 @@ class CheckServer:
             except OSError:
                 pass
 
+    def _send(self, conn: socket.socket, doc: dict) -> None:
+        """THE response egress: a fleet node stamps its ``node`` id on
+        every response (ok/SHED/error alike) so a router-merged answer
+        — and a flight dump, and a trace — can say which node decided
+        which lanes (docs/SERVING.md "Fleet")."""
+        if self.node_id is not None and "node" not in doc:
+            doc = {**doc, "node": self.node_id}
+        send_doc(conn, doc)
+
     def _handle(self, conn: socket.socket, req: dict) -> None:
         op = req.get("op", "check")
         if op == "stats":
-            send_doc(conn, {"ok": True, "stats": self.stats()})
+            self._send(conn, {"ok": True, "stats": self.stats()})
+        elif op in ("replog.digests", "replog.pull", "replog.push"):
+            self._handle_replog(conn, op, req)
         elif op == "shutdown":
             if self.allow_shutdown:
-                send_doc(conn, {"ok": True, "stopping": True})
+                self._send(conn, {"ok": True, "stopping": True})
                 self.stop()
             else:
-                send_doc(conn, {"ok": False,
+                self._send(conn, {"ok": False,
                                 "error": "shutdown disabled"})
         elif op in ("check", "shrink"):
             try:
@@ -540,10 +577,63 @@ class CheckServer:
                 # no admission slots are held here (_handle_check admits
                 # only after validation and releases on its own errors;
                 # _handle_shrink releases in its finally)
-                send_doc(conn, {"id": req.get("id"), "ok": False,
+                self._send(conn, {"id": req.get("id"), "ok": False,
                                 "error": f"{type(e).__name__}: {e}"})
         else:
-            send_doc(conn, {"ok": False, "error": f"unknown op {op!r}"})
+            self._send(conn, {"ok": False, "error": f"unknown op {op!r}"})
+
+    # -- the replog anti-entropy ops (fleet/replog.py) -----------------
+    def _handle_replog(self, conn: socket.socket, op: str,
+                       req: dict) -> None:
+        """The segment-exchange surface a fleet router reconciles
+        through: ``digests`` advertises what this node holds (and has
+        absorbed — a peer must not think compaction lost anything),
+        ``pull`` ships whole sealed segments out, ``push`` adopts
+        replicated ones — fingerprint-verified, idempotent, and folded
+        into the live cache WITHOUT re-banking (each verdict lands on
+        this node's disk exactly once)."""
+        if self.replog is None:
+            self._send(conn, {"id": req.get("id"), "ok": False,
+                              "error": "node runs no replicated log "
+                                       "(start with replog_dir)"})
+            return
+        if op == "replog.digests":
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "digests": self.replog.digests(),
+                              "absorbed": self.replog.absorbed(),
+                              "active_rows":
+                                  self.replog.snapshot()["active_rows"]})
+            return
+        if op == "replog.pull":
+            segments = []
+            for name in list(req.get("segments") or [])[:64]:
+                got = self.replog.read_segment(str(name))
+                if got is not None:
+                    segments.append({"name": str(name),
+                                     "fingerprint": got[0],
+                                     "lines": got[1]})
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "segments": segments})
+            return
+        adopted = rows_in = 0
+        errors: List[str] = []
+        for seg in list(req.get("segments") or []):
+            try:
+                rows = self.replog.adopt(str(seg.get("name")),
+                                         str(seg.get("fingerprint")),
+                                         list(seg.get("lines") or []))
+            except (ValueError, OSError, AttributeError) as e:
+                errors.append(f"{type(e).__name__}: {e}"[:200])
+                continue
+            if rows:
+                adopted += 1
+                rows_in += self.cache.adopt_rows(rows)
+        self.obs.event("replog.adopt", segments=adopted, rows=rows_in)
+        doc = {"id": req.get("id"), "ok": True, "adopted": adopted,
+               "rows": rows_in}
+        if errors:
+            doc["errors"] = errors
+        self._send(conn, doc)
 
     # -- the check path ------------------------------------------------
     def _handle_check(self, conn: socket.socket, req: dict) -> None:
@@ -552,7 +642,7 @@ class CheckServer:
         t_req = time.perf_counter()
         model = req.get("model")
         if model not in MODELS:
-            send_doc(conn, {"id": req.get("id"), "ok": False,
+            self._send(conn, {"id": req.get("id"), "ok": False,
                             "error": f"unknown model {model!r}; one of "
                                      f"{sorted(MODELS)}"})
             return
@@ -560,7 +650,7 @@ class CheckServer:
         if rows_list is None and "history" in req:
             rows_list = [req["history"]]
         if not isinstance(rows_list, list) or not rows_list:
-            send_doc(conn, {"id": req.get("id"), "ok": False,
+            self._send(conn, {"id": req.get("id"), "ok": False,
                             "error": "request needs a non-empty "
                                      "'histories' (or 'history') array"})
             return
@@ -757,7 +847,7 @@ class CheckServer:
                 violations=doc.get("violations"),
                 cached=sum(bool(c) for c in doc.get("cached", ())))
         self._m_request_s.observe(dt)
-        send_doc(conn, doc)
+        self._send(conn, doc)
 
     # -- P-compositional split lanes (ops/pcomp.py) --------------------
     def _split_pays(self, entry: _EngineEntry, h: History) -> bool:
@@ -867,7 +957,7 @@ class CheckServer:
         t_req = time.perf_counter()
         model = req.get("model")
         if model not in MODELS:
-            send_doc(conn, {"id": req.get("id"), "ok": False,
+            self._send(conn, {"id": req.get("id"), "ok": False,
                             "error": f"unknown model {model!r}; one of "
                                      f"{sorted(MODELS)}"})
             return
@@ -876,7 +966,7 @@ class CheckServer:
                 and len(req["histories"]) == 1:
             rows = req["histories"][0]
         if not isinstance(rows, list) or not rows:
-            send_doc(conn, {"id": req.get("id"), "ok": False,
+            self._send(conn, {"id": req.get("id"), "ok": False,
                             "error": "shrink needs ONE non-empty "
                                      "'history' rows array"})
             return
@@ -1247,6 +1337,7 @@ class CheckServer:
             }
         return {
             "address": self.address,
+            "node": self.node_id,
             "uptime_s": round(time.monotonic() - self._t0, 1),
             "engine_kind": self.engine_kind,
             "workers": self.n_workers,

@@ -477,7 +477,9 @@ def cmd_serve(args) -> int:
         workers=args.workers, quarantine_after=args.quarantine_after,
         pcomp=not args.no_pcomp,
         trace_log=args.trace_log, flight_dir=args.flight_dir,
-        metrics_port=args.metrics_port)
+        metrics_port=args.metrics_port,
+        node_id=args.node_id, replog_dir=args.replog_dir,
+        replog_seal_rows=args.replog_seal_rows)
     warm = [m.strip() for m in args.warm.split(",")] if args.warm else []
     warm = [m for m in warm if m]
     unknown = sorted(set(warm) - set(MODELS))
@@ -492,6 +494,8 @@ def cmd_serve(args) -> int:
             server.warm(model)
         print(json.dumps({"serving": server.address,
                           "engine": args.engine,
+                          "node": args.node_id,
+                          "replog": args.replog_dir,
                           "workers": args.workers,
                           "max_lanes": args.max_lanes,
                           "flush_ms": args.flush_ms,
@@ -508,6 +512,130 @@ def cmd_serve(args) -> int:
     finally:
         server.stop()
     return 0
+
+
+def cmd_fleet(args) -> int:
+    """Run a fleet (qsm_tpu/fleet, docs/SERVING.md "Fleet"): N
+    CheckServer node processes behind a :class:`FleetRouter` that
+    speaks the unchanged client protocol — consistent-hash routing by
+    the verdict-cache identity, node-loss re-dispatch, segmented
+    replicated verdict logs with anti-entropy catch-up.  ``--addrs``
+    fronts nodes you started yourself; otherwise ``--nodes N`` local
+    node processes are spawned (and torn down with the router).
+    Prints ONE JSON line with the router address + node map, then
+    serves until a ``shutdown`` request (or SIGINT)."""
+    import os
+    import subprocess
+    import tempfile
+
+    from ..fleet.router import FleetRouter
+
+    nodes: list = []
+    procs: list = []
+    if args.addrs:
+        for i, addr in enumerate(a.strip() for a in args.addrs.split(",")
+                                 if a.strip()):
+            nodes.append((f"n{i}", addr))
+    else:
+        replog_root = args.replog_root or tempfile.mkdtemp(
+            prefix="qsm_fleet_replog_")
+        env = dict(os.environ)
+        # nodes run the host ladder (engine auto); like pool workers,
+        # none of them may race the operator's device plane
+        env["JAX_PLATFORMS"] = "cpu"
+        for i in range(args.nodes):
+            cmd = [sys.executable, "-m", "qsm_tpu", "serve",
+                   "--port", "0", "--node-id", f"n{i}",
+                   "--replog-dir", os.path.join(replog_root, f"n{i}")]
+            if args.workers:
+                cmd += ["--workers", str(args.workers)]
+            if args.warm:
+                cmd += ["--warm", args.warm]
+            procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                          text=True, env=env))
+        for i, proc in enumerate(procs):
+            line = proc.stdout.readline()  # the serve banner line
+            try:
+                nodes.append((f"n{i}", json.loads(line)["serving"]))
+            except (ValueError, KeyError):
+                for p in procs:
+                    p.terminate()
+                    try:
+                        p.wait(timeout=5.0)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                raise SystemExit(
+                    f"node {i} failed to start (no serve banner)")
+    router = FleetRouter(
+        nodes, host=args.host, port=args.port, unix_path=args.unix,
+        queue_depth=args.queue_depth,
+        quarantine_after=args.quarantine_after,
+        heartbeat_s=args.heartbeat_s,
+        anti_entropy_s=args.anti_entropy_s,
+        trace_log=args.trace_log, flight_dir=args.flight_dir,
+        metrics_port=args.metrics_port)
+    router.start()
+    try:
+        print(json.dumps({"fleet": router.address,
+                          "nodes": dict(nodes),
+                          "spawned": len(procs),
+                          "anti_entropy_s": args.anti_entropy_s,
+                          "trace_log": args.trace_log,
+                          "flight_dir": args.flight_dir}), flush=True)
+        router.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+        for proc in procs:
+            # deterministic node teardown: terminate → bounded wait →
+            # kill escalation (the pool's reap discipline, fleet level)
+            try:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=5.0)
+            except OSError:
+                pass
+    return 0
+
+
+def _render_stats_fleet(doc: dict) -> str:
+    """The ``stats --serve ROUTER --fleet`` view: the router's own
+    counters plus one row per node — live fleet health at a glance."""
+    lines = [
+        f"fleet router {doc.get('address', '?')}  uptime "
+        f"{doc.get('uptime_s', 0)}s  requests {doc.get('requests', 0)} "
+        f"histories {doc.get('histories', 0)}",
+        f"node_faults {doc.get('node_faults', 0)}  redispatches "
+        f"{doc.get('redispatches', 0)}  ladder_lanes "
+        f"{doc.get('ladder_lanes', 0)}  node_sheds "
+        f"{doc.get('node_sheds', 0)}",
+    ]
+    ae = doc.get("anti_entropy") or {}
+    lines.append(f"anti-entropy sweeps {ae.get('sweeps', 0)}  segments "
+                 f"{ae.get('segments_shipped', 0)}  rows "
+                 f"{ae.get('rows_shipped', 0)}")
+    fleet_nodes = doc.get("fleet_nodes") or {}
+    for n in (doc.get("membership") or {}).get("nodes", []):
+        nid = n.get("node")
+        state = ("QUARANTINED" if n.get("quarantined")
+                 else "up" if n.get("healthy") else "DOWN")
+        ns = fleet_nodes.get(nid) or {}
+        if "error" in ns:
+            detail = f"unreachable ({ns['error']})"
+        else:
+            cache = ns.get("cache") or {}
+            replog = cache.get("replog") or {}
+            detail = (f"requests {ns.get('requests', 0)}  histories "
+                      f"{ns.get('histories', 0)}  bank "
+                      f"{cache.get('entries', 0)} rows  segments "
+                      f"{replog.get('sealed_segments', '-')}")
+        lines.append(f"  {nid} [{state}] {n.get('address', '?')}  "
+                     f"{detail}")
+    return "\n".join(lines)
 
 
 def cmd_submit(args) -> int:
@@ -663,10 +791,19 @@ def cmd_stats(args) -> int:
                 return 0
         client = CheckClient(args.serve)
         try:
-            print(json.dumps(client.stats().get("stats", {})))
+            doc = client.stats().get("stats", {})
         finally:
             client.close()
+        if getattr(args, "fleet", False):
+            # the fleet view: a router's per-node health/traffic table
+            # (a plain server answers too — it just has no node rows)
+            print(_render_stats_fleet(doc))
+        else:
+            print(json.dumps(doc))
         return 0
+    if getattr(args, "fleet", False):
+        raise SystemExit("--fleet needs --serve ADDR (a running fleet "
+                         "router's stats verb is what it renders)")
     if getattr(args, "watch", False):
         raise SystemExit("--watch needs --serve ADDR (a running "
                          "server's stats verb is what refreshes)")
@@ -1441,7 +1578,66 @@ def main(argv=None) -> int:
                    help="serve live metrics in Prometheus exposition "
                         "format on GET /metrics at this port (0 = "
                         "ephemeral; printed in the serving line)")
+    p.add_argument("--node-id", default=None, metavar="ID",
+                   help="fleet node id (qsm_tpu/fleet): stamped on "
+                        "every response so router-merged answers say "
+                        "which node decided which lanes")
+    p.add_argument("--replog-dir", default=None, metavar="DIR",
+                   help="bank verdicts in a segmented replicated log "
+                        "(fleet/replog.py) instead of --cache's single "
+                        "file, and serve the replog.* anti-entropy ops")
+    p.add_argument("--replog-seal-rows", type=int, default=256,
+                   help="rows per sealed replog segment (the unit "
+                        "anti-entropy replicates; smaller = fresher "
+                        "replication, more segment files)")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "fleet",
+        help="run a multi-node fleet: N CheckServer nodes behind a "
+             "fault-tolerant router (qsm_tpu/fleet; docs/SERVING.md)")
+    p.add_argument("--nodes", type=int, default=2,
+                   help="local node processes to spawn (ignored with "
+                        "--addrs)")
+    p.add_argument("--addrs", default=None,
+                   help="comma-separated addresses of nodes you "
+                        "started yourself (host:port or unix paths)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="router TCP port (0 = ephemeral, printed)")
+    p.add_argument("--unix", default=None,
+                   help="router UNIX socket path instead of TCP")
+    p.add_argument("--workers", type=int, default=0,
+                   help="worker processes per spawned node "
+                        "(serve --workers)")
+    p.add_argument("--warm", default=None,
+                   help="comma-separated models each spawned node "
+                        "warms at start")
+    p.add_argument("--replog-root", default=None, metavar="DIR",
+                   help="root directory for spawned nodes' segmented "
+                        "verdict logs (default: a temp dir)")
+    p.add_argument("--queue-depth", type=int, default=4096,
+                   help="router admission bound (lanes in flight)")
+    p.add_argument("--quarantine-after", type=int, default=3,
+                   help="consecutive failed probes before a node is "
+                        "quarantined one-way (re-admitted on "
+                        "sustained health)")
+    p.add_argument("--heartbeat-s", type=float, default=1.0,
+                   help="membership probe beat seconds")
+    p.add_argument("--anti-entropy-s", type=float, default=3.0,
+                   help="anti-entropy sweep interval seconds (0 = "
+                        "off)")
+    p.add_argument("--trace-log", default=None, metavar="PATH",
+                   help="router span log (qsm-tpu trace <id> shows "
+                        "router->node hops)")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="router flight-recorder dumps (node death/"
+                        "quarantine/partition)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="router Prometheus /metrics port (per-node "
+                        "health + traffic series)")
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser(
         "trace",
@@ -1584,6 +1780,10 @@ def main(argv=None) -> int:
     p.add_argument("--watch", action="store_true",
                    help="with --serve: a refreshing terminal view of "
                         "the live counters (Ctrl-C exits)")
+    p.add_argument("--fleet", action="store_true",
+                   help="with --serve ROUTER_ADDR: render the fleet "
+                        "view (router counters + one health/traffic "
+                        "row per node)")
     p.add_argument("--interval", type=float, default=2.0,
                    help="--watch refresh interval seconds")
     p.add_argument("--watch-count", type=int, default=0,

@@ -134,3 +134,59 @@ def test_dus_cache_write_matches_onehot():
         out[mode] = (np.asarray(s), np.asarray(it))
     np.testing.assert_array_equal(out["dus"][0], out["onehot"][0])
     np.testing.assert_array_equal(out["dus"][1], out["onehot"][1])
+
+
+# --- the segmented replicated verdict bank (qsm_tpu/fleet/replog.py) -------
+# The serve-plane verdict cache generalizes to content-fingerprinted
+# segments a fleet replicates (ISSUE 12); these pin the edge cases the
+# single-file bank never had: torn ACTIVE tails on restart, catch-up
+# adoption concurrent with live banking, and compaction's absorbed-set
+# memory.  (tests/test_fleet.py carries the full-tier twins.)
+
+def test_segmented_bank_restart_after_seal_and_tear(tmp_path):
+    """A restarted node adopts every sealed segment plus the clean
+    prefix of the active segment; a garbled tail (SIGKILL mid-append)
+    is truncated, never replayed as a verdict."""
+    import os
+
+    from qsm_tpu.fleet.replog import SegmentedLog
+    from qsm_tpu.serve.cache import VerdictCache
+
+    log = SegmentedLog(str(tmp_path), node_id="n0", seal_rows=4)
+    cache = VerdictCache(max_entries=64, store=log)
+    for i in range(10):
+        cache.put(f"k{i}", i % 2, None)
+    assert log.snapshot()["sealed_segments"] == 2  # 8 rows sealed
+    with open(os.path.join(str(tmp_path), "active.jsonl"), "a") as f:
+        f.write('{"key": "k10", "verd')  # the torn row
+    log2 = SegmentedLog(str(tmp_path), node_id="n0", seal_rows=4)
+    assert log2.truncated_tails == 1
+    cache2 = VerdictCache(max_entries=64, store=log2)
+    assert len(cache2) == 10
+    for i in range(10):
+        assert cache2.get(f"k{i}").verdict == i % 2
+    assert cache2.get("k10") is None
+
+
+def test_segmented_bank_adoption_is_fingerprint_gated(tmp_path):
+    """Replication trusts nothing: an adopted segment must re-derive
+    its advertised content fingerprint or be refused outright, and a
+    re-adoption of a held segment is a no-op (idempotent catch-up)."""
+    import pytest as _pytest
+
+    from qsm_tpu.fleet.replog import SegmentedLog, segment_fingerprint
+    from qsm_tpu.serve.cache import VerdictCache
+
+    a = SegmentedLog(str(tmp_path / "a"), node_id="a", seal_rows=2)
+    VerdictCache(max_entries=64, store=a).put_many(
+        [("x", 1, None), ("y", 0, None)])
+    (name,) = a.digests()
+    fp, lines = a.read_segment(name)
+    b = SegmentedLog(str(tmp_path / "b"), node_id="b", seal_rows=2)
+    with _pytest.raises(ValueError):
+        b.adopt(name, fp, lines + ['{"key": "evil", "verdict": 0}'])
+    assert b.digests() == {}
+    rows = b.adopt(name, fp, lines)
+    assert [r["key"] for r in rows] == ["x", "y"]
+    assert b.adopt(name, fp, lines) == []  # idempotent
+    assert b.missing(a.digests()) == []

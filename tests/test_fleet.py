@@ -1,0 +1,630 @@
+"""Fleet tier (qsm_tpu/fleet, ISSUE 12): routing identity, node-loss
+re-dispatch, quarantine/re-admission, the segmented replicated verdict
+log with anti-entropy catch-up, SHED fleet blocks, and the
+kill-a-node acceptance (flight dump names the doomed trace ids and the
+span log shows the hop off the dead node)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from qsm_tpu.fleet.membership import HashRing, Membership
+from qsm_tpu.fleet.replog import SegmentedLog, segment_fingerprint
+from qsm_tpu.fleet.router import FleetRouter
+from qsm_tpu.models import AtomicCasSUT, CasSpec, RacyCasSUT
+from qsm_tpu.obs import Observability, load_dump, load_events, \
+    recent_events
+from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+from qsm_tpu.resilience.policy import preset
+from qsm_tpu.serve.cache import VerdictCache, fingerprint_key
+from qsm_tpu.serve.client import CheckClient
+from qsm_tpu.serve.protocol import VERDICT_NAMES
+from qsm_tpu.serve.server import CheckServer
+from qsm_tpu.utils.corpus import build_corpus
+
+SPEC = CasSpec()
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return build_corpus(SPEC, (AtomicCasSUT, RacyCasSUT), n=12,
+                        n_pids=4, max_ops=10, seed_base=0,
+                        seed_prefix="fleet")
+
+
+@pytest.fixture(scope="module")
+def expected(corpus):
+    oracle = WingGongCPU(memo=True)
+    return [VERDICT_NAMES[int(v)]
+            for v in oracle.check_histories(SPEC, corpus)]
+
+
+def _failing_history():
+    oracle = WingGongCPU(memo=True)
+    pool = build_corpus(SPEC, (AtomicCasSUT, RacyCasSUT), n=24,
+                        n_pids=6, max_ops=16, seed_base=0,
+                        seed_prefix="bench_fleet_shrink")
+    for h in pool:
+        if int(oracle.check_histories(SPEC, [h])[0]) == 0:
+            return h
+    raise AssertionError("seeded pool produced no violation")
+
+
+def _fleet(tmp_path, n_nodes=2, seal_rows=8, router_kw=None,
+           node_kw=None):
+    nodes = [CheckServer(node_id=f"n{i}",
+                         replog_dir=str(tmp_path / f"replog{i}"),
+                         replog_seal_rows=seal_rows, flush_s=0.005,
+                         **(node_kw or {})).start()
+             for i in range(n_nodes)]
+    router = FleetRouter(
+        [(s.node_id, s.address) for s in nodes],
+        policy=preset("fleet-route").with_(timeout_s=3.0),
+        probe_policy=preset("fleet-probe").with_(timeout_s=1.0),
+        heartbeat_s=0.2, anti_entropy_s=0.0,
+        **(router_kw or {})).start()
+    return router, nodes
+
+
+def _teardown(router, nodes):
+    router.stop()
+    for s in nodes:
+        s.stop()
+
+
+# --- routing identity ------------------------------------------------------
+
+def test_hash_ring_is_deterministic_and_stable_under_exclusion():
+    ring = HashRing(["n0", "n1", "n2"], vnodes=32)
+    allowed = {"n0", "n1", "n2"}
+    keys = [f"key{i}" for i in range(200)]
+    owners = {k: ring.node_for(k, allowed) for k in keys}
+    assert owners == {k: ring.node_for(k, allowed) for k in keys}
+    assert set(owners.values()) == allowed  # all nodes take traffic
+    # consistent: excluding one node moves ONLY its keys
+    for k in keys:
+        moved = ring.node_for(k, allowed, exclude={"n1"})
+        if owners[k] != "n1":
+            assert moved == owners[k]
+        else:
+            assert moved in ("n0", "n2")
+    assert ring.node_for("x", set()) is None
+
+
+def test_membership_quarantine_and_readmission():
+    """One-way quarantine after repeated failures; re-admission only
+    on SUSTAINED health (readmit_after consecutive good probes)."""
+    m = Membership([("n0", "unused:1"), ("n1", "unused:2")],
+                   quarantine_after=3, readmit_after=2)
+    err = RuntimeError("boom")
+    m.note_failure("n0", err)
+    # one failure is suspicion, not death (down_after grace): the node
+    # stays routable so a single slow probe can't flap its keys away
+    assert "n0" in m.healthy_ids()
+    m.note_failure("n0", err)
+    assert "n0" not in m.healthy_ids()     # down after the streak
+    assert not m._nodes["n0"].quarantined  # but not yet quarantined
+    # an empty healthy set never starves routing: non-quarantined
+    # nodes stay routable (the dispatch ladder handles true death)
+    m.note_failure("n1", err)
+    m.note_failure("n1", err)
+    assert m.healthy_ids() == set()
+    assert m.routable_ids() == {"n0", "n1"}
+    m.note_success("n1")
+    m.note_failure("n0", err)
+    assert m._nodes["n0"].quarantined
+    assert m.shed_state() == {"nodes": 2, "live": 1, "quarantined": 1}
+    # one good answer is luck, not health
+    m.note_success("n0")
+    assert "n0" not in m.healthy_ids()
+    m.note_success("n0")
+    assert "n0" in m.healthy_ids()
+    assert m.readmissions == 1
+    # a fresh failure streak needs the full threshold again
+    m.note_failure("n0", err)
+    assert not m._nodes["n0"].quarantined
+
+
+# --- the routed check path -------------------------------------------------
+
+def test_routed_verdicts_match_oracle_and_stamp_nodes(tmp_path, corpus,
+                                                      expected):
+    router, nodes = _fleet(tmp_path, n_nodes=2)
+    try:
+        with CheckClient(router.address, timeout_s=60.0) as c:
+            res = c.check("cas", corpus)
+            assert res["ok"]
+            assert res["verdicts"] == expected
+            assert res["node"] == "router"          # egress stamp
+            assert sum(res["nodes"].values()) == len(corpus)
+            assert set(res["nodes"]) <= {"n0", "n1"}
+            # identical traffic routes to the same nodes: every lane a
+            # banked O(1) hit the second time (the hot-cache identity)
+            res2 = c.check("cas", corpus)
+            assert res2["verdicts"] == expected
+            assert all(res2["cached"])
+            # witnesses ride through the router unchanged
+            resw = c.check("cas", corpus[:4], witness=True)
+            assert resw["verdicts"] == expected[:4]
+            assert len(resw["witnesses"]) == 4
+            # shrink routes to the owner node and answers 1-minimal
+            viol = _failing_history()
+            sres = c.shrink("cas", viol)
+            assert sres["ok"] and sres["verdict"] == "VIOLATION"
+            assert sres["final_ops"] <= len(viol)
+            assert sres["node"] in ("n0", "n1")
+            # stats carries the fleet view
+            st = c.stats()["stats"]
+            assert st["role"] == "router"
+            assert sorted(st["fleet_nodes"]) == ["n0", "n1"]
+    finally:
+        _teardown(router, nodes)
+
+
+def test_pcomp_split_traffic_routes_and_matches(tmp_path):
+    """kv traffic decomposes into per-key sub-lanes ON the nodes; the
+    routed whole-history verdicts still match the oracle."""
+    from qsm_tpu.models.registry import MODELS
+
+    entry = MODELS["kv"]
+    spec = entry.make_spec()
+    hists = build_corpus(spec,
+                         (entry.impls["atomic"], entry.impls["racy"]),
+                         n=6, n_pids=8, max_ops=24, seed_base=100,
+                         seed_prefix="fleet_kv")
+    oracle = WingGongCPU(memo=True)
+    want = [VERDICT_NAMES[int(v)]
+            for v in oracle.check_histories(spec, hists)]
+    router, nodes = _fleet(tmp_path, n_nodes=2)
+    try:
+        with CheckClient(router.address, timeout_s=120.0) as c:
+            res = c.check("kv", hists)
+            assert res["ok"] and res["verdicts"] == want
+        assert any(s.pcomp_split > 0 for s in nodes)  # really split
+    finally:
+        _teardown(router, nodes)
+
+
+def test_fleet_shed_carries_node_state_block(tmp_path, corpus):
+    router, nodes = _fleet(tmp_path, n_nodes=2,
+                           router_kw={"queue_depth": 1})
+    try:
+        with CheckClient(router.address, timeout_s=30.0) as c:
+            res = c.check("cas", corpus)  # 12 lanes > depth 1
+            assert res.get("shed") and not res.get("ok")
+            assert res["node"] == "router"
+            assert res["fleet"]["nodes"] == 2
+            assert res["fleet"]["live"] == 2
+            assert "trace" in res
+    finally:
+        _teardown(router, nodes)
+
+
+def test_full_partition_degrades_to_ladder(tmp_path, corpus, expected,
+                                           monkeypatch):
+    """partition:node@1 drops EVERY router→node exchange both
+    directions: the exclude-and-re-dispatch ladder runs dry and the
+    router's own in-process host ladder answers — exact verdicts,
+    node_faults counted, fault site fired."""
+    router, nodes = _fleet(tmp_path, n_nodes=2)
+    try:
+        monkeypatch.setenv("QSM_TPU_FAULTS", "partition:node@1")
+        with CheckClient(router.address, timeout_s=60.0) as c:
+            res = c.check("cas", corpus)
+            assert res["ok"] and res["verdicts"] == expected
+            assert res["node_faults"] >= 1
+            assert res["nodes"] == {"router": len(corpus)}
+            assert any(b["flush"] == "ladder" for b in res["batches"])
+            # the batch cost record says the batch survived node loss
+            assert any(b.get("search", {}).get("ndf", 0) >= 1
+                       for b in res["batches"])
+        monkeypatch.delenv("QSM_TPU_FAULTS")
+        st = router.stats()
+        assert st["node_faults"] >= 1
+        assert st["ladder_lanes"] >= len(corpus)
+    finally:
+        _teardown(router, nodes)
+
+
+def test_partial_partition_redispatches_to_survivor(tmp_path, corpus,
+                                                    expected,
+                                                    monkeypatch):
+    """partition:node@2 (the link dies mid-request and STAYS dead):
+    whatever sub-request hits it re-dispatches — to the other node if
+    its link still answers, else down to the ladder — with a
+    route.hop span either way, and verdicts exact."""
+    trace_log = str(tmp_path / "trace.jsonl")
+    router, nodes = _fleet(tmp_path, n_nodes=2,
+                           router_kw={"trace_log": trace_log})
+    try:
+        monkeypatch.setenv("QSM_TPU_FAULTS", "partition:node@2")
+        with CheckClient(router.address, timeout_s=60.0) as c:
+            res = c.check("cas", corpus)
+            assert res["ok"] and res["verdicts"] == expected
+        monkeypatch.delenv("QSM_TPU_FAULTS")
+        router.obs.tracer.close()
+        events = load_events(trace_log, trace_id=res["trace"])
+        hops = [e for e in events if e.get("name") == "route.hop"]
+        assert hops, "re-dispatch must leave a route.hop span"
+    finally:
+        _teardown(router, nodes)
+
+
+# --- the kill-a-node acceptance (subprocess nodes, real SIGKILL) ----------
+
+def _spawn_node(nid: str, tmp_path) -> tuple:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("QSM_TPU_FAULTS", None)
+    unix = str(tmp_path / f"{nid}.sock")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "qsm_tpu", "serve", "--unix", unix,
+         "--node-id", nid,
+         "--replog-dir", str(tmp_path / f"replog_{nid}")],
+        stdout=subprocess.PIPE, text=True, env=env)
+    banner = json.loads(proc.stdout.readline())
+    assert banner["serving"] == unix
+    return proc, unix
+
+
+def test_sigkill_node_mid_soak_redispatches_with_artifacts(tmp_path,
+                                                           corpus,
+                                                           expected):
+    """THE acceptance pin: a mid-soak SIGKILLed node produces a flight
+    dump naming the re-dispatched trace ids, and the span log (what
+    ``qsm-tpu trace <id>`` renders) shows the hop from the dead node
+    to the surviving one — while every verdict stays exact."""
+    procs = {}
+    for nid in ("n0", "n1"):
+        procs[nid] = _spawn_node(nid, tmp_path)
+    trace_log = str(tmp_path / "router_trace.jsonl")
+    flight_dir = str(tmp_path / "flight")
+    router = FleetRouter(
+        [(nid, unix) for nid, (_p, unix) in procs.items()],
+        policy=preset("fleet-route").with_(timeout_s=2.0),
+        probe_policy=preset("fleet-probe").with_(timeout_s=1.0),
+        heartbeat_s=0.3, anti_entropy_s=0.0,
+        trace_log=trace_log, flight_dir=flight_dir).start()
+    try:
+        # the victim: whichever node owns the first history's key
+        key = fingerprint_key(SPEC, corpus[0])
+        victim = router.membership.node_for(key)
+        survivor = "n1" if victim == "n0" else "n0"
+        wrong = []
+        errors = []
+
+        def drive():
+            with CheckClient(router.address, timeout_s=60.0) as c:
+                for _ in range(6):
+                    res = c.check("cas", corpus)
+                    if not res.get("ok"):
+                        errors.append(res)
+                    elif res["verdicts"] != expected:
+                        wrong.append(res["verdicts"])
+
+        t = threading.Thread(target=drive)
+        t.start()
+        time.sleep(0.2)
+        os.kill(procs[victim][0].pid, signal.SIGKILL)
+        t.join(120.0)
+        assert not wrong and not errors, (wrong, errors)
+        assert router.stats()["node_faults"] >= 1
+        router.obs.tracer.close()
+        # 1) the flight dump names the doomed dispatches' trace ids
+        dumps = [f for f in sorted(os.listdir(flight_dir))
+                 if "node_death" in f]
+        assert dumps, os.listdir(flight_dir)
+        doomed = []
+        for name in dumps:
+            dump = load_dump(os.path.join(flight_dir, name))
+            for ev in recent_events(dump, "node"):
+                at = ev.get("attrs") or {}
+                if (ev.get("name") == "node.shed"
+                        and at.get("node") == victim):
+                    doomed.extend(at.get("traces") or [])
+        assert doomed, "dump must name the re-dispatched trace ids"
+        # 2) qsm-tpu trace <id>: the hop off the dead node is visible
+        hop = None
+        for trace_id in doomed:
+            for ev in load_events(trace_log, trace_id=trace_id):
+                at = ev.get("attrs") or {}
+                if (ev.get("name") == "route.hop"
+                        and at.get("hop_from") == victim):
+                    hop = at
+        assert hop is not None
+        assert hop["hop_to"] in (survivor, "ladder")
+    finally:
+        router.stop()
+        for proc, _unix in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=5.0)
+
+
+# --- the replicated log ----------------------------------------------------
+
+def test_replog_concurrent_catchup_banks_exactly_once(tmp_path):
+    """Anti-entropy adoption CONCURRENT with live put_many: every
+    adopted verdict lands on disk exactly once (in its adopted
+    segment, never re-banked into the local active segment), and the
+    live set holds each key exactly once."""
+    a = SegmentedLog(str(tmp_path / "a"), node_id="a", seal_rows=4)
+    ca = VerdictCache(max_entries=4096, store=a)
+    ca.put_many([(f"ka{i}", i % 2, None) for i in range(16)])
+    b = SegmentedLog(str(tmp_path / "b"), node_id="b", seal_rows=4)
+    cb = VerdictCache(max_entries=4096, store=b)
+
+    stop = threading.Event()
+    put_batches = [0]
+
+    def live_puts():
+        i = 0
+        while not stop.is_set():
+            cb.put_many([(f"kb{i}_{j}", 0, None) for j in range(3)])
+            put_batches[0] += 1
+            i += 1
+            time.sleep(0.001)
+
+    t = threading.Thread(target=live_puts)
+    t.start()
+    try:
+        for name in b.missing(a.digests()):
+            got = a.read_segment(name)
+            rows = b.adopt(name, got[0], got[1])
+            cb.adopt_rows(rows)
+    finally:
+        stop.set()
+        t.join(5.0)
+    cb.flush()
+    # adopting again is a no-op (idempotent catch-up)
+    for name in a.digests():
+        assert b.adopt(name, *a.read_segment(name)) == []
+    # exactly-once on disk: ka* rows live ONLY in segments sealed by
+    # node a; b's own segments carry only kb* rows
+    on_disk = {}
+    for name in b.digests():
+        _fp, lines = b.read_segment(name)
+        for ln in lines:
+            row = json.loads(ln)
+            on_disk.setdefault(row["key"], []).append(name)
+    for key, segs in on_disk.items():
+        if key.startswith("ka"):
+            assert len(segs) == 1 and segs[0].startswith("seg-a-"), \
+                (key, segs)
+    # every adopted verdict present and correct in the live set
+    for i in range(16):
+        assert cb.get(f"ka{i}").verdict == i % 2
+    # a restart reloads the union
+    cb2 = VerdictCache(max_entries=4096,
+                       store=SegmentedLog(str(tmp_path / "b"),
+                                          node_id="b", seal_rows=4))
+    for i in range(16):
+        assert cb2.get(f"ka{i}").verdict == i % 2
+
+
+def test_replog_torn_tail_truncated_not_replayed(tmp_path):
+    log = SegmentedLog(str(tmp_path / "n"), node_id="n", seal_rows=100)
+    c = VerdictCache(max_entries=100, store=log)
+    c.put("good", 1, None)
+    # a SIGKILL mid-append: half a row at the active tail
+    with open(os.path.join(str(tmp_path / "n"), "active.jsonl"),
+              "a") as f:
+        f.write('{"key": "torn", "verd')
+    log2 = SegmentedLog(str(tmp_path / "n"), node_id="n",
+                        seal_rows=100)
+    assert log2.truncated_tails == 1
+    c2 = VerdictCache(max_entries=100, store=log2)
+    assert c2.get("good").verdict == 1   # everything before the tear
+    assert c2.get("torn") is None        # the torn row is NOT a verdict
+    # and the truncation restored a clean boundary: appends keep working
+    c2.put("after", 0, None)
+    c3 = VerdictCache(max_entries=100,
+                      store=SegmentedLog(str(tmp_path / "n"),
+                                         node_id="n", seal_rows=100))
+    assert c3.get("after").verdict == 0
+
+
+def test_replog_compaction_during_catchup_keeps_later_row_wins(
+        tmp_path):
+    """Compaction concurrent with catch-up: the post-merge entry (the
+    later local row's verdict + the banked witness) survives, the
+    absorbed segments are remembered so the anti-entropy diff never
+    re-pulls them."""
+    a = SegmentedLog(str(tmp_path / "a"), node_id="a", seal_rows=2)
+    ca = VerdictCache(max_entries=4096, store=a)
+    ca.put_many([(f"x{i}", 1, None) for i in range(4)])
+    b = SegmentedLog(str(tmp_path / "b"), node_id="b", seal_rows=2)
+    cb = VerdictCache(max_entries=4096, store=b)
+    cb.put("k", 1, [(0, 5)])        # banked with witness
+    cb.put("k", 0, None)            # later row wins the verdict...
+    assert cb.get("k").witness == [(0, 5)]  # ...witness post-merged
+    for name in b.missing(a.digests()):
+        cb.adopt_rows(b.adopt(name, *a.read_segment(name)))
+    # force a compaction mid-catch-up
+    cb.put_many([(f"y{i}", 0, None) for i in range(40)])
+    pre = b.snapshot()
+    b.compact(cb._live_lines())
+    assert b.snapshot()["absorbed_segments"] >= pre["sealed_segments"]
+    # absorbed segments are never re-pulled
+    assert b.missing(a.digests()) == []
+    # later-row-wins + witness preserved through compaction
+    cb2 = VerdictCache(max_entries=4096,
+                       store=SegmentedLog(str(tmp_path / "b"),
+                                          node_id="b", seal_rows=2))
+    assert cb2.get("k").verdict == 0
+    assert cb2.get("k").witness == [(0, 5)]
+    for i in range(4):
+        assert cb2.get(f"x{i}").verdict == 1
+
+
+def test_replog_corrupt_segment_quarantined(tmp_path):
+    log = SegmentedLog(str(tmp_path / "n"), node_id="n", seal_rows=2)
+    VerdictCache(max_entries=100, store=log).put_many(
+        [("a", 1, None), ("b", 0, None)])
+    (name,) = log.digests()
+    path = os.path.join(str(tmp_path / "n"), name)
+    with open(path, "a") as f:
+        f.write('{"key": "evil", "verdict": 0}\n')  # fingerprint broken
+    log2 = SegmentedLog(str(tmp_path / "n"), node_id="n", seal_rows=2)
+    assert log2.quarantined_segments == 1
+    assert log2.digests() == {}              # never served or offered
+    assert os.path.exists(path + ".quarantine")
+    # and a forged push is refused
+    with pytest.raises(ValueError):
+        log2.adopt("seg-x-000001-000000000000.jsonl",
+                   segment_fingerprint(["row"]), ["other"])
+
+
+def test_anti_entropy_sweep_converges_fleet(tmp_path, corpus,
+                                            expected):
+    """The router's sweep ships every sealed segment everywhere; a
+    node that saw none of the traffic then answers the whole corpus
+    from its adopted bank."""
+    router, nodes = _fleet(tmp_path, n_nodes=2, seal_rows=1)
+    try:
+        with CheckClient(router.address, timeout_s=60.0) as c:
+            c.check("cas", corpus)
+        for s in nodes:
+            s.cache.flush()
+        for _ in range(8):
+            if router.anti_entropy_sweep()["segments_shipped"] == 0:
+                break
+        d0 = nodes[0].replog.digests()
+        d1 = nodes[1].replog.digests()
+        assert set(d0) == set(d1) and d0 == d1
+        # every node now holds every whole-history verdict
+        for s in nodes:
+            for h in corpus:
+                key = fingerprint_key(SPEC, h)
+                e = s.cache.get(key)
+                assert e is not None
+                assert VERDICT_NAMES[e.verdict] == \
+                    expected[corpus.index(h)]
+    finally:
+        _teardown(router, nodes)
+
+
+# --- CLI surfaces ----------------------------------------------------------
+
+def test_stats_fleet_render(tmp_path, corpus):
+    from qsm_tpu.utils.cli import _render_stats_fleet
+
+    router, nodes = _fleet(tmp_path, n_nodes=2)
+    try:
+        with CheckClient(router.address, timeout_s=60.0) as c:
+            c.check("cas", corpus)
+        text = _render_stats_fleet(router.stats())
+        assert "fleet router" in text
+        assert "n0 [up]" in text and "n1 [up]" in text
+    finally:
+        _teardown(router, nodes)
+
+
+def test_node_stamps_on_plain_server(tmp_path, corpus):
+    """A node started with --node-id stamps every response — ok, error
+    and stats alike (the protocol `node` stamp satellite)."""
+    srv = CheckServer(node_id="solo",
+                      replog_dir=str(tmp_path / "replog")).start()
+    try:
+        with CheckClient(srv.address, timeout_s=30.0) as c:
+            res = c.check("cas", corpus[:2])
+            assert res["node"] == "solo"
+            bad = c.check("nope", corpus[:1])
+            assert bad["node"] == "solo" and not bad["ok"]
+            st = c.stats()
+            assert st["node"] == "solo"
+            assert st["stats"]["node"] == "solo"
+            assert st["stats"]["cache"]["replog"]["node"] == "solo"
+    finally:
+        srv.stop()
+
+
+def test_link_saturation_is_busy_not_node_death(tmp_path, corpus):
+    """Every pooled link slot mid-request is router-local backpressure
+    (NodeBusy), never node-health evidence — a hot node must not be
+    probed toward quarantine by its own popularity (the WorkerBusy
+    lesson one level down)."""
+    from qsm_tpu.fleet.router import NodeBusy, NodeFault, NodeLink
+
+    srv = CheckServer().start()
+    try:
+        link = NodeLink("n0", srv.address)
+        link._sema = threading.BoundedSemaphore(1)
+        link._sema.acquire()
+        with pytest.raises(NodeBusy) as ei:
+            link.request({"op": "stats"}, timeout_s=0.2)
+        assert not isinstance(ei.value, NodeFault)  # not shed-worthy
+        link._sema.release()
+        assert link.request({"op": "stats"}, timeout_s=5.0)["ok"]
+    finally:
+        srv.stop()
+
+
+def test_cache_path_and_replog_dir_refused(tmp_path):
+    """Two banks, one truth: --cache and --replog-dir together would
+    silently abandon the single-file bank — refused loudly instead."""
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        CheckServer(cache_path=str(tmp_path / "bank.jsonl"),
+                    replog_dir=str(tmp_path / "replog"))
+
+
+def test_stale_pooled_socket_retries_on_fresh_connection(tmp_path,
+                                                         corpus):
+    """A pooled socket dying across a node restart must read as 'this
+    socket died', not 'this node died': the link retries once fresh
+    (safe — every fleet op is idempotent) before raising NodeDead."""
+    from qsm_tpu.fleet.router import NodeDead, NodeLink
+
+    unix = str(tmp_path / "n.sock")
+    srv = CheckServer(unix_path=unix, node_id="n0").start()
+    link = NodeLink("n0", unix)
+    try:
+        assert link.request({"op": "stats"}, timeout_s=5.0)["ok"]
+        assert len(link._free) == 1          # pooled
+        srv.stop()                           # restart on the SAME path
+        srv = CheckServer(unix_path=unix, node_id="n0").start()
+        # the pooled socket is stale; the request must still succeed
+        resp = link.request({"op": "stats"}, timeout_s=5.0)
+        assert resp["ok"] and resp["node"] == "n0"
+        srv.stop()
+        # with the node REALLY gone (socket path unlinked by stop()),
+        # the fresh retry fails too: NodeDead.  Pooled sockets dropped
+        # first — a half-stopped connection thread may still answer
+        # one last pooled request, which is fine in production but
+        # nondeterministic here.
+        link.close_all()
+        with pytest.raises(NodeDead):
+            link.request({"op": "stats"}, timeout_s=2.0)
+    finally:
+        srv.stop()
+        link.close_all()
+
+
+def test_replog_adopt_refuses_name_fingerprint_mismatch(tmp_path):
+    """A segment whose NAME disagrees with its content fingerprint
+    would persist now and quarantine on every restart (a permanent
+    re-pull churn loop) — refused at adoption time."""
+    from qsm_tpu.fleet.replog import SegmentedLog, segment_fingerprint
+
+    log = SegmentedLog(str(tmp_path), node_id="b", seal_rows=2)
+    lines = ['{"key": "k", "verdict": 1, "witness": null}']
+    fp = segment_fingerprint(lines)
+    bad_name = "seg-x-000001-aaaaaaaaaaaa.jsonl"
+    assert fp[:12] != "aaaaaaaaaaaa"
+    with pytest.raises(ValueError, match="name does not match"):
+        log.adopt(bad_name, fp, lines)
+    assert log.digests() == {}
+    # the consistent pair adopts fine
+    good = f"seg-x-000001-{fp[:12]}.jsonl"
+    assert [r["key"] for r in log.adopt(good, fp, lines)] == ["k"]

@@ -15,7 +15,8 @@ import pytest
 import qsm_tpu.analysis.fixtures as fixtures
 from qsm_tpu.analysis import (ERROR, FAMILIES, Finding, Whitelist,
                               run_lint)
-from qsm_tpu.analysis.engine import (DEFAULT_OBS_FILES,
+from qsm_tpu.analysis.engine import (DEFAULT_FLEET_FILES,
+                                     DEFAULT_OBS_FILES,
                                      DEFAULT_OPS_FILES,
                                      DEFAULT_POOL_FILES,
                                      DEFAULT_RACE_FILES,
@@ -53,15 +54,17 @@ def test_in_tree_corpus_is_clean(report):
     assert "resilience" in report.passes
     # the serving plane (family e): every connection-accepting /
     # lane-buffering module (the pool supervisor and worker recv loops
-    # included) plus the serve bench tool
-    assert len(DEFAULT_SERVE_FILES) == 10
+    # included) plus the serve bench tool — and, since r12, the fleet
+    # tier's router/membership/replog + its soak bench
+    assert len(DEFAULT_SERVE_FILES) == 14
     assert "serve" in report.passes
     # the worker-lifecycle plane (family f): spawn/supervise/bench
     assert len(DEFAULT_POOL_FILES) == 3
     assert "pool" in report.passes
     # the whole-program race plane (family g): serve + resilience +
-    # tools, analyzed as one closed program (the shrink plane included)
-    assert len(DEFAULT_RACE_FILES) >= 17
+    # tools, analyzed as one closed program (the shrink plane and the
+    # fleet tier included)
+    assert len(DEFAULT_RACE_FILES) >= 21
     assert "race" in report.passes
     # the shrink plane's frontier-bound family (h)
     assert "shrink" in report.passes
@@ -69,9 +72,13 @@ def test_in_tree_corpus_is_clean(report):
     # cardinality over obs/ + serve/ + resilience/
     assert len(DEFAULT_OBS_FILES) >= 17
     assert "obs" in report.passes
-    # a–i all registered and all ran in the default lane
-    assert sorted(FAMILIES) == list("abcdefghi")
-    assert report.families == list("abcdefghi")
+    # the fleet re-dispatch family (j): router/membership/replog +
+    # the soak bench
+    assert len(DEFAULT_FLEET_FILES) == 4
+    assert "fleet" in report.passes
+    # a–j all registered and all ran in the default lane
+    assert sorted(FAMILIES) == list("abcdefghij")
+    assert report.families == list("abcdefghij")
     assert report.ok, "\n".join(
         f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
 
@@ -216,6 +223,44 @@ def test_obs_live_tree_is_clean():
     for rel in DEFAULT_OBS_FILES:
         findings += check_obs_file(os.path.join(REPO_ROOT, rel),
                                    root=REPO_ROOT)
+    assert findings == []
+
+
+def test_fleet_redispatch_is_caught():
+    """The fleet pass's bulb check (family j): the while-True
+    re-dispatch loop (no attempt budget) and the bounded loop that
+    never excludes the failed node each fire QSM-FLEET-REDISPATCH
+    exactly once; the tried-set + exclude= twin must NOT be flagged."""
+    from qsm_tpu.analysis.fleet_passes import check_fleet_file
+
+    findings = check_fleet_file(fixtures.__file__)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule_id, []).append(f)
+    hits = by_rule.pop("QSM-FLEET-REDISPATCH")
+    assert len(hits) == 2
+    assert {f.severity for f in hits} == {ERROR}
+    # the two seeded forms, in source order: unbounded first (the
+    # while-True stub), non-excluding second; the sanctioned
+    # BoundedRedispatchRouterStub (tried.add + exclude=) stays clean
+    assert "no bounded attempt budget" in hits[0].message
+    assert "never excludes the failed node" in hits[1].message
+    assert not by_rule  # nothing else fires on the fixture module
+
+
+def test_fleet_live_tree_is_clean():
+    """The fleet tier itself keeps the discipline its pass gates:
+    bounded attempts from the fleet-route preset + tried-set
+    exclusion (fleet/router.py _dispatch_group is the model)."""
+    import os
+
+    from qsm_tpu.analysis.engine import REPO_ROOT
+    from qsm_tpu.analysis.fleet_passes import check_fleet_file
+
+    findings = []
+    for rel in DEFAULT_FLEET_FILES:
+        findings += check_fleet_file(os.path.join(REPO_ROOT, rel),
+                                     root=REPO_ROOT)
     assert findings == []
 
 

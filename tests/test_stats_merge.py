@@ -36,7 +36,7 @@ _ADDITIVE = ("lockstep_iters", "nodes_explored", "memo_prunes",
              "memo_inserts", "compactions", "chunk_rounds", "rescued",
              "deferred", "tail_histories", "segments_split",
              "segments_total", "degradations", "retries",
-             "worker_faults", "pcomp_split", "pcomp_subs",
+             "worker_faults", "node_faults", "pcomp_split", "pcomp_subs",
              "pcomp_recombine_ms", "shrink_rounds", "shrink_lanes",
              "shrink_memo_hits", "obs_events")
 
@@ -161,12 +161,13 @@ def test_to_compact_full_key_set_and_values():
     c = st.to_compact()
     assert sorted(c) == sorted(
         ("iph", "nph", "prunes", "rescued", "segs", "ord", "plan",
-         "deg", "fb", "wf", "pcs", "pcn", "pcm", "shr", "shl", "shm",
-         "sho", "obe"))
+         "deg", "fb", "wf", "ndf", "pcs", "pcn", "pcm", "shr", "shl",
+         "shm", "sho", "obe"))
     assert c["pcm"] == st.pcomp_max_sub
     assert c["sho"] == st.shrink_ratio_pct
     assert c["obe"] == st.obs_events
     assert c["wf"] == st.worker_faults
+    assert c["ndf"] == st.node_faults
     assert c["iph"] == round(st.lockstep_iters / st.histories, 1)
     assert c["nph"] == round(st.nodes_explored / st.histories, 1)
 

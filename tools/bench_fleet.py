@@ -1,0 +1,658 @@
+"""Fleet-tier soak — survival under node kill/wedge/partition/restart.
+
+ISSUE 12's tier is judged on *survival*, not just speed: verdicts must
+stay correct and available while fleet nodes crash, wedge, partition
+and restart.  This harness replays ONE recorded traffic mix — plain
+``check`` corpora (cas), pcomp-split corpora (kv, multireg — per-key
+sub-lanes on the nodes), and ``shrink`` requests on failing histories
+— against 1/2/3-node fleets behind a :class:`~qsm_tpu.fleet.router.
+FleetRouter`, with chaos cells driven through the faults plane and
+plain POSIX signals:
+
+* ``fleet_n{1,2,3}`` — the healthy scaling sweep at the same client
+  load; EVERY response oracle-verified (``wrong_verdicts`` required 0);
+* ``kill_node``      — SIGKILL the busiest node MID-soak: undecided
+  lanes re-dispatch to survivors, the router's flight dump must name
+  the doomed dispatches' trace ids, and the span log must show the
+  ``route.hop`` from the dead node to the surviving one (the
+  ``qsm-tpu trace <id>`` acceptance, checked from the same log);
+* ``wedge_node``     — SIGSTOP a node (alive, silent — the wedge the
+  worker pool knows one level down): bounded link timeouts shed it,
+  lanes re-dispatch, zero wrong answers;
+* ``partition``      — ``QSM_TPU_FAULTS=partition:node:p`` drops a
+  random fraction of router→node exchanges both directions (seeded,
+  replayable): the exclude-and-re-dispatch ladder absorbs every drop;
+* ``rolling_restart``— restart every node IN SEQUENCE (SIGKILL +
+  respawn on the same replog dir/address), anti-entropy catch-up
+  between steps, then the whole recorded mix re-submitted: zero wrong
+  verdicts AND zero lost banked verdicts (every check lane answers
+  from the bank — ``cached`` all true) and shrink results bit-equal.
+
+Scaling honesty (the r08 precedent): the ≥2× three-node gate needs
+``host_cores >= nodes + 1`` to be physically expressible — three node
+processes cannot out-check one on a single core.  The summary stamps
+``host_cores``, the measured ratio, and ``gate_waived_insufficient_
+cores`` when the machine cannot express the gate; correctness gates
+(zero wrong, zero lost, chaos-cell survival) are NEVER waived.
+
+Output: a resumable ``CellJournal`` (``--resume`` re-runs zero
+completed cells) committed as ``BENCH_FLEET_<tag>.json``
+(``make bench-fleet``; probe_watcher archives it off-window).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_CLIENTS = 4
+ROUNDS = 2            # mix replays per client in a scaling cell
+CHAOS_ROUNDS = 4      # longer soak so mid-run faults land mid-run
+SUBPROC_TIMEOUT_S = 600.0
+KILL_AFTER_S = 0.3   # early: later soak rounds are bank hits and fly
+LINK_TIMEOUT_S = 3.0  # router→node bound for the chaos cells
+
+
+# ---------------------------------------------------------------------------
+# the recorded traffic mix
+# ---------------------------------------------------------------------------
+
+def _build_mix():
+    """The recorded mix: (kind, model, payload) requests — cas check
+    corpora, pcomp-splitting kv/multireg corpora, and shrink requests
+    on failing cas histories — plus the oracle reference for each."""
+    from qsm_tpu.models.registry import MODELS
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+    from qsm_tpu.serve.protocol import VERDICT_NAMES, history_to_rows
+    from qsm_tpu.utils.corpus import build_corpus
+
+    oracle = WingGongCPU(memo=True)
+    mix = []
+
+    def add_check(model, n_corpora, corpus_n, n_pids, max_ops, seed0):
+        entry = MODELS[model]
+        spec = entry.make_spec()
+        for i in range(n_corpora):
+            hists = build_corpus(
+                spec, (entry.impls["atomic"], entry.impls["racy"]),
+                n=corpus_n, n_pids=n_pids, max_ops=max_ops,
+                seed_base=seed0 + i * 10_000,
+                seed_prefix=f"bench_fleet_{model}_{i}")
+            expected = [VERDICT_NAMES[int(v)]
+                        for v in oracle.check_histories(spec, hists)]
+            mix.append({"kind": "check", "model": model,
+                        "rows": [history_to_rows(h) for h in hists],
+                        "expected": expected})
+
+    add_check("cas", 6, 8, 4, 10, 0)
+    add_check("kv", 2, 4, 8, 24, 500_000)       # pcomp-split lanes
+    add_check("multireg", 2, 4, 8, 16, 900_000)  # second split family
+    # failing cas histories for the shrink lanes
+    entry = MODELS["cas"]
+    spec = entry.make_spec()
+    pool = build_corpus(
+        spec, (entry.impls["atomic"], entry.impls["racy"]),
+        n=24, n_pids=6, max_ops=16, seed_base=0,
+        seed_prefix="bench_fleet_shrink")
+    failing = [h for h in pool
+               if int(oracle.check_histories(spec, [h])[0]) == 0]
+    for h in failing[:2]:
+        mix.append({"kind": "shrink", "model": "cas",
+                    "rows": history_to_rows(h), "expected": None})
+    return mix
+
+
+# ---------------------------------------------------------------------------
+# node processes (UNIX sockets: a restarted node keeps its address)
+# ---------------------------------------------------------------------------
+
+class Node:
+    def __init__(self, nid: str, run_dir: str, seal_rows: int = 64):
+        self.nid = nid
+        self.unix_path = os.path.join(run_dir, f"{nid}.sock")
+        self.replog_dir = os.path.join(run_dir, f"replog_{nid}")
+        self.seal_rows = seal_rows
+        self.proc = None
+
+    def spawn(self) -> "Node":
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # chaos rules target the ROUTER's node site; a spawned node
+        # must not inherit them (kill:serve etc. would be a different
+        # cell's drill)
+        env.pop("QSM_TPU_FAULTS", None)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "qsm_tpu", "serve",
+             "--unix", self.unix_path, "--node-id", self.nid,
+             "--replog-dir", self.replog_dir,
+             "--replog-seal-rows", str(self.seal_rows),
+             # warm every mix model (register = the projected spec kv/
+             # multireg sub-lanes ride): a cold engine build under
+             # full 1-core load can outlast a chaos-tuned link bound
+             # and read as a wedge
+             "--warm", "cas,kv,multireg,register"],
+            stdout=subprocess.PIPE, text=True, cwd=REPO, env=env)
+        line = self.proc.stdout.readline()
+        doc = json.loads(line)
+        assert doc.get("serving") == self.unix_path, doc
+        return self
+
+    def sigkill(self) -> None:
+        self.proc.kill()
+        self.proc.wait(timeout=10.0)
+
+    def sigstop(self) -> None:
+        os.kill(self.proc.pid, signal.SIGSTOP)
+
+    def sigcont(self) -> None:
+        try:
+            os.kill(self.proc.pid, signal.SIGCONT)
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        try:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5.0)
+        except OSError:
+            pass
+
+
+def _fleet(n_nodes: int, run_dir: str, cell: str, seal_rows: int = 64,
+           trace: bool = False, link_timeout_s: float = 10.0):
+    """Spawn N nodes + an in-process router for one cell.  Fresh
+    per-cell replog dirs: an earlier cell's banked verdicts must not
+    contaminate a later cell's throughput.  ``link_timeout_s`` stays
+    generous except in the chaos cells (LINK_TIMEOUT_S): on a shared
+    single core a loaded-but-healthy node can miss a wedge-tuned
+    bound, and a timeout is indistinguishable from a wedge at the
+    link layer."""
+    from qsm_tpu.fleet.router import FleetRouter
+    from qsm_tpu.resilience.policy import preset
+
+    cell_dir = os.path.join(run_dir, cell)
+    os.makedirs(cell_dir, exist_ok=True)
+    nodes = [Node(f"n{i}", cell_dir, seal_rows=seal_rows).spawn()
+             for i in range(n_nodes)]
+    kw = {}
+    if trace:
+        kw["trace_log"] = os.path.join(cell_dir, "router_trace.jsonl")
+        kw["flight_dir"] = os.path.join(cell_dir, "flight")
+    router = FleetRouter(
+        [(n.nid, n.unix_path) for n in nodes],
+        policy=preset("fleet-route").with_(timeout_s=link_timeout_s),
+        probe_policy=preset("fleet-probe").with_(timeout_s=1.0),
+        heartbeat_s=0.3, anti_entropy_s=0.0, **kw).start()
+    return router, nodes
+
+
+def _busiest_node(router, mix) -> str:
+    """The node owning the most of the mix's whole-history keys — the
+    one in-flight lanes are most likely riding when the chaos lands."""
+    from qsm_tpu.serve.cache import fingerprint_key
+    from qsm_tpu.serve.protocol import rows_to_history
+
+    owned: dict = {}
+    allowed = set(router.membership.all_ids())
+    for req in mix:
+        spec = router._spec_for(req["model"], {})
+        hists = ([rows_to_history(req["rows"])]
+                 if req["kind"] == "shrink"
+                 else [rows_to_history(r) for r in req["rows"]])
+        for h in hists:
+            nid = router.membership.ring.node_for(
+                fingerprint_key(spec, h), allowed)
+            owned[nid] = owned.get(nid, 0) + 1
+    return max(owned, key=owned.get)
+
+
+# ---------------------------------------------------------------------------
+# the client drive
+# ---------------------------------------------------------------------------
+
+def _drive(router, mix, n_clients: int, rounds: int,
+           chaos=None, chaos_at_s: float = None):
+    """Closed-loop clients replaying the recorded mix; every check
+    response verified against the oracle reference on receipt.
+    ``chaos`` is a zero-arg callable fired ``chaos_at_s`` into the
+    drive (SIGKILL/SIGSTOP/...)."""
+    from qsm_tpu.serve.client import CheckClient
+
+    lock = threading.Lock()
+    latencies, errors, wrong = [], [], []
+    served = [0]
+    shrink_results = {}
+
+    def drive(ci: int):
+        try:
+            with CheckClient(router.address, timeout_s=120.0) as client:
+                for _r in range(rounds):
+                    # each client starts at its own offset so the mix
+                    # interleaves across connections instead of
+                    # marching in lockstep
+                    for k in [(j + ci) % len(mix)
+                              for j in range(len(mix))]:
+                        req = mix[k]
+                        t0 = time.perf_counter()
+                        if req["kind"] == "check":
+                            res = client.check(req["model"], req["rows"])
+                        else:
+                            res = client.shrink(req["model"], req["rows"])
+                        dt = time.perf_counter() - t0
+                        with lock:
+                            latencies.append(dt)
+                            if not res.get("ok"):
+                                errors.append(res)
+                            elif (req["kind"] == "check"
+                                  and res["verdicts"] != req["expected"]):
+                                wrong.append({"mix": k,
+                                              "got": res["verdicts"]})
+                            elif (req["kind"] == "shrink"
+                                  and res.get("verdict") != "VIOLATION"):
+                                wrong.append({"mix": k, "shrink": res})
+                            else:
+                                served[0] += (len(req["rows"])
+                                              if req["kind"] == "check"
+                                              else 1)
+                                if req["kind"] == "shrink":
+                                    shrink_results[k] = res["history"]
+        except Exception as e:  # noqa: BLE001 — a dead client is a row fact
+            with lock:
+                errors.append({"error": f"{type(e).__name__}: {e}"})
+
+    threads = [threading.Thread(target=drive, args=(ci,))
+               for ci in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    if chaos is not None:
+        time.sleep(chaos_at_s or KILL_AFTER_S)
+        chaos()
+    for t in threads:
+        t.join(SUBPROC_TIMEOUT_S)
+    wall = time.perf_counter() - t0
+    return wall, latencies, errors, wrong, served[0], shrink_results
+
+
+def _row(cell, n_nodes, wall, latencies, errors, wrong, served,
+         router_stats) -> dict:
+    lat = np.asarray(sorted(latencies)) if latencies else np.asarray([0.0])
+    mem = router_stats.get("membership", {})
+    return {
+        "nodes": n_nodes, "clients": N_CLIENTS,
+        "histories": served, "seconds": round(wall, 3),
+        "histories_per_sec": round(served / max(wall, 1e-9), 1),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1000, 2),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1000, 2),
+        "errors": len(errors),
+        "wrong_verdicts": len(wrong),
+        "node_faults": router_stats.get("node_faults", 0),
+        "node_sheds": router_stats.get("node_sheds", 0),
+        "redispatches": router_stats.get("redispatches", 0),
+        "ladder_lanes": router_stats.get("ladder_lanes", 0),
+        "quarantines": mem.get("quarantines", 0),
+        "readmissions": mem.get("readmissions", 0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+
+def bench_scaling(n_nodes: int, mix, run_dir: str) -> dict:
+    router, nodes = _fleet(n_nodes, run_dir, f"n{n_nodes}")
+    try:
+        wall, lat, errors, wrong, served, _ = _drive(
+            router, mix, N_CLIENTS, ROUNDS)
+        stats = router.stats()
+    finally:
+        router.stop()
+        for n in nodes:
+            n.stop()
+    return _row(f"fleet_n{n_nodes}", n_nodes, wall, lat, errors, wrong,
+                served, stats)
+
+
+def bench_kill_node(mix, run_dir: str) -> dict:
+    """SIGKILL the busiest node mid-soak; afterwards audit the three
+    acceptance artifacts: correct verdicts, a flight dump naming the
+    doomed trace ids, and the route.hop span from the dead node."""
+    from qsm_tpu.obs import load_dump, load_events, recent_events
+
+    router, nodes = _fleet(3, run_dir, "kill", trace=True,
+                           link_timeout_s=LINK_TIMEOUT_S)
+    victim = _busiest_node(router, mix)
+    node_by_id = {n.nid: n for n in nodes}
+    try:
+        wall, lat, errors, wrong, served, _ = _drive(
+            router, mix, N_CLIENTS, CHAOS_ROUNDS,
+            chaos=lambda: node_by_id[victim].sigkill(),
+            chaos_at_s=KILL_AFTER_S)
+        stats = router.stats()
+        flight_dir = os.path.join(run_dir, "kill", "flight")
+        trace_log = os.path.join(run_dir, "kill", "router_trace.jsonl")
+        router.obs.tracer.close()
+        doomed = []
+        dump_path = None
+        for name in sorted(os.listdir(flight_dir)
+                           if os.path.isdir(flight_dir) else []):
+            if "node_death" not in name and "partition" not in name:
+                continue
+            dump = load_dump(os.path.join(flight_dir, name))
+            for ev in recent_events(dump, "node"):
+                at = ev.get("attrs") or {}
+                if ev.get("name") == "node.shed" \
+                        and at.get("node") == victim:
+                    doomed.extend(at.get("traces") or [])
+                    dump_path = name
+        hop_seen = False
+        for trace_id in doomed[:8]:
+            for ev in load_events(trace_log, trace_id=trace_id):
+                at = ev.get("attrs") or {}
+                if ev.get("name") == "route.hop" \
+                        and at.get("hop_from") == victim:
+                    hop_seen = True
+    finally:
+        router.stop()
+        for n in nodes:
+            n.stop()
+    row = _row("kill_node", 3, wall, lat, errors, wrong, served, stats)
+    row.update({
+        "killed_node": victim,
+        "kill_after_s": KILL_AFTER_S,
+        "kill_landed_mid_run": stats.get("node_faults", 0) >= 1,
+        "flight_dump": dump_path,
+        "flight_dump_names_doomed_traces": bool(doomed),
+        "doomed_traces": doomed[:4],
+        "trace_shows_hop_off_dead_node": hop_seen,
+        "verdicts_bit_identical": not wrong and not errors,
+    })
+    return row
+
+
+def bench_wedge_node(mix, run_dir: str) -> dict:
+    """SIGSTOP (wedge: alive, holds its sockets, answers nothing) the
+    busiest node mid-soak — the failure bounded link timeouts exist
+    for."""
+    router, nodes = _fleet(3, run_dir, "wedge",
+                           link_timeout_s=LINK_TIMEOUT_S)
+    victim = _busiest_node(router, mix)
+    node_by_id = {n.nid: n for n in nodes}
+    try:
+        wall, lat, errors, wrong, served, _ = _drive(
+            router, mix, N_CLIENTS, CHAOS_ROUNDS,
+            chaos=lambda: node_by_id[victim].sigstop(),
+            chaos_at_s=KILL_AFTER_S)
+        stats = router.stats()
+    finally:
+        node_by_id[victim].sigcont()
+        router.stop()
+        for n in nodes:
+            n.stop()
+    row = _row("wedge_node", 3, wall, lat, errors, wrong, served, stats)
+    row.update({
+        "wedged_node": victim,
+        "wedge_detected": stats.get("node_faults", 0) >= 1,
+        "verdicts_bit_identical": not wrong and not errors,
+    })
+    return row
+
+
+def bench_partition(mix, run_dir: str) -> dict:
+    """Seeded random partition: a fraction of router→node exchanges
+    drop frames both directions (``partition:node:p`` — the faults
+    plane's grammar, replayable by seed)."""
+    os.environ["QSM_TPU_FAULTS"] = "partition:node:0.2"
+    os.environ["QSM_TPU_FAULTS_SEED"] = "12"
+    try:
+        router, nodes = _fleet(3, run_dir, "partition",
+                               link_timeout_s=LINK_TIMEOUT_S)
+        try:
+            wall, lat, errors, wrong, served, _ = _drive(
+                router, mix, N_CLIENTS, CHAOS_ROUNDS)
+            stats = router.stats()
+        finally:
+            router.stop()
+            for n in nodes:
+                n.stop()
+    finally:
+        os.environ.pop("QSM_TPU_FAULTS", None)
+        os.environ.pop("QSM_TPU_FAULTS_SEED", None)
+    row = _row("partition", 3, wall, lat, errors, wrong, served, stats)
+    row.update({
+        "partition_p": 0.2,
+        "partitions_fired": stats.get("faults", {}).get("node", 0),
+        "verdicts_bit_identical": not wrong and not errors,
+    })
+    return row
+
+
+def bench_rolling_restart(mix, run_dir: str) -> dict:
+    """Restart every node in sequence (SIGKILL + respawn on the same
+    replog dir and address), anti-entropy catch-up between steps, then
+    the whole mix re-submitted: zero wrong verdicts, zero lost banked
+    verdicts (every check lane a bank hit), shrink results bit-equal."""
+    from qsm_tpu.serve.client import CheckClient
+
+    # seal_rows=1: every banked batch seals its own segment, so the
+    # anti-entropy sweep replicates the COMPLETE bank — the zero-lost
+    # assertion below is exact, not modulo an unsealed tail
+    router, nodes = _fleet(3, run_dir, "rolling", seal_rows=1)
+    try:
+        # phase A: bank the whole mix
+        wall_a, lat_a, errors_a, wrong_a, served_a, shrink_a = _drive(
+            router, mix, N_CLIENTS, 1)
+        router.anti_entropy_sweep()
+        restarts = []
+        for node in nodes:
+            node.sigkill()
+            time.sleep(0.3)
+            node.spawn()
+            # membership must see it healthy again before the next
+            # restart (sustained health re-admission)
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 30.0:
+                router.membership.probe(node.nid)
+                if node.nid in router.membership.healthy_ids():
+                    break
+                time.sleep(0.2)
+            # catch the restarted node up before the next one dies —
+            # sweeps until quiescent (bounded: segment count is finite)
+            for _ in range(32):
+                if router.anti_entropy_sweep()["segments_shipped"] == 0:
+                    break
+            restarts.append(node.nid)
+        # phase B: the whole mix again — all from the bank
+        miss = []
+        wrong_b = []
+        shrink_equal = True
+        with CheckClient(router.address, timeout_s=120.0) as client:
+            for k, req in enumerate(mix):
+                if req["kind"] == "check":
+                    res = client.check(req["model"], req["rows"])
+                    if not res.get("ok") \
+                            or res["verdicts"] != req["expected"]:
+                        wrong_b.append(k)
+                    elif not all(res.get("cached", [])):
+                        miss.append({"mix": k,
+                                     "cached": res.get("cached")})
+                else:
+                    res = client.shrink(req["model"], req["rows"])
+                    if not res.get("ok") \
+                            or res.get("verdict") != "VIOLATION":
+                        wrong_b.append(k)
+                    elif k in shrink_a \
+                            and res["history"] != shrink_a[k]:
+                        shrink_equal = False
+        stats = router.stats()
+    finally:
+        router.stop()
+        for n in nodes:
+            n.stop()
+    row = _row("rolling_restart", 3, wall_a, lat_a, errors_a, wrong_a,
+               served_a, stats)
+    row.update({
+        "restarted": restarts,
+        "phase_b_wrong": len(wrong_b),
+        "lanes_not_from_bank": len(miss),
+        "zero_lost_banked_verdicts": not miss and not wrong_b,
+        "shrink_results_bit_equal": shrink_equal,
+        "ae_segments_shipped": stats.get("anti_entropy", {}).get(
+            "segments_shipped", 0),
+        "ae_rows_shipped": stats.get("anti_entropy", {}).get(
+            "rows_shipped", 0),
+    })
+    return row
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def run(tag: str, out_path, resume: bool) -> int:
+    from qsm_tpu.resilience.checkpoint import CellJournal
+
+    path = out_path or os.path.join(REPO, f"BENCH_FLEET_{tag}.json")
+    header = {
+        "artifact": "BENCH_FLEET",
+        "device_fallback": None,  # host-side by design: survival +
+        # fleet fan-out, measured where it is honest
+        "platform": "cpu",
+        "mix": "cas check x6 + kv pcomp x2 + multireg pcomp x2 + "
+               "cas shrink x2",
+        "clients": N_CLIENTS, "rounds": ROUNDS,
+        "host_cores": os.cpu_count(),
+        "captured_iso": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+    }
+    journal = CellJournal(path, header, resume=resume)
+    todo = ["fleet_n1", "fleet_n2", "fleet_n3", "kill_node",
+            "wedge_node", "partition", "rolling_restart"]
+    mix = None
+    if any(journal.complete(k) is None for k in todo):
+        mix = _build_mix()
+
+    with tempfile.TemporaryDirectory() as run_dir:
+        for n in (1, 2, 3):
+            key = f"fleet_n{n}"
+            if journal.complete(key) is None:
+                journal.emit(key, bench_scaling(n, mix, run_dir))
+        if journal.complete("kill_node") is None:
+            journal.emit("kill_node", bench_kill_node(mix, run_dir))
+        if journal.complete("wedge_node") is None:
+            journal.emit("wedge_node", bench_wedge_node(mix, run_dir))
+        if journal.complete("partition") is None:
+            journal.emit("partition", bench_partition(mix, run_dir))
+        if journal.complete("rolling_restart") is None:
+            journal.emit("rolling_restart",
+                         bench_rolling_restart(mix, run_dir))
+
+    n1 = journal.complete("fleet_n1")
+    n3 = journal.complete("fleet_n3")
+    kill = journal.complete("kill_node")
+    wedge = journal.complete("wedge_node")
+    part = journal.complete("partition")
+    roll = journal.complete("rolling_restart")
+    rows = [journal.complete(k) for k in todo]
+    wrong_total = sum(r.get("wrong_verdicts", 0) for r in rows) \
+        + roll.get("phase_b_wrong", 0)
+    host_cores = os.cpu_count() or 1
+    ratio = n3["histories_per_sec"] / max(n1["histories_per_sec"], 1e-9)
+    # the r08 honesty framing: three node processes cannot out-check
+    # one on a host without the cores to run them — the gate needs
+    # host_cores >= nodes + 1 (3 nodes + router/clients) to be
+    # physically expressible.  The ratio is recorded either way;
+    # correctness gates below are never waived.
+    cores_sufficient = host_cores >= 4
+    summary = {
+        "metric": "fleet_survival_and_scaling",
+        "host_cores": host_cores,
+        "fleet_n1_hps": n1["histories_per_sec"],
+        "fleet_n2_hps": journal.complete("fleet_n2")[
+            "histories_per_sec"],
+        "fleet_n3_hps": n3["histories_per_sec"],
+        "ratio_n3_vs_n1": round(ratio, 2),
+        "gate_2x_at_3_nodes": bool(ratio >= 2.0),
+        "gate_waived_insufficient_cores": not cores_sufficient,
+        "scaling_honesty": (
+            None if cores_sufficient else
+            f"host has {host_cores} core(s): 3 node processes + router "
+            "+ clients share it, so near-linear node scaling is not "
+            "expressible here (needs host_cores >= nodes + 1, the r08 "
+            "workers+1 rule one level up); the chaos/correctness "
+            "gates below are measured fully"),
+        "wrong_verdicts_total": wrong_total,
+        "kill_node_survived": bool(kill.get("verdicts_bit_identical")),
+        "kill_flight_dump_names_doomed_traces": bool(
+            kill.get("flight_dump_names_doomed_traces")),
+        "kill_trace_shows_hop": bool(
+            kill.get("trace_shows_hop_off_dead_node")),
+        "kill_landed_mid_run": bool(kill.get("kill_landed_mid_run")),
+        "wedge_node_survived": bool(wedge.get("verdicts_bit_identical")),
+        "wedge_detected": bool(wedge.get("wedge_detected")),
+        "partition_survived": bool(part.get("verdicts_bit_identical")),
+        "partitions_fired": part.get("partitions_fired", 0),
+        "rolling_restart_zero_lost": bool(
+            roll.get("zero_lost_banked_verdicts")),
+        "rolling_restart_shrink_bit_equal": bool(
+            roll.get("shrink_results_bit_equal")),
+        "resumed_cells": journal.resumed_cells,
+        "artifact": os.path.basename(path),
+    }
+    if journal.complete("summary") is None:
+        journal.emit("summary", summary)
+    print(json.dumps(summary))
+    ok = (summary["wrong_verdicts_total"] == 0
+          and summary["kill_node_survived"]
+          and summary["kill_landed_mid_run"]
+          and summary["kill_flight_dump_names_doomed_traces"]
+          and summary["kill_trace_shows_hop"]
+          and summary["wedge_node_survived"]
+          and summary["wedge_detected"]
+          and summary["partition_survived"]
+          and summary["rolling_restart_zero_lost"]
+          and (summary["gate_2x_at_3_nodes"]
+               or summary["gate_waived_insufficient_cores"]))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tag", default="r12")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--resume", action="store_true",
+                    help="adopt completed cells from a prior journal "
+                         "at the output path (resilience/checkpoint)")
+    args = ap.parse_args(argv)
+
+    from qsm_tpu.utils.device import force_cpu_platform
+
+    force_cpu_platform()
+    try:
+        return run(args.tag, args.out, args.resume)
+    except Exception as e:  # noqa: BLE001 — diagnostic line, not a traceback
+        print(json.dumps({"metric": "fleet_survival_and_scaling",
+                          "error": f"{type(e).__name__}: {e}"}))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

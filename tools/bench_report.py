@@ -42,11 +42,12 @@ sys.path.insert(0, REPO)
 _HEADLINE_KEYS = (
     "histories_per_sec", "h_per_s", "reduction_vs_hand",
     "engine_call_ratio", "call_ratio_batched", "wall_ratio",
-    "nodes_ratio", "speedup", "ratio", "mean_ratio",
+    "nodes_ratio", "ratio_n3_vs_n1", "speedup", "ratio", "mean_ratio",
     "tracing_off_overhead_pct", "tracing_on_overhead_pct",
     "value", "p50_ms", "p99_ms",
 )
-_GATE_KEYS = ("gate_ok", "all_verified", "wrong_verdicts")
+_GATE_KEYS = ("gate_ok", "all_verified", "wrong_verdicts",
+              "wrong_verdicts_total", "rolling_restart_zero_lost")
 _MAX_FACTS = 5
 
 

@@ -80,7 +80,7 @@ CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 # interprocedural race analyzer), independent of the window artifacts'
 # ROUND_TAG — renaming those retires banked measurements, renaming this
 # just says which rule set produced the findings.
-LINT_ROUND = "r11"  # family (i) — trace-plane discipline — landed r11
+LINT_ROUND = "r12"  # family (j) — fleet re-dispatch discipline — r12
 LINT_ARTIFACT = os.path.join(REPO, f"LINT_{LINT_ROUND}.json")
 
 # Committed archive of the P-compositionality bench (tools/
@@ -117,6 +117,17 @@ OBS_ARTIFACT = os.path.join(REPO, f"BENCH_OBS_{OBS_ROUND}.json")
 # full scan = no_obs + tracing_off + tracing_on + summary
 OBS_MIN_ROWS = 4
 _OBS_STATE: dict = {"attempted": False}
+
+# Committed archive of the fleet soak (tools/bench_fleet.py): HOST-ONLY
+# like the pcomp/shrink/obs gates — 1/2/3-node fleets on a recorded
+# traffic mix with kill/wedge/partition/rolling-restart chaos cells —
+# refreshed off-window on CellJournal --resume rails.  Tracks its own
+# round tag (the fleet tier landed in r12).
+FLEET_ROUND = "r12"
+FLEET_ARTIFACT = os.path.join(REPO, f"BENCH_FLEET_{FLEET_ROUND}.json")
+# full scan = 3 scaling cells + 4 chaos cells + summary
+FLEET_MIN_ROWS = 8
+_FLEET_STATE: dict = {"attempted": False}
 
 # Cached verdict of the pre-seize lint gate, keyed on a SOURCE
 # fingerprint — not process lifetime: the watcher runs all round while
@@ -307,6 +318,14 @@ def _maybe_archive_obs(timeout: float = 900.0) -> None:
     BENCH/LINT artifacts."""
     _maybe_archive(_OBS_STATE, OBS_ARTIFACT, "bench_obs.py",
                    OBS_MIN_ROWS, "obs_bench", timeout)
+
+
+def _maybe_archive_fleet(timeout: float = 1200.0) -> None:
+    """The fleet soak artifact (tools/bench_fleet.py): the survival
+    gates (kill/wedge/partition/rolling-restart at zero wrong and zero
+    lost verdicts) archived beside the other host-only gates."""
+    _maybe_archive(_FLEET_STATE, FLEET_ARTIFACT, "bench_fleet.py",
+                   FLEET_MIN_ROWS, "fleet_bench", timeout)
 
 
 def _run_window_bench(bench_timeout: float, extra_args, label: str,
@@ -691,6 +710,7 @@ def main() -> int:
         _maybe_archive_pcomp()
         _maybe_archive_shrink()
         _maybe_archive_obs()
+        _maybe_archive_fleet()
     while True:
         t0 = time.time()
         _maybe_compact_probe_log()  # bounded; no-op below the threshold
