@@ -11,7 +11,7 @@
 PYTHON ?= python
 # keep in lockstep with tools/probe_watcher.py LINT_ROUND (the watcher
 # archives the same document before every window seize)
-LINT_ARTIFACT ?= LINT_r12.json
+LINT_ARTIFACT ?= LINT_r13.json
 
 # P-compositionality bench (tools/bench_pcomp.py): host-only — no TPU
 # window needed — on CellJournal --resume rails; refreshes the
@@ -35,8 +35,10 @@ OBS_ARTIFACT ?= BENCH_OBS_r11.json
 # rails; refreshes the committed BENCH_FLEET artifact (1/2/3-node
 # fleets on a recorded check+shrink+pcomp mix with kill-node-mid-soak,
 # wedge, partition and rolling-restart chaos cells — zero wrong
-# verdicts, zero lost banked verdicts; docs/SERVING.md "Fleet")
-FLEET_ARTIFACT ?= BENCH_FLEET_r12.json
+# verdicts, zero lost banked verdicts — plus the r13 router-HA cells:
+# kill/wedge the ACTIVE router (lease takeover, split-brain refusal)
+# and router-dead gossip convergence; docs/SERVING.md "Fleet")
+FLEET_ARTIFACT ?= BENCH_FLEET_r13.json
 
 .PHONY: lint-gate lint-changed lint-sarif test bench-pcomp \
 	bench-shrink bench-obs bench-fleet bench-report

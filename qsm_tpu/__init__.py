@@ -31,8 +31,11 @@ Layers (mirroring SURVEY.md §1, redesigned TPU-first):
   protocol-identical router over N check-server nodes with
   consistent-hash routing by the verdict-cache identity, node
   quarantine/re-admission, bounded node-loss re-dispatch, and a
-  segmented replicated verdict log with anti-entropy catch-up
-  (docs/SERVING.md "Fleet")
+  segmented replicated verdict log with anti-entropy catch-up —
+  de-SPOF'd end to end: router HA behind a filesystem lease
+  (split-brain-safe term takeover), node-to-node gossip replication,
+  and row-level segment subsumption (docs/SERVING.md "Fleet" /
+  "Router HA")
 * ``qsm_tpu.utils``    — config, structured logging, CLI
 """
 

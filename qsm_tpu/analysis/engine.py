@@ -70,7 +70,8 @@ DEFAULT_SERVE_FILES = (
     # the fleet tier accepts connections and loops over node links —
     # same accept/recv/queue discipline as the single-node plane
     "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/membership.py",
-    "qsm_tpu/fleet/replog.py", "tools/bench_fleet.py")
+    "qsm_tpu/fleet/replog.py", "qsm_tpu/fleet/lease.py",
+    "qsm_tpu/fleet/gossip.py", "tools/bench_fleet.py")
 # the worker-lifecycle modules the pool passes cover: everything that
 # spawns, supervises, or benches worker processes
 DEFAULT_POOL_FILES = (
@@ -99,10 +100,12 @@ DEFAULT_RACE_FILES = (
     # across connections — same closed program
     "qsm_tpu/shrink/frontier.py", "qsm_tpu/shrink/shrinker.py",
     # the fleet tier: router connection/group threads, the membership
-    # probe thread and the anti-entropy loop share counters, links and
-    # node records — one closed program with the serving stack
+    # probe thread, the lease/gossip beat loops and the anti-entropy
+    # loop share counters, links and node records — one closed
+    # program with the serving stack
     "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/membership.py",
-    "qsm_tpu/fleet/replog.py",
+    "qsm_tpu/fleet/replog.py", "qsm_tpu/fleet/lease.py",
+    "qsm_tpu/fleet/gossip.py",
     "tools/bench_serve.py", "tools/bench_pcomp.py",
     "tools/bench_shrink.py", "tools/bench_fleet.py",
     "tools/probe_watcher.py", "tools/soak_prune.py")
@@ -113,11 +116,13 @@ DEFAULT_SHRINK_FILES = (
     "qsm_tpu/shrink/frontier.py", "qsm_tpu/shrink/shrinker.py",
     "tools/bench_shrink.py")
 
-# the fleet-tier modules the re-dispatch pass covers (family j): the
-# tier itself plus its soak bench
+# the fleet-tier modules the re-dispatch + lease passes cover (family
+# j): the tier itself (HA lease and gossip modules included) plus its
+# soak bench
 DEFAULT_FLEET_FILES = (
     "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/membership.py",
-    "qsm_tpu/fleet/replog.py", "tools/bench_fleet.py")
+    "qsm_tpu/fleet/replog.py", "qsm_tpu/fleet/lease.py",
+    "qsm_tpu/fleet/gossip.py", "tools/bench_fleet.py")
 
 # the trace-plane discipline beat (family i): everything that opens
 # spans or writes metrics — the obs plane itself, the serving stack
@@ -373,8 +378,9 @@ FAMILIES: Dict[str, Family] = {f.fid: f for f in (
            triggers=("qsm_tpu/analysis/obs_passes.py",
                      "qsm_tpu/analysis/astutil.py")),
     Family(fid="j", key="fleet",
-           title="fleet re-dispatch discipline (bounded attempts, "
-                 "failed-node exclusion)",
+           title="fleet re-dispatch + lease discipline (bounded "
+                 "attempts, failed-node exclusion, term/expiry-gated "
+                 "promotion)",
            files=DEFAULT_FLEET_FILES, per_file=_per_file_fleet,
            triggers=("qsm_tpu/analysis/fleet_passes.py",
                      "qsm_tpu/analysis/astutil.py")),

@@ -613,4 +613,52 @@ class BoundedRedispatchRouterStub:
             except Exception:  # noqa: BLE001 — excluded, try the next
                 target = self.ring.node_for(key, allowed, exclude=tried)
                 continue
+
+
+class TermlessTakeoverRouterStub:
+    """Seeded bugs for QSM-FLEET-LEASE (the router-HA promotion
+    discipline, fleet/lease.py): ``promote_forever`` spins a
+    while-True around lease acquisition (unbounded standby-promote
+    loop — with a live active holding the lease it never stands
+    down); ``promote_blind`` acquires without ever consulting the
+    record's term or expiry (the split-brain grab the lease exists to
+    exclude).  Never executed."""
+
+    def __init__(self, lease):
+        self.lease = lease
+
+    def promote_forever(self):
+        while True:  # <-- bug: unbounded, spins against a live active
+            rec = self.lease.acquire()
+            if rec is not None:
+                return rec
+
+    def promote_blind(self, healthy):
+        if healthy():
+            # <-- bug: no term/expiry consult before the grab
+            return self.lease.acquire()
         return None
+
+
+class LeasedTakeoverRouterStub:
+    """Sanctioned twin: one beat-driven attempt per observation — the
+    record is read, its term and expiry consulted, and acquisition
+    attempted at most once per beat (the fleet/router.py ``ha_beat``
+    shape) — must stay CLEAN under QSM-FLEET-LEASE."""
+
+    def __init__(self, lease, grace_s=1.0):
+        self.lease = lease
+        self.grace_s = grace_s
+        self.term = 0
+
+    def beat(self, probe):
+        rec = self.lease.read()
+        if rec is not None and not self.lease.expired(rec,
+                                                      self.grace_s):
+            return None              # the incumbent's term is live
+        if not probe():
+            return None              # no independent view of the fleet
+        got = self.lease.acquire(self.grace_s)
+        if got is not None:
+            self.term = got["term"]  # the term rides every response
+        return got

@@ -29,6 +29,26 @@ AST lint over the fleet modules and the fleet bench tool:
   Sanctioned form: ``for _ in range(policy.attempts)`` +
   ``tried.add(target)`` before the request + ``node_for(key,
   exclude=tried)`` on failure (fleet/router.py is the model).
+
+* ``QSM-FLEET-LEASE`` (error) — the router-HA promotion discipline
+  (ISSUE 13, fleet/lease.py).  A promotion is a call through a
+  lease-named handle (``lease.acquire(...)`` / ``self.lease.promote``
+  etc.) and must be:
+
+  - **bounded** — a constant-``True`` ``while`` wrapping the acquire
+    is an unbounded standby-promote loop: with the lease held by a
+    live active, the standby spins forever instead of standing by on
+    its beat cadence; and
+  - **term/expiry-gated** — a function that promotes without ever
+    consulting the lease's term or expiry (no ``term``/``expir``
+    token anywhere in it) can grab the lease while the record it
+    never read is still live, which is exactly the split-brain the
+    lease exists to exclude.
+
+  Sanctioned form: one beat-driven attempt per observation —
+  ``rec = lease.read(); if lease.expired(rec, grace): ...
+  lease.acquire()`` with the term riding the response
+  (fleet/router.py ``ha_beat`` is the model).
 """
 
 from __future__ import annotations
@@ -41,6 +61,10 @@ from .astutil import attr_chain, parse_module
 from .findings import ERROR, Finding
 
 _DISPATCH_CALLS = {"request", "dispatch"}
+# promotion verbs reached through a lease-named handle (the handle
+# requirement keeps ordinary Lock/Semaphore .acquire() out of scope)
+_PROMOTE_CALLS = {"acquire", "promote", "takeover", "take_over"}
+_CONSULT_TOKENS = ("term", "expir")
 
 
 def _is_const_true(test: ast.AST) -> bool:
@@ -92,6 +116,83 @@ def _loop_excludes_failed(loop: ast.AST) -> bool:
     return False
 
 
+def _is_lease_promote(call: ast.Call) -> bool:
+    """``<...lease...>.acquire(...)`` — a promotion through a
+    lease-named handle (``lease.acquire``, ``self._lease.promote``)."""
+    chain = attr_chain(call.func)
+    if not chain or len(chain) < 2:
+        return False
+    if chain[-1] not in _PROMOTE_CALLS:
+        return False
+    return any("lease" in part.lower() for part in chain[:-1])
+
+
+def _consults_lease_state(fn: ast.AST) -> bool:
+    """Does this function ever read a term/expiry-named thing — a name,
+    an attribute, or a ``rec["term"]``-style string key?"""
+    for node in ast.walk(fn):
+        text = None
+        if isinstance(node, ast.Name):
+            text = node.id
+        elif isinstance(node, ast.Attribute):
+            text = node.attr
+        elif isinstance(node, ast.Constant) and isinstance(node.value,
+                                                          str):
+            text = node.value
+        if text is not None and any(tok in text.lower()
+                                    for tok in _CONSULT_TOKENS):
+            return True
+    return False
+
+
+def _check_lease_discipline(tree: ast.Module, relpath: str
+                            ) -> List[Finding]:
+    """QSM-FLEET-LEASE (module docstring): per function, an unbounded
+    promote loop outranks (and subsumes) the termless finding — one
+    finding per broken promotion path, not two."""
+    out: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        promotes = [n for n in ast.walk(fn)
+                    if isinstance(n, ast.Call) and _is_lease_promote(n)]
+        if not promotes:
+            continue
+        unbounded = None
+        for loop in ast.walk(fn):
+            if isinstance(loop, ast.While) \
+                    and _is_const_true(loop.test) \
+                    and any(isinstance(n, ast.Call)
+                            and _is_lease_promote(n)
+                            for n in ast.walk(loop)):
+                unbounded = loop
+                break
+        if unbounded is not None:
+            out.append(Finding(
+                ERROR, "QSM-FLEET-LEASE",
+                f"{relpath}:{fn.name}:{unbounded.lineno}",
+                "unbounded standby-promote loop — a while-True around "
+                "lease acquisition spins against a live active "
+                "forever; promotion belongs on the beat cadence, one "
+                "gated attempt per observation",
+                "drive promotion from the lease beat: read the "
+                "record, consult lease.expired(rec, grace), and "
+                "attempt acquire at most once per beat "
+                "(fleet/router.py ha_beat is the model)"))
+        elif not _consults_lease_state(fn):
+            out.append(Finding(
+                ERROR, "QSM-FLEET-LEASE",
+                f"{relpath}:{fn.name}:{promotes[0].lineno}",
+                "promotion path that never consults lease term/expiry "
+                "— acquiring without reading the record can take the "
+                "lease while the incumbent's term is still live: the "
+                "split-brain the lease exists to exclude",
+                "gate the acquire on the observed record "
+                "(lease.read() + lease.expired(rec, grace)) and carry "
+                "the returned term onto every response"))
+    return out
+
+
 def check_fleet_file(path: str, root: Optional[str] = None
                      ) -> List[Finding]:
     tree = parse_module(path)
@@ -102,7 +203,7 @@ def check_fleet_file(path: str, root: Optional[str] = None
         except ValueError:
             pass
     fn_of = _function_map(tree)
-    out: List[Finding] = []
+    out: List[Finding] = _check_lease_discipline(tree, relpath)
     for node in ast.walk(tree):
         if not isinstance(node, (ast.While, ast.For)):
             continue

@@ -17,20 +17,29 @@ partition and restart while verdicts stay correct and available.
 * ``replog``     — :class:`SegmentedLog`: the append-only verdict
   bank generalized into content-fingerprinted segments that an
   anti-entropy loop replicates node-to-node, enabling rolling
-  restarts with zero dropped or wrong verdicts.
+  restarts with zero dropped or wrong verdicts; row-level segment
+  subsumption keeps catch-up bounded past compactions;
+* ``lease``      — :class:`Lease`: the filesystem term+TTL record
+  arbitrating which of N routers is the fleet's one active brain
+  (router HA — split-brain-safe takeover, one-way per term);
+* ``gossip``     — :class:`GossipAgent`: node-to-node digest/pull/push
+  anti-entropy with random peer fan-out, so banked verdicts keep
+  converging with every router dead.
 
 CLI: ``qsm-tpu fleet`` / ``qsm-tpu stats --serve ROUTER --fleet``;
-bench: tools/bench_fleet.py (artifact ``BENCH_FLEET_r12.json``);
+bench: tools/bench_fleet.py (artifact ``BENCH_FLEET_r13.json``);
 static gate: the QSM-FLEET pass family (analysis/fleet_passes.py).
 """
 
+from .gossip import GossipAgent
+from .lease import Lease
 from .membership import HashRing, Membership
 from .replog import SegmentedLog, segment_fingerprint
 from .router import (FleetRouter, NodeDead, NodeFault, NodeLink,
                      NodePartitioned, NodeTimeout)
 
 __all__ = [
-    "FleetRouter", "HashRing", "Membership", "NodeDead", "NodeFault",
-    "NodeLink", "NodePartitioned", "NodeTimeout", "SegmentedLog",
-    "segment_fingerprint",
+    "FleetRouter", "GossipAgent", "HashRing", "Lease", "Membership",
+    "NodeDead", "NodeFault", "NodeLink", "NodePartitioned",
+    "NodeTimeout", "SegmentedLog", "segment_fingerprint",
 ]

@@ -1,0 +1,206 @@
+"""Router lease — the fleet's one-active-brain contract.
+
+PR 12 left the fleet with exactly one router: kill it and the tier is
+gone.  Running N routers against the same fleet config fixes
+availability but creates the split-brain hazard — two routers both
+believing they are active could answer the same traffic from diverging
+health views.  This module is the arbitration: a filesystem-backed
+*lease* (one JSON record beside the replog dirs) holding
+
+* a **term** — a monotonically increasing integer, bumped by every
+  takeover; an active router stamps its term on every response, and a
+  router holding a stale term answers ``SHED`` with a
+  ``router_superseded`` block, never a verdict;
+* a **holder** — the router id that owns the current term;
+* an **expiry** — wall-clock ``expires_at`` a bounded TTL ahead,
+  refreshed by :meth:`renew` on the active router's sweep beat.
+
+Safety argument (one-way per term): the active serves only while
+``now < expires_at`` of its OWN last successful renew; a standby
+:meth:`acquire`\\ s only after observing ``now >= expires_at`` (plus a
+grace) of the SAME record and bumping the term.  Both read the same
+file and the same host clock, so at most one router can believe its
+term is live at any instant, and a router that lost term T can never
+serve under T again — it re-enters only by winning a LATER term
+through the same gated path.  Read-modify-write races between two
+candidates are excluded by an ``flock``-held lock file: the kernel
+owns the exclusion, so a candidate SIGKILLed mid-acquire releases it
+with its process — no stale-lock state exists to break (and no
+break-the-stale-lock race, where two breakers could each unlink the
+other's fresh lock and both proceed, can arise).
+
+The scope is deliberately single-host-filesystem (the deployment shape
+of the local fleet: N node processes + routers sharing a disk and a
+clock); a multi-host fleet would back the same record with its shared
+store.  Consumed by :class:`~qsm_tpu.fleet.router.FleetRouter`
+(``lease_path=``); lint family (j) gates the promotion discipline
+(QSM-FLEET-LEASE: every promote path must consult term/expiry and
+stay bounded)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+_ARTIFACT = "qsm_tpu_router_lease"
+_VERSION = 1
+
+
+class Lease:
+    """One router's handle on the shared lease record (see module
+    docstring).  All methods are one bounded filesystem transaction;
+    ``None`` returns mean "you do not hold it" — callers re-consult on
+    their next beat, never spin."""
+
+    def __init__(self, path: str, holder: str, ttl_s: float = 3.0):
+        self.path = path
+        self.holder = str(holder)
+        self.ttl_s = max(0.2, float(ttl_s))
+        self._lock_fd = None
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    # -- reading -------------------------------------------------------
+    def read(self) -> Optional[dict]:
+        """The current record, or None (missing/garbled — a garbled
+        lease is treated as expired: the next acquire rewrites it)."""
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if doc.get("artifact") != _ARTIFACT:
+            return None
+        if not isinstance(doc.get("term"), int) \
+                or not isinstance(doc.get("expires_at"), (int, float)):
+            return None
+        return doc
+
+    @staticmethod
+    def expired(rec: Optional[dict], grace_s: float = 0.0) -> bool:
+        """True when the record's term is no longer live (plus the
+        caller's grace — standbys wait it out so clock skew inside one
+        host's filesystem timestamps can never overlap two actives)."""
+        if rec is None:
+            return True
+        return time.time() >= float(rec["expires_at"]) + max(
+            0.0, grace_s)
+
+    # -- the write transactions ----------------------------------------
+    def acquire(self, grace_s: float = 0.0) -> Optional[dict]:
+        """Take the lease iff nobody holds a live term: no record, an
+        expired record (past ``grace_s``), or our own record.  The new
+        term is ``old term + 1`` (a re-acquire of our own live record
+        keeps the term — that is a renew).  Returns the record now in
+        force when WE hold it, else None."""
+        if not self._lock():
+            return None
+        try:
+            rec = self.read()
+            if rec is not None and rec.get("holder") != self.holder \
+                    and not self.expired(rec, grace_s):
+                return None  # a live foreign term: never contested
+            old_term = int(rec["term"]) if rec is not None else 0
+            if rec is not None and rec.get("holder") == self.holder \
+                    and not self.expired(rec):
+                term = old_term        # still ours: refresh, not bump
+            else:
+                term = old_term + 1    # a takeover mints a NEW term
+            return self._write(term)
+        finally:
+            self._unlock()
+
+    def renew(self, term: int) -> Optional[dict]:
+        """Refresh ``expires_at`` iff we still hold exactly ``term``.
+        None = lost (superseded, expired-and-taken, or the record is
+        gone) — the caller must stop serving under ``term``."""
+        if not self._lock():
+            return None
+        try:
+            rec = self.read()
+            if rec is None or rec.get("holder") != self.holder \
+                    or int(rec["term"]) != int(term):
+                return None
+            if self.expired(rec):
+                # our own record expired before this renew landed: the
+                # term MAY already be contested — refreshing it could
+                # resurrect a stale active after a standby's expiry
+                # read.  One-way: give it up; re-entry is a new term.
+                return None
+            return self._write(int(term))
+        finally:
+            self._unlock()
+
+    def release(self) -> None:
+        """Expire our own record in place (clean shutdown: the standby
+        need not wait out the TTL).  A TOMBSTONE, not an unlink — the
+        term survives, so the successor still mints term+1 and the
+        monotonic-term contract holds across clean handovers (merged
+        logs must never see the same term from two brains).  A foreign
+        record is left alone."""
+        if not self._lock():
+            return
+        try:
+            rec = self.read()
+            if rec is not None and rec.get("holder") == self.holder:
+                from ..resilience.checkpoint import atomic_write_json
+
+                # backdated past any sane grace (grace <= 2*ttl) so
+                # the successor's very next beat sees it expired
+                rec = {**rec, "released": True,
+                       "expires_at": round(
+                           time.time() - 2 * self.ttl_s, 4)}
+                atomic_write_json(self.path, rec)
+        finally:
+            self._unlock()
+
+    # -- plumbing ------------------------------------------------------
+    def _write(self, term: int) -> dict:
+        from ..resilience.checkpoint import atomic_write_json
+
+        rec = {"artifact": _ARTIFACT, "version": _VERSION,
+               "term": int(term), "holder": self.holder,
+               "ttl_s": self.ttl_s,
+               "expires_at": round(time.time() + self.ttl_s, 4)}
+        atomic_write_json(self.path, rec)
+        return rec
+
+    @property
+    def _lock_path(self) -> str:
+        return self.path + ".lock"
+
+    def _lock(self) -> bool:
+        """``flock(LOCK_EX | LOCK_NB)`` mutual exclusion around
+        read-modify-write.  Held for microseconds; contention loses
+        THIS beat (never blocks).  Kernel-owned: a holder SIGKILLed
+        mid-transaction releases with its process, so no stale-lock
+        state exists and nothing ever needs breaking (an unlink-based
+        break would race — two breakers could each remove the other's
+        fresh lock and both enter the critical section: exactly the
+        split-brain this lock exists to exclude).  The lock file
+        itself is deliberately never unlinked."""
+        import fcntl
+
+        try:
+            fd = os.open(self._lock_path, os.O_CREAT | os.O_RDWR)
+        except OSError:
+            return False
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False  # live contention: lose this beat
+        self._lock_fd = fd
+        return True
+
+    def _unlock(self) -> None:
+        fd = getattr(self, "_lock_fd", None)
+        if fd is None:
+            return
+        self._lock_fd = None
+        try:
+            os.close(fd)  # closing the fd releases the flock
+        except OSError:
+            pass

@@ -26,20 +26,25 @@ and replication:
   never replays the torn row as a verdict.  Sealed segments are
   verified against their fingerprint on load — a corrupt one is moved
   aside ``.quarantine``, never adopted, never served.
-* **Compaction absorbs, never forgets.**  When the row count outgrows
-  the live set, every segment folds into one fresh local segment
-  holding the post-merge (later-row-wins) live entries — and the
-  absorbed segments' names+fingerprints are recorded in
+* **Compaction absorbs, never forgets — boundedly.**  When the row
+  count outgrows the live set, every segment folds into one fresh
+  local segment holding the post-merge (later-row-wins) live entries —
+  and the absorbed segments' names+fingerprints are recorded in
   ``absorbed.json`` so the anti-entropy diff does not re-pull what
-  compaction just deduplicated (the catch-up/compaction race is a
-  bounded dance, not a loop).  Known cost, priced deliberately: the
-  fresh segment is a NEW identity, so peers pull the compacted live
-  set once per compaction even though they hold every row, and the
-  absorbed record only grows.  Compaction fires only past 2× the live
-  set (rare in steady state — the single-file bank pays the same
-  rewrite), so this trades a bounded occasional full-set ship for
-  identity-by-content simplicity; row-level subsumption is the
-  ROADMAP item 2 REMAINING work.
+  compaction just deduplicated.  The record is HARD-CAPPED
+  (``absorbed_cap``, fold-forward: oldest names drop first), so a
+  100-compaction lifetime stays O(cap) on disk — safe to forget
+  because row-level subsumption (below) protects anything the record
+  no longer lists.
+* **Row-level subsumption** (ISSUE 13): a compacted segment is a NEW
+  identity holding rows its peers may all hold already.  Before a
+  segment ships, the would-be receiver checks the segment's row-key
+  coverage (:meth:`row_keys` on the owner, the ``replog.covers`` /
+  ``replog.subsumed`` wire ops) against its OWN live set; full
+  coverage records the name as *subsumed* (:meth:`note_subsumed` —
+  capped like the absorbed record) and the rows never cross the wire.
+  Catch-up cost per compaction drops from one full-live-set ship per
+  peer to one key-list exchange.
 
 Verdicts are pure functions of (spec, history) — fingerprint-keyed
 rows from different nodes can only agree on the verdict — so adoption
@@ -87,20 +92,26 @@ class SegmentedLog:
     connection threads."""
 
     def __init__(self, dir: str, node_id: str = "n0",
-                 seal_rows: int = 256):
+                 seal_rows: int = 256, absorbed_cap: int = 64):
         self.dir = dir
         self.node_id = str(node_id)
         self.seal_rows = max(1, int(seal_rows))
+        # hard bound on the absorbed AND subsumed records (fold-forward
+        # semantics: oldest names drop first; row-level subsumption
+        # protects anything forgotten)
+        self.absorbed_cap = max(1, int(absorbed_cap))
         self._lock = threading.RLock()
         self._active_rows = 0        # data rows in the active segment
         self._active_clean = False   # file exists and ends on a clean line
         self._sealed: Dict[str, str] = {}    # name -> fingerprint
         self._absorbed: Dict[str, str] = {}  # compacted-away name -> fp
+        self._subsumed: Dict[str, str] = {}  # coverage-skipped name -> fp
         self._next_seq = 1
         self.truncated_tails = 0     # torn active tails dropped on load
         self.quarantined_segments = 0  # fingerprint-mismatch segs set aside
         self.seals = 0
         self.adoptions = 0
+        self.subsumptions = 0        # ships skipped: rows already held
         os.makedirs(dir, exist_ok=True)
         self._scan()
 
@@ -128,13 +139,17 @@ class SegmentedLog:
                 local_seqs.append(int(m.group("seq")))
         self._sealed = {k: v for k, v in self._sealed.items()
                         if v is not None}
-        ab = self._read_absorbed()
+        ab, sub, next_seq = self._read_absorbed()
         self._absorbed = ab
-        for name in ab:
+        self._subsumed = sub
+        for name in list(ab) + list(sub):
             m = _SEG_RE.match(name)
             if m is not None and m.group("node") == self.node_id:
                 local_seqs.append(int(m.group("seq")))
-        self._next_seq = max(local_seqs) + 1
+        # the persisted high-water seq survives the capped absorbed
+        # record forgetting old local names — a reused (seq, fp) name
+        # colliding with a copy a peer still holds must stay impossible
+        self._next_seq = max(max(local_seqs) + 1, next_seq)
         self._load_active_counts()
 
     def _verify_or_quarantine(self, name: str, m) -> Optional[str]:
@@ -211,24 +226,44 @@ class SegmentedLog:
             else len(clean)
         self._active_clean = bool(clean)
 
-    def _read_absorbed(self) -> Dict[str, str]:
+    def _read_absorbed(self) -> Tuple[Dict[str, str], Dict[str, str], int]:
         try:
             with open(os.path.join(self.dir, ABSORBED_NAME)) as f:
                 doc = json.load(f)
         except (OSError, ValueError):
-            return {}
+            return {}, {}, 1
         if doc.get("artifact") != _ABSORBED_ARTIFACT:
-            return {}
+            return {}, {}, 1
         names = doc.get("names")
-        return dict(names) if isinstance(names, dict) else {}
+        sub = doc.get("subsumed")
+        try:
+            next_seq = max(1, int(doc.get("next_seq", 1)))
+        except (TypeError, ValueError):
+            next_seq = 1
+        return (dict(names) if isinstance(names, dict) else {},
+                dict(sub) if isinstance(sub, dict) else {},
+                next_seq)
+
+    def _cap_record(self, record: Dict[str, str]) -> Dict[str, str]:
+        """Fold-forward: keep only the NEWEST ``absorbed_cap`` entries
+        (dict insertion order = record order).  Forgotten names stay
+        safe — the next offer of one is caught by the row-level
+        subsumption check against the live set, which is exactly what
+        covered the name when it entered this record."""
+        while len(record) > self.absorbed_cap:
+            record.pop(next(iter(record)))
+        return record
 
     def _write_absorbed(self) -> None:
         from ..resilience.checkpoint import atomic_write_json
 
-        atomic_write_json(os.path.join(self.dir, ABSORBED_NAME),
-                          {"artifact": _ABSORBED_ARTIFACT,
-                           "version": _VERSION,
-                           "names": dict(sorted(self._absorbed.items()))})
+        atomic_write_json(
+            os.path.join(self.dir, ABSORBED_NAME),
+            {"artifact": _ABSORBED_ARTIFACT, "version": _VERSION,
+             # NOT sorted: insertion order is the fold-forward order
+             "names": dict(self._absorbed),
+             "subsumed": dict(self._subsumed),
+             "next_seq": self._next_seq})
 
     # -- the VerdictCache store contract -------------------------------
     @property
@@ -341,7 +376,8 @@ class SegmentedLog:
     def compact(self, lines: List[str]) -> None:
         """Fold everything into ONE fresh local segment holding the
         caller's post-merge live rows; absorbed segment names are
-        REMEMBERED so the anti-entropy diff never re-pulls them."""
+        REMEMBERED (capped, fold-forward — :meth:`_cap_record`) so the
+        anti-entropy diff never re-pulls them."""
         with self._lock:
             fp = segment_fingerprint(lines)
             name = (f"seg-{self.node_id}-{self._next_seq:06d}"
@@ -349,11 +385,20 @@ class SegmentedLog:
             self._write_segment(name, fp, lines)
             self._next_seq += 1
             for old, old_fp in list(self._sealed.items()):
+                # re-inserted at the record's newest end either way:
+                # this compaction is the entry's newest coverage proof
+                self._absorbed.pop(old, None)
                 self._absorbed[old] = old_fp
                 try:
                     os.unlink(self._seg_path(old))
                 except OSError:
                     pass
+            # a name both subsumed and now absorbed needs one record
+            for old in list(self._subsumed):
+                if old in self._absorbed:
+                    self._subsumed.pop(old)
+            self._cap_record(self._absorbed)
+            self._cap_record(self._subsumed)
             self._sealed = {name: fp[:12]}
             try:
                 os.unlink(self._active_path)
@@ -375,13 +420,90 @@ class SegmentedLog:
         with self._lock:
             return dict(self._absorbed)
 
+    def subsumed(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._subsumed)
+
+    def covered(self) -> Dict[str, str]:
+        """Everything this node need never be shipped: absorbed by its
+        own compactions plus subsumed by row-level coverage — the set
+        the ``replog.digests`` wire op advertises beside the held
+        segments."""
+        with self._lock:
+            return {**self._absorbed, **self._subsumed}
+
     def missing(self, remote: Dict[str, str]) -> List[str]:
         """Remote segment names this node neither holds nor has
-        absorbed — what a catch-up must pull."""
+        absorbed/subsumed — what a catch-up must consider pulling."""
         with self._lock:
             return sorted(n for n in remote
                           if n not in self._sealed
-                          and n not in self._absorbed)
+                          and n not in self._absorbed
+                          and n not in self._subsumed)
+
+    @staticmethod
+    def row_keys_of(lines: List[str]) -> List[str]:
+        """The cache keys of already-read row lines (one parse — the
+        push leg has the lines in hand and must not re-read the file
+        just for its keys)."""
+        return [str(r["key"])
+                for r in SegmentedLog._parse_rows(lines)]
+
+    def row_keys(self, name: str) -> Optional[List[str]]:
+        """The cache keys of one HELD segment's rows — the coverage a
+        peer checks against its live set before asking for the rows
+        themselves (the ``replog.covers`` wire op)."""
+        with self._lock:
+            if name not in self._sealed:
+                return None
+            try:
+                _h, lines = self._read_lines(self._seg_path(name))
+            except (OSError, ValueError):
+                return None
+            return self.row_keys_of(lines)
+
+    def covers(self, names) -> List[dict]:
+        """``[{name, fingerprint, keys}]`` for the held segments among
+        ``names`` — the ``replog.covers`` wire payload, ONE file read
+        per segment (keys parsed from the read, the fingerprint from
+        the in-memory sealed map)."""
+        out: List[dict] = []
+        with self._lock:
+            for name in names:
+                fp = self._sealed.get(name)
+                if fp is None:
+                    continue
+                try:
+                    _h, lines = self._read_lines(self._seg_path(name))
+                except (OSError, ValueError):
+                    continue
+                out.append({"name": name, "fingerprint": fp,
+                            "keys": self.row_keys_of(lines)})
+        return out
+
+    def note_subsumed(self, name: str, fingerprint: str) -> bool:
+        """Record that ``name``'s rows are already fully held locally:
+        the segment is treated as covered — never pulled, never
+        offered as missing — without its rows ever crossing the wire.
+        Same name/fingerprint consistency gate as :meth:`adopt`; the
+        record is capped like the absorbed one.  False = already
+        held/covered (no-op)."""
+        m = _SEG_RE.match(name)
+        if m is None:
+            raise ValueError(f"bad segment name {name!r}")
+        if fingerprint and m.group("fp") != fingerprint[:12]:
+            raise ValueError(
+                f"segment {name} name does not match its content "
+                f"fingerprint {fingerprint[:12]} (refusing to subsume)")
+        with self._lock:
+            if name in self._sealed or name in self._absorbed \
+                    or name in self._subsumed:
+                return False
+            self._subsumed[name] = m.group("fp")
+            self._cap_record(self._subsumed)
+            self.subsumptions += 1
+            self._write_absorbed()
+        return True
 
     def read_segment(self, name: str) -> Optional[Tuple[str, List[str]]]:
         """(fingerprint, row lines) of one sealed segment, or None —
@@ -417,7 +539,8 @@ class SegmentedLog:
                 f"segment {name} name does not match its content "
                 f"fingerprint {fingerprint[:12]} (refusing to adopt)")
         with self._lock:
-            if name in self._sealed or name in self._absorbed:
+            if name in self._sealed or name in self._absorbed \
+                    or name in self._subsumed:
                 return []
             self._write_segment(name, fingerprint, lines)
             self._sealed[name] = fingerprint[:12]
@@ -430,9 +553,12 @@ class SegmentedLog:
             return {"dir": self.dir, "node": self.node_id,
                     "sealed_segments": len(self._sealed),
                     "absorbed_segments": len(self._absorbed),
+                    "subsumed_segments": len(self._subsumed),
+                    "absorbed_cap": self.absorbed_cap,
                     "active_rows": self._active_rows,
                     "seal_rows": self.seal_rows,
                     "seals": self.seals,
                     "adoptions": self.adoptions,
+                    "subsumptions": self.subsumptions,
                     "truncated_tails": self.truncated_tails,
                     "quarantined_segments": self.quarantined_segments}

@@ -37,6 +37,21 @@ nodes:
   node death, wedge and partition cells run on the CPU platform like
   every other degradation path (tests/test_fleet.py,
   tools/bench_fleet.py).
+* **HA — the router is no longer the tier's last SPOF** (ISSUE 13).
+  Started with ``lease_path=``, N routers race a filesystem lease
+  (fleet/lease.py: term + bounded TTL): exactly one wins ACTIVE and
+  stamps its ``term`` on every response; the rest run STANDBY —
+  membership probing and stats stay live, but check/shrink answer
+  ``SHED`` with a ``router`` block (``router_standby``) so a
+  multi-address client hops on.  The active renews on the sweep beat;
+  a standby promotes only after observing lease expiry PLUS its own
+  independent health probe of the nodes, minting term+1 — one-way per
+  term, so a router that lost term T sheds ``router_superseded``
+  under T forever (split-brain pinned in tests/test_fleet_ha.py).
+  Takeover emits the ``router.takeover`` span and fires a flight
+  dump.  Clients ride it via ``CheckClient("a,b")`` multi-address
+  failover (bounded, safe — all fleet ops are idempotent and verdicts
+  bank by fingerprint).
 
 Observability (qsm_tpu/obs): the request's trace id rides every
 sub-request to the nodes; the router emits ``route.request`` /
@@ -106,16 +121,44 @@ class NodeLink:
     """Bounded connection pool to ONE node.  Each request borrows a
     pooled (socket, channel) pair — concurrent router connections fan
     into the node's own micro-batcher over parallel sockets — under a
-    semaphore bound; a faulted socket is discarded, never reused."""
+    semaphore bound; a faulted socket is discarded, never reused.
+
+    ``address`` may be a comma-separated list (``a,b``): fresh
+    connections try each in order, so a peer reachable on more than
+    one door (an HA router pair fronting the same fleet, a node
+    re-bound after migration) fails over at connect time.  Safe for
+    the same reason the stale-pool retry is: every fleet op is
+    idempotent."""
 
     MAX_CONNS = 16
 
     def __init__(self, node_id: str, address: str):
         self.node_id = node_id
         self.address = address
+        self.addresses = [a.strip() for a in str(address).split(",")
+                          if a.strip()]
+        if not self.addresses:
+            raise ValueError(f"node {node_id}: empty address")
         self._free: List[Tuple[socket.socket, LineChannel]] = []
         self._lock = threading.Lock()
         self._sema = threading.BoundedSemaphore(self.MAX_CONNS)
+
+    def _connect(self, timeout_s: float) -> socket.socket:
+        last: Optional[BaseException] = None
+        for addr in self.addresses:
+            try:
+                return connect(addr, timeout_s=timeout_s)
+            except (socket.timeout, TimeoutError):
+                # a connect TIMEOUT is wedge/partition evidence, not
+                # death: propagate so the caller maps it to
+                # NodeTimeout (NodeDead would trigger the fresh-
+                # connection retry and double the stall per address
+                # against a SYN-dropping peer)
+                raise
+            except OSError as e:
+                last = e
+        raise NodeDead(f"node {self.node_id}: no address answered "
+                       f"({type(last).__name__}: {last})") from last
 
     def request(self, doc: dict, timeout_s: float) -> dict:
         """One bounded round-trip.  Raises a :class:`NodeFault` family
@@ -161,8 +204,7 @@ class NodeLink:
                     pair = self._free.pop() if self._free else None
             try:
                 if pair is None:
-                    sock = connect(self.address,
-                                   timeout_s=min(timeout_s, 10.0))
+                    sock = self._connect(min(timeout_s, 10.0))
                     pair = (sock, LineChannel(sock))
                 sock, chan = pair
                 send_doc(sock, doc)
@@ -240,6 +282,10 @@ class FleetRouter:
                  ae_max_segments: int = 32,
                  allow_shutdown: bool = True,
                  node_id: str = "router",
+                 lease_path: Optional[str] = None,
+                 lease_ttl_s: float = 3.0,
+                 ha_grace_s: Optional[float] = None,
+                 ha_beat_s: Optional[float] = None,
                  trace_log: Optional[str] = None,
                  flight_dir: Optional[str] = None,
                  metrics_port: Optional[int] = None,
@@ -293,7 +339,31 @@ class FleetRouter:
         self.ladder_lanes = 0
         self.ae_sweeps = 0
         self.ae_segments_shipped = 0
+        self.ae_segments_subsumed = 0  # ships skipped: rows already held
         self.ae_rows_shipped = 0
+        # router HA (fleet/lease.py; module docstring).  Without a
+        # lease the router is unconditionally active — the single-
+        # router deployment is byte-identical to PR 12.
+        self.lease = None
+        self.ha_role = "active"      # active | standby | superseded
+        self.term = 0                # the term this router last HELD
+        self.takeovers = 0
+        self.ha_sheds = 0            # check/shrink refused while not active
+        self._lease_expires = 0.0    # epoch bound of OUR live term
+        self._observed: dict = {}    # last foreign lease record seen
+        if lease_path is not None:
+            from .lease import Lease
+
+            self.lease = Lease(lease_path, holder=node_id,
+                               ttl_s=lease_ttl_s)
+            self.ha_role = "standby"  # until the first beat decides
+            self.ha_grace_s = (ha_grace_s if ha_grace_s is not None
+                               else self.lease.ttl_s * 0.5)
+            self._beat_s = (ha_beat_s if ha_beat_s is not None
+                            else max(0.05, self.lease.ttl_s / 3.0))
+        else:
+            self.ha_grace_s = 0.0
+            self._beat_s = anti_entropy_s
         self._m_route_s = self.obs.metrics.histogram(
             "qsm_fleet_route_seconds",
             "router end-to-end request latency")
@@ -339,9 +409,16 @@ class FleetRouter:
                              name="qsm-fleet-accept")
         t.start()
         self._threads.append(t)
-        if self.anti_entropy_s and self.anti_entropy_s > 0:
-            t = threading.Thread(target=self._anti_entropy_loop,
-                                 daemon=True, name="qsm-fleet-ae")
+        if self.lease is not None:
+            # the first beat decides the starting role (winner of the
+            # lease race goes active; the rest stand by)
+            try:
+                self.ha_beat()
+            except OSError:
+                pass
+        if self._beat_s and self._beat_s > 0:
+            t = threading.Thread(target=self._beat_loop,
+                                 daemon=True, name="qsm-fleet-beat")
             t.start()
             self._threads.append(t)
         return self
@@ -349,6 +426,12 @@ class FleetRouter:
     def stop(self) -> None:
         first_stop = not self._stop.is_set()
         self._stop.set()
+        if first_stop and self.lease is not None \
+                and self.ha_role == "active":
+            # clean shutdown hands the term over immediately: the
+            # standby need not wait out the TTL (a SIGKILLed active
+            # can't run this line — that path IS the TTL wait)
+            self.lease.release()
         self.membership.stop()
         if self._sock is not None:
             try:
@@ -416,10 +499,21 @@ class FleetRouter:
     def _send(self, conn: socket.socket, doc: dict) -> None:
         if "node" not in doc:
             doc = {**doc, "node": self.node_id}
+        if self.lease is not None and "term" not in doc:
+            # the HA contract: every response says which term answered
+            # it, so merged logs (and the split-brain pins) can tell a
+            # stale brain's answers from the live one's
+            doc = {**doc, "term": self.term}
         send_doc(conn, doc)
 
     def _handle(self, conn: socket.socket, req: dict) -> None:
         op = req.get("op", "check")
+        if op in ("check", "shrink") and not self._active_now():
+            # a non-active (or expired-term) router must never answer
+            # a verdict: SHED with the router block, client hops on
+            trace = str(req.get("trace") or "") or new_trace_id()
+            self._send(conn, self._ha_shed(req, trace))
+            return
         if op == "stats":
             self._send(conn, {"ok": True, "stats": self.stats()})
         elif op == "shutdown":
@@ -939,13 +1033,149 @@ class FleetRouter:
         self._m_route_s.observe(dt)
         self._send(conn, doc)
 
-    # -- anti-entropy --------------------------------------------------
-    def _anti_entropy_loop(self) -> None:
-        while not self._stop.wait(self.anti_entropy_s):
-            try:
-                self.anti_entropy_sweep()
-            except Exception:  # noqa: BLE001 — the loop must survive
-                continue
+    # -- the HA lease (fleet/lease.py; module docstring) ---------------
+    def _active_now(self) -> bool:
+        """May THIS router answer verdicts right now?  Leaseless =
+        always; leased = active role AND our term's own expiry still
+        ahead (one bounded clock compare on the hot path — the beat
+        refreshes the bound; a renewal that cannot land in time makes
+        this False before any standby can have promoted)."""
+        if self.lease is None:
+            return True
+        return self.ha_role == "active" \
+            and time.time() < self._lease_expires
+
+    def ha_beat(self) -> dict:
+        """One lease heartbeat: the active renews its term; everyone
+        else walks the gated promotion path — observe the record,
+        consult its term and expiry, and only past expiry (plus grace)
+        probe the nodes independently and acquire term+1.  Public so
+        tests and the split-brain pins drive it synchronously."""
+        if self.lease is None:
+            return {"role": self.ha_role, "term": self.term}
+        if self.ha_role == "active":
+            rec = self.lease.renew(self.term)
+            if rec is not None:
+                self._lease_expires = rec["expires_at"]
+            else:
+                self._demote(self.lease.read())
+            return {"role": self.ha_role, "term": self.term}
+        # standby / superseded: the ONE promotion path (QSM-FLEET-LEASE
+        # gates exactly this shape — term/expiry consulted, no loop)
+        rec = self.lease.read()
+        if rec is not None:
+            self._observed = {"term": rec.get("term"),
+                              "holder": rec.get("holder"),
+                              "expires_at": rec.get("expires_at")}
+        if not self.lease.expired(rec, self.ha_grace_s):
+            return {"role": self.ha_role, "term": self.term}
+        if not self._nodes_reachable():
+            # a standby that cannot see the fleet must not grab the
+            # term just to answer everything from its own ladder
+            return {"role": self.ha_role, "term": self.term,
+                    "blocked": "no reachable node"}
+        got = self.lease.acquire(self.ha_grace_s)
+        if got is not None:
+            self._promote(got, superseded=rec)
+        return {"role": self.ha_role, "term": self.term}
+
+    def _nodes_reachable(self) -> bool:
+        """The standby's independent pre-promotion health probe: at
+        least one fleet node must answer THIS router directly — a
+        lease expiry observed from behind a partition is not a mandate
+        to serve."""
+        return any(self.membership.probe(nid)
+                   for nid in self.membership.all_ids())
+
+    def _promote(self, rec: dict, superseded: Optional[dict]) -> None:
+        takeover = superseded is not None  # vs. a fresh lease's election
+        with self._lock:
+            self.ha_role = "active"
+            self.term = int(rec["term"])
+            if takeover:
+                self.takeovers += 1
+        self._lease_expires = rec["expires_at"]
+        if takeover:
+            # the takeover span (the bench/test acceptance: `qsm-tpu
+            # trace` shows it with the superseded term) — also a
+            # flight-dump trigger (obs._DUMP_TRIGGERS), so a takeover
+            # leaves an artifact naming what the new active saw
+            self.obs.event(
+                "router.takeover", node=self.node_id, term=self.term,
+                superseded_term=superseded.get("term"),
+                superseded_holder=superseded.get("holder"))
+        else:
+            self.obs.event("router.elect", node=self.node_id,
+                           term=self.term)
+
+    def _demote(self, seen: Optional[dict]) -> None:
+        """One-way per term: our term is gone (superseded or expired
+        unrenewable).  We keep standing by — re-entry only by WINNING
+        a later term through the gated promotion path."""
+        if seen is not None:
+            self._observed = {"term": seen.get("term"),
+                              "holder": seen.get("holder"),
+                              "expires_at": seen.get("expires_at")}
+        with self._lock:
+            self.ha_role = "superseded"
+        self._lease_expires = 0.0
+        self.obs.event("router.superseded", node=self.node_id,
+                       term=self.term,
+                       active_term=(seen or {}).get("term"),
+                       active_holder=(seen or {}).get("holder"))
+
+    def _ha_shed(self, req: dict, trace: str) -> dict:
+        """The non-active refusal: SHED with the ``router`` block — a
+        stale-term router must never answer a verdict, and the block
+        tells a multi-address client (and the operator) where the
+        active brain is."""
+        with self._lock:
+            self.ha_sheds += 1
+            was_active = self.term > 0
+        reason = "router_superseded" if was_active else "router_standby"
+        # the advisory active_term/active_holder come from the BEAT's
+        # cached observation (refreshed every ~TTL/3) — a refused
+        # request must not cost a lease-file read on the request
+        # thread.  One exception: a just-expired active that has not
+        # beaten yet observed nothing; read once so its very first
+        # superseded SHED can still name the successor.
+        observed = self._observed
+        if not observed and self.lease is not None:
+            rec = self.lease.read()
+            if rec is not None:
+                observed = self._observed = {
+                    "term": rec.get("term"),
+                    "holder": rec.get("holder"),
+                    "expires_at": rec.get("expires_at")}
+        self.obs.event("admission.shed", trace=trace, reason=reason)
+        doc = {"id": req.get("id"), "ok": False, "shed": True,
+               "reason": reason, "node": self.node_id}
+        if trace:
+            doc["trace"] = trace
+        doc["router"] = {
+            "role": self.ha_role, "term": self.term,
+            "active_term": observed.get("term"),
+            "active_holder": observed.get("holder"),
+        }
+        return doc
+
+    # -- the beat loop (lease renewal + anti-entropy) ------------------
+    def _beat_loop(self) -> None:
+        next_ae = time.monotonic()
+        while not self._stop.wait(self._beat_s):
+            if self.lease is not None:
+                try:
+                    self.ha_beat()
+                except Exception:  # noqa: BLE001 — the beat survives
+                    pass
+            if (self.anti_entropy_s and self.anti_entropy_s > 0
+                    and self._active_now()
+                    and time.monotonic() >= next_ae):
+                next_ae = time.monotonic() + self.anti_entropy_s
+                try:
+                    self.anti_entropy_sweep()
+                except Exception:  # noqa: BLE001 — the loop must survive
+                    continue
 
     def anti_entropy_sweep(self) -> dict:
         """One digest-exchange reconciliation: collect every healthy
@@ -974,7 +1204,8 @@ class FleetRouter:
         for nid, (dig, _ab) in sorted(digests.items()):
             for name in dig:
                 union.setdefault(name, nid)
-        shipped = rows = 0
+        shipped = rows = subsumed = 0
+        covers_cache: Dict[str, Optional[dict]] = {}
         for nid, (dig, ab) in sorted(digests.items()):
             missing = [n for n in sorted(union)
                        if n not in dig and n not in ab]
@@ -986,11 +1217,37 @@ class FleetRouter:
                 # must not accrue failures to the healthy owner it was
                 # being caught up from (and vice versa)
                 try:
+                    cov = self._ae_covers(owner, name, covers_cache,
+                                          timeout_s)
+                except NodeBusy:
+                    break  # saturated link: finish this node next beat
+                except _LINK_FAULTS as e:
+                    self.membership.note_failure(owner, e)
+                    break
+                if cov is not None and cov.get("keys"):
+                    # row-level subsumption: the LACKER's own live set
+                    # decides whether the rows need to move at all — a
+                    # compacted segment it effectively holds is marked
+                    # covered without one row line crossing the wire
+                    try:
+                        sub = self.links[nid].request(
+                            {"op": "replog.subsumed", "name": name,
+                             "fingerprint": cov.get("fingerprint"),
+                             "keys": cov["keys"]}, timeout_s)
+                    except NodeBusy:
+                        break
+                    except _LINK_FAULTS as e:
+                        self.membership.note_failure(nid, e)
+                        break
+                    if sub.get("subsumed"):
+                        subsumed += 1
+                        continue
+                try:
                     pulled = self.links[owner].request(
                         {"op": "replog.pull", "segments": [name]},
                         timeout_s)
                 except NodeBusy:
-                    break  # saturated link: finish this node next beat
+                    break
                 except _LINK_FAULTS as e:
                     self.membership.note_failure(owner, e)
                     break
@@ -1011,12 +1268,32 @@ class FleetRouter:
         with self._lock:
             self.ae_sweeps += 1
             self.ae_segments_shipped += shipped
+            self.ae_segments_subsumed += subsumed
             self.ae_rows_shipped += rows
-        if shipped:
+        if shipped or subsumed:
             self.obs.event("fleet.anti_entropy", nodes=len(digests),
-                           segments=shipped, rows=rows)
+                           segments=shipped, rows=rows,
+                           subsumed=subsumed)
         return {"nodes": len(digests), "segments_shipped": shipped,
-                "rows_shipped": rows}
+                "segments_subsumed": subsumed, "rows_shipped": rows}
+
+    def _ae_covers(self, owner: str, name: str,
+                   cache: Dict[str, Optional[dict]],
+                   timeout_s: float) -> Optional[dict]:
+        """One segment's row-key coverage from its owner, fetched once
+        per sweep however many lackers need it.  None = the owner
+        cannot say (old node, unreadable segment): the ship proceeds —
+        subsumption is an optimization, never a correctness gate."""
+        if name in cache:
+            return cache[name]
+        resp = self.links[owner].request(
+            {"op": "replog.covers", "segments": [name]}, timeout_s)
+        cov = None
+        for c in resp.get("covers") or []:
+            if c.get("name") == name:
+                cov = c
+        cache[name] = cov
+        return cov
 
     # -- observability -------------------------------------------------
     def node_stats(self, timeout_s: float = 5.0) -> Dict[str, dict]:
@@ -1068,14 +1345,31 @@ class FleetRouter:
             }
             ae = {"sweeps": self.ae_sweeps,
                   "segments_shipped": self.ae_segments_shipped,
+                  "segments_subsumed": self.ae_segments_subsumed,
                   "rows_shipped": self.ae_rows_shipped,
                   "interval_s": self.anti_entropy_s,
                   "policy": self.ae_policy.name}
+            lease = {"enabled": self.lease is not None,
+                     "role": self.ha_role,
+                     "term": self.term,
+                     "holder": self.node_id,
+                     "takeovers": self.takeovers,
+                     "ha_sheds": self.ha_sheds}
+        if self.lease is not None:
+            lease["path"] = self.lease.path
+            lease["ttl_s"] = self.lease.ttl_s
+            if self.ha_role == "active":
+                lease["expires_in_s"] = round(
+                    self._lease_expires - time.time(), 2)
+            else:
+                lease["active_term"] = self._observed.get("term")
+                lease["active_holder"] = self._observed.get("holder")
         return {
             "address": self.address,
             "role": "router",
             "node": self.node_id,
             "uptime_s": round(time.monotonic() - self._t0, 1),
+            "lease": lease,
             **counters,
             "policy": self.policy.name,
             "admission": self.admission.snapshot(),
@@ -1111,9 +1405,24 @@ class FleetRouter:
                 ("qsm_fleet_ae_segments_shipped_total", c,
                  "anti-entropy segments replicated", {},
                  float(self.ae_segments_shipped)),
+                ("qsm_fleet_ae_segments_subsumed_total", c,
+                 "anti-entropy ships skipped (rows already held)", {},
+                 float(self.ae_segments_subsumed)),
                 ("qsm_fleet_in_flight", g, "router admitted lanes",
                  {}, float(adm["in_flight"])),
+                ("qsm_fleet_lease_term", g,
+                 "lease term this router last held", {},
+                 float(self.term)),
+                ("qsm_fleet_takeovers_total", c,
+                 "lease takeovers won by this router", {},
+                 float(self.takeovers)),
+                ("qsm_fleet_ha_sheds_total", c,
+                 "check/shrink refused while not the active router",
+                 {}, float(self.ha_sheds)),
             ]
+        out.append(("qsm_fleet_active", "gauge",
+                    "1 while this router's term is live", {},
+                    1.0 if self._active_now() else 0.0))
         out += [
             ("qsm_fleet_node_healthy", "gauge",
              "node health (1 healthy, 0 down/quarantined)",

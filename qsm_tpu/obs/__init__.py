@@ -49,7 +49,13 @@ _DUMP_TRIGGERS = {"worker.shed": "worker_crash",
                   "fault.hit": "fault_plane",
                   "node.shed": "node_death",
                   "node.partition": "partition",
-                  "fleet.quarantine": "node_quarantine"}
+                  "fleet.quarantine": "node_quarantine",
+                  # a router-HA takeover is a fleet-level incident by
+                  # definition (the old brain is dead, wedged or
+                  # partitioned): the new active's first act leaves an
+                  # artifact recording what it observed when it took
+                  # the term (fleet/router.py _promote)
+                  "router.takeover": "router_takeover"}
 
 
 class Observability:

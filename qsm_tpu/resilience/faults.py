@@ -64,7 +64,11 @@ tests/test_serve.py), ``worker`` (serve/worker.py pool-worker dispatch
 shed → re-dispatch → respawn/quarantine ladder, tests/test_serve_pool.py),
 ``node`` (fleet/router.py router→node round-trips — partition/hang/raise
 there exercises the fleet tier's exclude-and-re-dispatch ladder down to
-the router's own in-process host ladder, tests/test_fleet.py).
+the router's own in-process host ladder, tests/test_fleet.py), ``router``
+(serve/client.py client→router round-trips — partition/hang/raise there
+exercises the client's bounded multi-address failover onto the standby
+router, tests/test_fleet_ha.py; same hang/raise/kill/partition grammar,
+one level further out).
 """
 
 from __future__ import annotations

@@ -244,6 +244,15 @@ PRESETS = {
     # backlog ships over several beats instead of one unbounded one.
     "anti-entropy": RetryPolicy(name="anti-entropy", attempts=1,
                                 timeout_s=15.0, deadline_s=60.0),
+    # one node-to-node gossip exchange (qsm_tpu/fleet/gossip.py):
+    # timeout_s bounds a single digests/covers/pull/push round-trip
+    # with ONE random peer; deadline_s caps a whole beat's fan-out so
+    # a slow peer costs this node one bounded slice of its beat, not
+    # the beat — convergence rides the NEXT beat's fresh random picks.
+    # attempts stays 1: gossip is retried by cadence, never in-line (a
+    # peer that just failed is excluded for the rest of the sweep).
+    "gossip": RetryPolicy(name="gossip", attempts=1, timeout_s=10.0,
+                          deadline_s=20.0),
 }
 
 

@@ -33,11 +33,15 @@ servers — started with ``node_id`` / ``replog_dir`` so responses are
 node-stamped and the verdict bank is a segmented REPLICATED log
 serving the ``replog.*`` anti-entropy ops — sit behind a
 protocol-identical ``fleet.FleetRouter``; clients need no changes.
+With ``peers=``/``--peers`` the nodes also gossip replog segments
+DIRECTLY (fleet/gossip.py) so replication survives every router
+dying, and routers themselves run HA behind a filesystem lease
+(fleet/lease.py; clients ride it with a comma ``--addr a,b`` list).
 
 CLI: ``qsm-tpu serve`` / ``qsm-tpu submit`` / ``qsm-tpu fleet``
 (utils/cli.py); bench: tools/bench_serve.py (artifact
 ``BENCH_SERVE_r08.json``) and tools/bench_fleet.py
-(``BENCH_FLEET_r12.json``); static gates: the QSM-SERVE pass family
+(``BENCH_FLEET_r13.json``); static gates: the QSM-SERVE pass family
 (analysis/serve_passes.py), the QSM-POOL family
 (analysis/pool_passes.py), the QSM-OBS family
 (analysis/obs_passes.py) and the QSM-FLEET family

@@ -205,6 +205,21 @@ class VerdictCache:
             self.adopted += n
         return n
 
+    def holds_all(self, keys) -> bool:
+        """True iff EVERY key is in the live set — the row-level
+        subsumption gate (fleet/gossip.py, the ``replog.subsumed``
+        op): a segment whose keys are all held need not ship its rows.
+        Pure containment: no hit/miss accounting, no LRU touch (a
+        coverage probe must not keep cold entries artificially hot).
+        An empty key list is NOT coverage — there is nothing to
+        subsume, so the segment ships and the fingerprint check
+        decides."""
+        keys = list(keys)
+        if not keys:
+            return False
+        with self._lock:
+            return all(k in self._od for k in keys)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._od)

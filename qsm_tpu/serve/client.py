@@ -5,31 +5,74 @@ order.  Concurrency is per-connection (each concurrent caller opens its
 own client — the micro-batcher coalesces ACROSS connections), which is
 the shape tools/bench_serve.py drives.
 
-Used by ``qsm-tpu submit`` / ``qsm-tpu stats --serve``, the bench tool
-and tests/test_serve.py.
+Multi-address failover (ISSUE 13): ``address`` may be a comma-separated
+list (``--addr a,b``) naming an HA router pair (or any set of
+protocol-identical doors to the same fleet).  The client walks the list
+with BOUNDED retries on three signals:
+
+* connect failure / connection death mid-request — the socket layer's
+  word that this door is gone;
+* a ``SHED`` whose reason is ``router_standby`` / ``router_superseded``
+  — the door is alive but not the active brain (the HA refusal
+  contract, fleet/router.py), so the answer lives behind another one;
+* the ``router`` fault site (``QSM_TPU_FAULTS=partition:router`` /
+  ``raise:router`` / ``hang:router``) — the client→router exchange is
+  chaos-drillable on the CPU platform like every other link in the
+  stack.
+
+Re-asking after a death mid-request is safe because every fleet op is
+idempotent: check/shrink/stats are pure functions of the request and
+verdicts bank by fingerprint (a duplicate lands on the same cache row).
+Retries are bounded by ``len(addresses) + 1`` attempts — a fleet with
+no answering door raises ``ConnectionError``, never spins.
+
+Used by ``qsm-tpu submit`` / ``qsm-tpu stats --serve``, the bench tools
+and tests/test_serve.py, tests/test_fleet_ha.py.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import time
 from typing import List, Optional, Sequence, Union
 
 from ..core.history import History
+from ..resilience.faults import InjectedFault, inject
 from .protocol import (LineChannel, connect, history_to_rows, send_doc)
 
 _ids = itertools.count()
 
+# SHED reasons that mean "alive, but not the brain you want" — the
+# client hops to the next address instead of surfacing the refusal
+_FAILOVER_SHED_REASONS = ("router_standby", "router_superseded")
+
+# pause between full cycles through a multi-address list: a takeover
+# window (lease TTL + grace + one beat) lasts seconds, so burning the
+# whole address list once per millisecond would exhaust any attempt
+# budget long before the standby promotes.  The retry ladder is
+# WALL-CLOCK bounded by the client's own timeout_s instead.
+_CYCLE_PAUSE_S = 0.25
+
 
 class CheckClient:
     """JSON-lines client for a running :class:`~qsm_tpu.serve.server.
-    CheckServer` (address: ``host:port`` or a UNIX socket path)."""
+    CheckServer` or :class:`~qsm_tpu.fleet.router.FleetRouter`
+    (address: ``host:port`` or a UNIX socket path, or a comma list of
+    either for multi-address failover — see module docstring)."""
 
     def __init__(self, address: str, timeout_s: float = 60.0):
         self.address = address
+        self.addresses = [a.strip() for a in str(address).split(",")
+                          if a.strip()]
+        if not self.addresses:
+            raise ValueError("CheckClient needs at least one address")
         self.timeout_s = timeout_s
-        self._sock = connect(address, timeout_s=timeout_s)
-        self._chan = LineChannel(self._sock)
+        self.failovers = 0   # address hops taken (death or HA shed)
+        self._addr_i = 0
+        self._sock = None
+        self._chan: Optional[LineChannel] = None
+        self._connect_any()
 
     # ------------------------------------------------------------------
     def check(self, model: str,
@@ -89,18 +132,139 @@ class CheckClient:
         return self._round_trip({"op": "shutdown"})
 
     def close(self) -> None:
+        if self._sock is None:
+            return
         try:
             self._sock.close()
         except OSError:
             pass
+        self._sock = None
+        self._chan = None
 
     # ------------------------------------------------------------------
+    @property
+    def connected_address(self) -> str:
+        """The address currently (or last) spoken to."""
+        return self.addresses[self._addr_i % len(self.addresses)]
+
+    def _connect_any(self, bound_s: Optional[float] = None) -> None:
+        """Connect to the first answering address, starting from the
+        current position (sticky: a client that failed over stays on
+        the working door).  ``bound_s`` caps EACH connect attempt —
+        the failover ladder passes its remaining budget so a
+        SYN-dropping partition cannot stall one attempt for the whole
+        ``timeout_s`` per address."""
+        bound_s = self.timeout_s if bound_s is None else bound_s
+        last: Optional[BaseException] = None
+        for k in range(len(self.addresses)):
+            i = (self._addr_i + k) % len(self.addresses)
+            try:
+                sock = connect(self.addresses[i],
+                               timeout_s=max(0.1, bound_s))
+            except OSError as e:
+                last = e
+                continue
+            self._addr_i = i
+            self._sock = sock
+            self._chan = LineChannel(sock)
+            return
+        raise ConnectionError(
+            f"no server answered at {self.addresses}: "
+            f"{type(last).__name__}: {last}")
+
+    def _advance(self) -> None:
+        self.close()
+        self._addr_i = (self._addr_i + 1) % len(self.addresses)
+        self.failovers += 1
+
     def _round_trip(self, req: dict) -> dict:
+        """One request under bounded multi-address failover (module
+        docstring).  Single-address clients keep one bounded retry on
+        a fresh connection — a server restart on the same address must
+        not read as server death (the NodeLink lesson one level up).
+        Multi-address clients cycle the list with a short pause
+        between full cycles, wall-clock bounded by ``timeout_s``: a
+        takeover window (the standby still shedding ``router_standby``
+        while the lease runs out) lasts seconds, and a count bound
+        would burn out in milliseconds against a dead door."""
+        n = len(self.addresses)
+        deadline = time.monotonic() + max(1.0, self.timeout_s)
+        # bounded by construction: every attempt either pauses toward
+        # the deadline or is one of the first `n + 1` free tries, AND
+        # the deadline is re-checked per attempt (a SYN-dropping
+        # partition burns connect budget, not just pause budget)
+        max_attempts = (n + 1) + n * max(
+            1, int(max(1.0, self.timeout_s) / _CYCLE_PAUSE_S) + 1)
+        last: Optional[BaseException] = None
+        for attempt in range(max_attempts):
+            if attempt and time.monotonic() >= deadline:
+                break
+            try:
+                doc = self._ask_once(req, deadline)
+            except (OSError, ConnectionError, TimeoutError, ValueError,
+                    InjectedFault) as e:
+                last = e
+                self._advance()
+                if not self._pause_between_cycles(attempt, n, deadline):
+                    break
+                continue
+            if (doc.get("shed")
+                    and doc.get("reason") in _FAILOVER_SHED_REASONS
+                    and n > 1):
+                # alive but not the active brain: hop — the active is
+                # behind one of the other doors (or about to be, after
+                # its lease beat)
+                last = None
+                self._advance()
+                if not self._pause_between_cycles(attempt, n, deadline):
+                    return doc  # out of time: surface the honest SHED
+                continue
+            return doc
+        if last is None:
+            raise ConnectionError(
+                f"no active router at {self.addresses} before the "
+                f"{self.timeout_s:.1f}s client bound")
+        raise ConnectionError(
+            f"server at {self.address} closed the connection "
+            f"({type(last).__name__}: {last})")
+
+    def _pause_between_cycles(self, attempt: int, n: int,
+                              deadline: float) -> bool:
+        """After a full cycle through the address list, wait out a
+        short pause (the takeover window is time, not attempts).
+        False = the deadline is spent — stop retrying.  Single-address
+        clients get their one free fresh-connection retry, then stop."""
+        if n == 1:
+            return attempt < 1
+        if (attempt + 1) % n:
+            return True  # mid-cycle: try the next address immediately
+        remaining = deadline - time.monotonic()
+        if remaining <= _CYCLE_PAUSE_S:
+            return False
+        time.sleep(_CYCLE_PAUSE_S)
+        return True
+
+    def _ask_once(self, req: dict,
+                  deadline: Optional[float] = None) -> dict:
+        bound = self.timeout_s
+        if deadline is not None:
+            bound = max(0.1, min(bound, deadline - time.monotonic()))
+        if self._sock is None:
+            self._connect_any(bound)
+        act = inject("router")
+        if act in ("partition", "wedge"):
+            # the exchange's frames drop both directions: the request
+            # never arrives, the answer never comes — the failover
+            # loop treats it exactly like a dead door
+            self.close()
+            raise ConnectionError(
+                "injected partition at fault site 'router'")
         send_doc(self._sock, req)
-        line = self._chan.read_line(timeout_s=self.timeout_s)
+        line = self._chan.read_line(timeout_s=bound)
         if line is None:
             raise ConnectionError(
-                f"server at {self.address} closed the connection")
+                f"server at {self.connected_address} closed the "
+                "connection")
         return json.loads(line)
 
     def __enter__(self) -> "CheckClient":

@@ -54,11 +54,25 @@ with a ``node_id`` stamps ``node`` on EVERY response (ok/SHED/error),
 so router-merged answers say which node decided which lanes; a server
 started with a ``replog_dir`` additionally answers the
 ``replog.digests`` / ``replog.pull`` / ``replog.push`` ops — the
-segment-exchange surface the router's anti-entropy loop reconciles
-replicated verdict banks through.  The ``FleetRouter`` itself speaks
-exactly this protocol, so clients point at a router address unchanged;
-its SHED responses carry the per-node health block (``fleet``) beside
-the ``pool`` block a single node would send.
+segment-exchange surface anti-entropy reconciles replicated verdict
+banks through — plus ``replog.covers`` / ``replog.subsumed`` (the
+row-level subsumption legs: a segment whose rows the receiver already
+holds is marked covered without its rows crossing the wire) and
+``gossip.peers`` (configure node-to-node gossip at runtime,
+fleet/gossip.py).  The ``FleetRouter`` itself speaks exactly this
+protocol, so clients point at a router address unchanged; its SHED
+responses carry the per-node health block (``fleet``) beside the
+``pool`` block a single node would send.
+
+Router HA (fleet/lease.py): a router running under a lease stamps its
+``term`` on every response; a NON-active router answers check/shrink
+with ``{"shed": true, "reason": "router_standby" |
+"router_superseded", "router": {role, term, active_term,
+active_holder}}`` — never a verdict.  ``CheckClient`` accepts a comma
+address list (``--addr a,b``) and fails over onto the next router on
+connection death or an HA shed, wall-clock bounded by its own
+``timeout_s`` (safe: every op here is idempotent and verdicts bank by
+fingerprint).
 """
 
 from __future__ import annotations

@@ -208,7 +208,10 @@ class CheckServer:
                  obs: Optional[Observability] = None,
                  node_id: Optional[str] = None,
                  replog_dir: Optional[str] = None,
-                 replog_seal_rows: int = 256):
+                 replog_seal_rows: int = 256,
+                 peers: Optional[list] = None,
+                 gossip_s: float = 0.0,
+                 gossip_fanout: int = 2):
         if engine not in ("auto", "planned"):
             raise ValueError(f"unknown serve engine {engine!r}; "
                              "one of ('auto', 'planned')")
@@ -269,6 +272,18 @@ class CheckServer:
                                        seal_rows=replog_seal_rows)
         self.cache = VerdictCache(max_entries=cache_entries,
                                   path=cache_path, store=self.replog)
+        # peer-to-peer anti-entropy (fleet/gossip.py): with peers and
+        # a replog, this node keeps its banked verdicts converging
+        # with the fleet's NODE-TO-NODE — no router in the loop, so
+        # replication survives every router being dead.  Peers come
+        # from the ctor (static deploys) or the `gossip.peers` op
+        # (qsm-tpu fleet wires spawned nodes whose addresses are only
+        # known after their banners).
+        self.gossip = None
+        self._gossip_interval = float(gossip_s)
+        self._gossip_fanout = int(gossip_fanout)
+        if self.replog is not None and peers:
+            self._make_gossip(peers)
         self.admission = AdmissionController(
             queue_depth=queue_depth, policy=self.policy,
             pool_state=self.pool.shed_state if self.pool else None)
@@ -316,6 +331,17 @@ class CheckServer:
         self.shrink_lanes = 0      # candidate lanes those rounds carried
         self.shrink_memo_hits = 0  # candidates answered without checking
 
+    def _make_gossip(self, peers) -> None:
+        from ..fleet.gossip import GossipAgent
+
+        if self.gossip is None:
+            self.gossip = GossipAgent(
+                self.node_id or "n0", self.replog, self.cache,
+                peers=peers, interval_s=self._gossip_interval,
+                fanout=self._gossip_fanout, obs=self.obs)
+        else:
+            self.gossip.set_peers(peers)
+
     # -- lifecycle -----------------------------------------------------
     @property
     def address(self) -> str:
@@ -356,6 +382,8 @@ class CheckServer:
                 host=self.host if not self.unix_path else "127.0.0.1",
                 port=self.metrics_port).start()
             self.metrics_port = self._metrics_server.port
+        if self.gossip is not None:
+            self.gossip.start()
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="qsm-serve-accept")
         t.start()
@@ -374,6 +402,8 @@ class CheckServer:
         # wait → kill escalation → reap) so no test or caller ever
         # leaks a child process
         self.batcher.stop()
+        if self.gossip is not None:
+            self.gossip.stop()
         if self.pool is not None:
             self.pool.stop()
         if self._sock is not None:
@@ -554,8 +584,11 @@ class CheckServer:
         op = req.get("op", "check")
         if op == "stats":
             self._send(conn, {"ok": True, "stats": self.stats()})
-        elif op in ("replog.digests", "replog.pull", "replog.push"):
+        elif op in ("replog.digests", "replog.pull", "replog.push",
+                    "replog.covers", "replog.subsumed"):
             self._handle_replog(conn, op, req)
+        elif op == "gossip.peers":
+            self._handle_gossip_peers(conn, req)
         elif op == "shutdown":
             if self.allow_shutdown:
                 self._send(conn, {"ok": True, "stopping": True})
@@ -598,11 +631,51 @@ class CheckServer:
                                        "(start with replog_dir)"})
             return
         if op == "replog.digests":
+            # `absorbed` on the wire = everything covered (absorbed by
+            # compaction OR subsumed by row coverage): a peer must not
+            # re-offer either kind
             self._send(conn, {"id": req.get("id"), "ok": True,
                               "digests": self.replog.digests(),
-                              "absorbed": self.replog.absorbed(),
+                              "absorbed": self.replog.covered(),
                               "active_rows":
                                   self.replog.snapshot()["active_rows"]})
+            return
+        if op == "replog.covers":
+            # the coverage leg of row-level subsumption: the row KEYS
+            # of held segments (never the rows), so a peer can decide
+            # whether a ship is needed at all — one file read each
+            covers = self.replog.covers(
+                [str(n) for n in list(req.get("segments") or [])[:64]])
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "covers": covers})
+            return
+        if op == "replog.subsumed":
+            # the decision leg: THIS node's live set says whether it
+            # already holds every row of the offered segment — if so
+            # the name is recorded as covered and the rows never ship
+            name = str(req.get("name") or "")
+            fp = str(req.get("fingerprint") or "")
+            keys = [str(k) for k in (req.get("keys") or [])]
+            held = (name in self.replog.digests()
+                    or name in self.replog.covered())
+            if held:
+                self._send(conn, {"id": req.get("id"), "ok": True,
+                                  "subsumed": True, "held": True})
+                return
+            subsumed = False
+            if keys and self.cache.holds_all(keys):
+                try:
+                    subsumed = self.replog.note_subsumed(name, fp)
+                except ValueError as e:
+                    self._send(conn, {"id": req.get("id"), "ok": False,
+                                      "error": f"{type(e).__name__}: "
+                                               f"{e}"[:200]})
+                    return
+                if subsumed:
+                    self.obs.event("replog.subsume", segment=name,
+                                   rows=len(keys))
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "subsumed": subsumed})
             return
         if op == "replog.pull":
             segments = []
@@ -634,6 +707,30 @@ class CheckServer:
         if errors:
             doc["errors"] = errors
         self._send(conn, doc)
+
+    def _handle_gossip_peers(self, conn: socket.socket,
+                             req: dict) -> None:
+        """(Re)configure this node's gossip peer set at runtime — the
+        wiring op ``qsm-tpu fleet`` uses after spawned nodes' addresses
+        are known.  Idempotent; requires a replog (gossip replicates
+        segments, a bankless node has none to exchange)."""
+        if self.replog is None:
+            self._send(conn, {"id": req.get("id"), "ok": False,
+                              "error": "node runs no replicated log "
+                                       "(start with replog_dir)"})
+            return
+        peers = req.get("peers") or []
+        if req.get("interval_s") is not None:
+            self._gossip_interval = float(req["interval_s"])
+        self._make_gossip(peers)
+        self.gossip.interval_s = self._gossip_interval
+        if not self._stop.is_set():
+            # idempotent: also wakes an agent created dormant
+            # (interval 0) that this op just gave a real beat
+            self.gossip.start()
+        self._send(conn, {"id": req.get("id"), "ok": True,
+                          "peers": self.gossip.peer_ids(),
+                          "interval_s": self.gossip.interval_s})
 
     # -- the check path ------------------------------------------------
     def _handle_check(self, conn: socket.socket, req: dict) -> None:
@@ -1358,6 +1455,10 @@ class CheckServer:
             "admission": self.admission.snapshot(),
             "batcher": self.batcher.snapshot(),
             "cache": self.cache.stats(),
+            # node-to-node anti-entropy accounting (fleet/gossip.py):
+            # None unless this node gossips
+            "gossip": (self.gossip.snapshot()
+                       if self.gossip is not None else None),
             # per-worker rows (dispatches, faults, deaths, respawns,
             # quarantines) — what `qsm-tpu stats --serve` aggregates
             "pool": self.pool.snapshot() if self.pool is not None else None,

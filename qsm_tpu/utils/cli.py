@@ -469,6 +469,12 @@ def cmd_serve(args) -> int:
         # the planner-built device engine initializes jax backends at
         # first request: gate exactly like --backend tpu
         _ensure_device_reachable()
+    peers = [a.strip() for a in (args.peers or "").split(",")
+             if a.strip()]
+    if peers and not args.replog_dir:
+        raise SystemExit("--peers needs --replog-dir (gossip "
+                         "replicates replog segments; a bankless node "
+                         "has none to exchange)")
     server = CheckServer(
         host=args.host, port=args.port, unix_path=args.unix,
         engine=args.engine, max_lanes=args.max_lanes,
@@ -479,7 +485,9 @@ def cmd_serve(args) -> int:
         trace_log=args.trace_log, flight_dir=args.flight_dir,
         metrics_port=args.metrics_port,
         node_id=args.node_id, replog_dir=args.replog_dir,
-        replog_seal_rows=args.replog_seal_rows)
+        replog_seal_rows=args.replog_seal_rows,
+        peers=peers or None, gossip_s=args.gossip_s,
+        gossip_fanout=args.gossip_fanout)
     warm = [m.strip() for m in args.warm.split(",")] if args.warm else []
     warm = [m for m in warm if m]
     unknown = sorted(set(warm) - set(MODELS))
@@ -496,6 +504,7 @@ def cmd_serve(args) -> int:
                           "engine": args.engine,
                           "node": args.node_id,
                           "replog": args.replog_dir,
+                          "peers": peers or None,
                           "workers": args.workers,
                           "max_lanes": args.max_lanes,
                           "flush_ms": args.flush_ms,
@@ -566,20 +575,51 @@ def cmd_fleet(args) -> int:
                         p.kill()
                 raise SystemExit(
                     f"node {i} failed to start (no serve banner)")
+    if args.gossip_s and args.gossip_s > 0:
+        # wire node-to-node gossip: each node gets every OTHER node as
+        # a peer (the gossip.peers op — addresses are only known now).
+        # Replication then no longer depends on any router living.
+        from ..serve.protocol import LineChannel, connect, send_doc
+
+        for nid, addr in nodes:
+            peers = [[pid, paddr] for pid, paddr in nodes
+                     if pid != nid]
+            try:
+                sock = connect(addr, timeout_s=5.0)
+                try:
+                    send_doc(sock, {"op": "gossip.peers",
+                                    "peers": peers,
+                                    "interval_s": args.gossip_s})
+                    LineChannel(sock).read_line(timeout_s=5.0)
+                finally:
+                    sock.close()
+            except (OSError, ValueError):
+                # a node without a replog (--addrs fronting a plain
+                # server) just doesn't gossip; the router sweep still
+                # covers it
+                pass
     router = FleetRouter(
         nodes, host=args.host, port=args.port, unix_path=args.unix,
         queue_depth=args.queue_depth,
         quarantine_after=args.quarantine_after,
         heartbeat_s=args.heartbeat_s,
         anti_entropy_s=args.anti_entropy_s,
+        node_id=args.router_id,
+        lease_path=args.lease_path,
+        lease_ttl_s=args.lease_ttl_s,
         trace_log=args.trace_log, flight_dir=args.flight_dir,
         metrics_port=args.metrics_port)
     router.start()
     try:
         print(json.dumps({"fleet": router.address,
+                          "router_id": args.router_id,
+                          "role": router.ha_role,
+                          "term": router.term,
+                          "lease": args.lease_path,
                           "nodes": dict(nodes),
                           "spawned": len(procs),
                           "anti_entropy_s": args.anti_entropy_s,
+                          "gossip_s": args.gossip_s,
                           "trace_log": args.trace_log,
                           "flight_dir": args.flight_dir}), flush=True)
         router.wait()
@@ -604,19 +644,38 @@ def cmd_fleet(args) -> int:
 
 def _render_stats_fleet(doc: dict) -> str:
     """The ``stats --serve ROUTER --fleet`` view: the router's own
-    counters plus one row per node — live fleet health at a glance."""
+    counters (the active/standby lease line first — which brain is
+    live and under which term) plus one row per node — fleet health
+    at a glance."""
     lines = [
         f"fleet router {doc.get('address', '?')}  uptime "
         f"{doc.get('uptime_s', 0)}s  requests {doc.get('requests', 0)} "
         f"histories {doc.get('histories', 0)}",
+    ]
+    lease = doc.get("lease") or {}
+    if lease.get("enabled"):
+        role = lease.get("role", "?")
+        bits = [f"lease: {lease.get('holder', '?')} [{role.upper()}] "
+                f"term {lease.get('term', 0)}  takeovers "
+                f"{lease.get('takeovers', 0)}  ha_sheds "
+                f"{lease.get('ha_sheds', 0)}"]
+        if role == "active":
+            bits.append(f"  expires_in {lease.get('expires_in_s', '?')}s")
+        elif lease.get("active_holder"):
+            bits.append(f"  active: {lease['active_holder']} term "
+                        f"{lease.get('active_term', '?')}")
+        lines.append("".join(bits))
+    else:
+        lines.append("lease: off (single router — no HA standby)")
+    lines.append(
         f"node_faults {doc.get('node_faults', 0)}  redispatches "
         f"{doc.get('redispatches', 0)}  ladder_lanes "
         f"{doc.get('ladder_lanes', 0)}  node_sheds "
-        f"{doc.get('node_sheds', 0)}",
-    ]
+        f"{doc.get('node_sheds', 0)}")
     ae = doc.get("anti_entropy") or {}
     lines.append(f"anti-entropy sweeps {ae.get('sweeps', 0)}  segments "
-                 f"{ae.get('segments_shipped', 0)}  rows "
+                 f"{ae.get('segments_shipped', 0)}  subsumed "
+                 f"{ae.get('segments_subsumed', 0)}  rows "
                  f"{ae.get('rows_shipped', 0)}")
     fleet_nodes = doc.get("fleet_nodes") or {}
     for n in (doc.get("membership") or {}).get("nodes", []):
@@ -1488,7 +1547,9 @@ def main(argv=None) -> int:
                    help="overrides the trace's own 'model' field")
     p.add_argument("--addr", default=None,
                    help="send to a running check server's `shrink` verb "
-                        "instead of shrinking in-process")
+                        "instead of shrinking in-process (a,b = "
+                        "multi-address failover across an HA router "
+                        "pair)")
     p.add_argument("--certificate", action="store_true",
                    help="compute the 1-minimality certificate (one "
                         "witness per drop-one neighbor) and audit it "
@@ -1590,6 +1651,18 @@ def main(argv=None) -> int:
                    help="rows per sealed replog segment (the unit "
                         "anti-entropy replicates; smaller = fresher "
                         "replication, more segment files)")
+    p.add_argument("--peers", default=None, metavar="A,B",
+                   help="comma-separated peer node addresses for "
+                        "node-to-node gossip anti-entropy "
+                        "(fleet/gossip.py; needs --replog-dir): banked "
+                        "verdicts keep converging with every router "
+                        "dead")
+    p.add_argument("--gossip-s", type=float, default=2.0,
+                   help="gossip beat seconds (with --peers; 0 = the "
+                        "agent exists but only the gossip.peers op "
+                        "drives it)")
+    p.add_argument("--gossip-fanout", type=int, default=2,
+                   help="random peers contacted per gossip beat")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -1637,6 +1710,24 @@ def main(argv=None) -> int:
                    metavar="PORT",
                    help="router Prometheus /metrics port (per-node "
                         "health + traffic series)")
+    p.add_argument("--lease-path", default=None, metavar="PATH",
+                   help="router-HA lease file (fleet/lease.py): run "
+                        "several `qsm-tpu fleet` routers with the SAME "
+                        "fleet config and lease path — one wins active "
+                        "(term-stamped responses), the rest stand by "
+                        "and take over on lease expiry; clients ride "
+                        "it with a comma --addr list")
+    p.add_argument("--lease-ttl-s", type=float, default=3.0,
+                   help="lease TTL seconds (renewed each beat; a dead "
+                        "active is superseded within ~1.5x this)")
+    p.add_argument("--router-id", default="router",
+                   help="this router's id (the lease holder name and "
+                        "the `node` stamp on router-answered "
+                        "responses)")
+    p.add_argument("--gossip-s", type=float, default=2.0,
+                   help="wire spawned/fronted nodes for node-to-node "
+                        "gossip anti-entropy at this beat (0 = off): "
+                        "replication then survives every router dying")
     p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser(
@@ -1658,7 +1749,10 @@ def main(argv=None) -> int:
         help="submit a trace file (the `check` format) to a running "
              "check server")
     p.add_argument("--addr", required=True,
-                   help="server address: host:port or a UNIX socket path")
+                   help="server address: host:port or a UNIX socket "
+                        "path; a comma list (a,b) enables bounded "
+                        "multi-address failover across an HA router "
+                        "pair — safe, every fleet op is idempotent")
     p.add_argument("--trace", required=True)
     p.add_argument("--model", default=None, choices=sorted(MODELS),
                    help="overrides the trace's own 'model' field")
@@ -1776,7 +1870,8 @@ def main(argv=None) -> int:
                    help="print a running check server's aggregate stats "
                         "(requests, batch occupancy, cache hit rate, "
                         "sheds, per-engine search/resilience counters) "
-                        "instead of running a corpus")
+                        "instead of running a corpus (a,b = "
+                        "multi-address failover)")
     p.add_argument("--watch", action="store_true",
                    help="with --serve: a refreshing terminal view of "
                         "the live counters (Ctrl-C exits)")

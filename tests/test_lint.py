@@ -55,8 +55,9 @@ def test_in_tree_corpus_is_clean(report):
     # the serving plane (family e): every connection-accepting /
     # lane-buffering module (the pool supervisor and worker recv loops
     # included) plus the serve bench tool — and, since r12, the fleet
-    # tier's router/membership/replog + its soak bench
-    assert len(DEFAULT_SERVE_FILES) == 14
+    # tier's router/membership/replog (+ the r13 lease/gossip modules)
+    # and its soak bench
+    assert len(DEFAULT_SERVE_FILES) == 16
     assert "serve" in report.passes
     # the worker-lifecycle plane (family f): spawn/supervise/bench
     assert len(DEFAULT_POOL_FILES) == 3
@@ -72,9 +73,9 @@ def test_in_tree_corpus_is_clean(report):
     # cardinality over obs/ + serve/ + resilience/
     assert len(DEFAULT_OBS_FILES) >= 17
     assert "obs" in report.passes
-    # the fleet re-dispatch family (j): router/membership/replog +
-    # the soak bench
-    assert len(DEFAULT_FLEET_FILES) == 4
+    # the fleet re-dispatch + lease family (j): router/membership/
+    # replog + the r13 lease/gossip modules + the soak bench
+    assert len(DEFAULT_FLEET_FILES) == 6
     assert "fleet" in report.passes
     # a–j all registered and all ran in the default lane
     assert sorted(FAMILIES) == list("abcdefghij")
@@ -245,7 +246,29 @@ def test_fleet_redispatch_is_caught():
     # BoundedRedispatchRouterStub (tried.add + exclude=) stays clean
     assert "no bounded attempt budget" in hits[0].message
     assert "never excludes the failed node" in hits[1].message
+    by_rule.pop("QSM-FLEET-LEASE")  # pinned by its own bulb test
     assert not by_rule  # nothing else fires on the fixture module
+
+
+def test_fleet_lease_is_caught():
+    """The lease pass's bulb check (family j, ISSUE 13): the
+    while-True promote loop and the term/expiry-blind acquire each
+    fire QSM-FLEET-LEASE exactly once; the beat-driven twin that
+    reads the record, consults expired()/term and acquires at most
+    once per beat must NOT be flagged."""
+    from qsm_tpu.analysis.fleet_passes import check_fleet_file
+
+    findings = [f for f in check_fleet_file(fixtures.__file__)
+                if f.rule_id == "QSM-FLEET-LEASE"]
+    assert len(findings) == 2
+    assert {f.severity for f in findings} == {ERROR}
+    assert "promote_forever" in findings[0].location
+    assert "unbounded standby-promote loop" in findings[0].message
+    assert "promote_blind" in findings[1].location
+    assert "never consults lease term/expiry" in findings[1].message
+    # the sanctioned LeasedTakeoverRouterStub stays clean
+    assert not any("LeasedTakeoverRouterStub" in f.location
+                   or "beat" in f.location for f in findings)
 
 
 def test_fleet_live_tree_is_clean():

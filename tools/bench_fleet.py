@@ -28,6 +28,26 @@ plain POSIX signals:
   verdicts AND zero lost banked verdicts (every check lane answers
   from the bank — ``cached`` all true) and shrink results bit-equal.
 
+The r13 cells kill the ROUTER itself (ISSUE 13 — the tier's last
+single points of failure):
+
+* ``kill_router``    — SIGKILL the ACTIVE of an HA router pair
+  MID-soak (fleet/lease.py): the standby takes the lease within the
+  TTL window, clients on ``--addr a,b`` fail over, the recorded mix
+  completes with zero wrong and zero lost verdicts, and the standby's
+  span log shows the ``router.takeover`` span with the superseded
+  term;
+* ``wedge_router``   — SIGSTOP the active (alive, holds the lease
+  file, renews nothing): the lease expires, the standby promotes, and
+  after SIGCONT the STALE-term router answers SHED with
+  ``router_superseded`` — the split-brain pin, live;
+* ``gossip_router_dead`` — stop every router outright after banking
+  the mix: node-to-node gossip (fleet/gossip.py) alone converges the
+  replogs within a bounded number of beats — every segment in the
+  fleet union held-or-covered by every node (row-level subsumption
+  makes held-set equality unreachable by design when a key banked on
+  two nodes).
+
 Scaling honesty (the r08 precedent): the ≥2× three-node gate needs
 ``host_cores >= nodes + 1`` to be physically expressible — three node
 processes cannot out-check one on a single core.  The summary stamps
@@ -64,6 +84,8 @@ CHAOS_ROUNDS = 4      # longer soak so mid-run faults land mid-run
 SUBPROC_TIMEOUT_S = 600.0
 KILL_AFTER_S = 0.3   # early: later soak rounds are bank hits and fly
 LINK_TIMEOUT_S = 3.0  # router→node bound for the chaos cells
+LEASE_TTL_S = 2.0     # router-HA lease TTL for the r13 chaos cells
+GOSSIP_BEAT_S = 0.3   # node-to-node gossip beat in the r13 cells
 
 
 # ---------------------------------------------------------------------------
@@ -204,6 +226,116 @@ def _fleet(n_nodes: int, run_dir: str, cell: str, seal_rows: int = 64,
     return router, nodes
 
 
+def _send_op(addr: str, doc: dict, timeout_s: float = 5.0) -> dict:
+    """One raw op round-trip (gossip.peers wiring, digest polling)."""
+    from qsm_tpu.serve.protocol import LineChannel, connect, send_doc
+
+    sock = connect(addr, timeout_s=timeout_s)
+    try:
+        send_doc(sock, doc)
+        line = LineChannel(sock).read_line(timeout_s=timeout_s)
+        return json.loads(line) if line else {}
+    finally:
+        sock.close()
+
+
+def _wire_gossip(nodes, beat_s: float = GOSSIP_BEAT_S) -> None:
+    """Node-to-node anti-entropy: every node gets every OTHER node as
+    a gossip peer (the gossip.peers op `qsm-tpu fleet` drives)."""
+    for n in nodes:
+        peers = [[o.nid, o.unix_path] for o in nodes if o is not n]
+        resp = _send_op(n.unix_path, {"op": "gossip.peers",
+                                      "peers": peers,
+                                      "interval_s": beat_s})
+        assert resp.get("ok"), resp
+
+
+class RouterProc:
+    """One `qsm-tpu fleet` router subprocess fronting externally-spawned
+    nodes — the r13 chaos cells SIGKILL/SIGSTOP these like nodes."""
+
+    def __init__(self, rid: str, run_dir: str, node_addrs,
+                 lease_path: str, trace: bool = False):
+        self.rid = rid
+        self.unix_path = os.path.join(run_dir, f"{rid}.sock")
+        self.node_addrs = list(node_addrs)
+        self.lease_path = lease_path
+        self.trace_log = (os.path.join(run_dir, f"{rid}_trace.jsonl")
+                          if trace else None)
+        self.flight_dir = (os.path.join(run_dir, f"{rid}_flight")
+                           if trace else None)
+        self.proc = None
+        self.role = None
+        self.term = None
+
+    def spawn(self) -> "RouterProc":
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("QSM_TPU_FAULTS", None)
+        cmd = [sys.executable, "-m", "qsm_tpu", "fleet",
+               "--addrs", ",".join(self.node_addrs),
+               "--unix", self.unix_path,
+               "--router-id", self.rid,
+               "--lease-path", self.lease_path,
+               "--lease-ttl-s", str(LEASE_TTL_S),
+               "--heartbeat-s", "0.3",
+               "--anti-entropy-s", "0.5",
+               "--gossip-s", "0"]
+        if self.trace_log:
+            cmd += ["--trace-log", self.trace_log,
+                    "--flight-dir", self.flight_dir]
+        self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                     text=True, cwd=REPO, env=env)
+        banner = json.loads(self.proc.stdout.readline())
+        assert banner.get("fleet") == self.unix_path, banner
+        self.role = banner.get("role")
+        self.term = banner.get("term")
+        return self
+
+    def sigkill(self) -> None:
+        self.proc.kill()
+        self.proc.wait(timeout=10.0)
+
+    def sigstop(self) -> None:
+        os.kill(self.proc.pid, signal.SIGSTOP)
+
+    def sigcont(self) -> None:
+        try:
+            os.kill(self.proc.pid, signal.SIGCONT)
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        try:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5.0)
+        except OSError:
+            pass
+
+
+def _ha_pair(run_dir: str, cell: str, n_nodes: int = 3,
+             trace_standby: bool = True):
+    """N nodes + an active/standby `qsm-tpu fleet` router pair sharing
+    one lease.  The FIRST router wins the lease (spawned and bannered
+    before the second starts)."""
+    cell_dir = os.path.join(run_dir, cell)
+    os.makedirs(cell_dir, exist_ok=True)
+    nodes = [Node(f"n{i}", cell_dir).spawn() for i in range(n_nodes)]
+    addrs = [n.unix_path for n in nodes]
+    lease = os.path.join(cell_dir, "lease.json")
+    ra = RouterProc("rA", cell_dir, addrs, lease).spawn()
+    rb = RouterProc("rB", cell_dir, addrs, lease,
+                    trace=trace_standby).spawn()
+    assert ra.role == "active" and ra.term == 1, (ra.role, ra.term)
+    assert rb.role == "standby", rb.role
+    return nodes, ra, rb
+
+
 def _busiest_node(router, mix) -> str:
     """The node owning the most of the mix's whole-history keys — the
     one in-flight lanes are most likely riding when the chaos lands."""
@@ -233,9 +365,12 @@ def _drive(router, mix, n_clients: int, rounds: int,
     """Closed-loop clients replaying the recorded mix; every check
     response verified against the oracle reference on receipt.
     ``chaos`` is a zero-arg callable fired ``chaos_at_s`` into the
-    drive (SIGKILL/SIGSTOP/...)."""
+    drive (SIGKILL/SIGSTOP/...).  ``router`` is a FleetRouter or a
+    plain address string — the r13 HA cells pass ``"a,b"`` so clients
+    exercise real multi-address failover."""
     from qsm_tpu.serve.client import CheckClient
 
+    address = router if isinstance(router, str) else router.address
     lock = threading.Lock()
     latencies, errors, wrong = [], [], []
     served = [0]
@@ -243,7 +378,7 @@ def _drive(router, mix, n_clients: int, rounds: int,
 
     def drive(ci: int):
         try:
-            with CheckClient(router.address, timeout_s=120.0) as client:
+            with CheckClient(address, timeout_s=120.0) as client:
                 for _r in range(rounds):
                     # each client starts at its own offset so the mix
                     # interleaves across connections instead of
@@ -523,6 +658,191 @@ def bench_rolling_restart(mix, run_dir: str) -> dict:
     return row
 
 
+def bench_kill_router(mix, run_dir: str) -> dict:
+    """SIGKILL the ACTIVE router of an HA pair mid-soak: the standby
+    must take the lease within the TTL window, multi-address clients
+    fail over, the mix completes with zero wrong/lost verdicts, and
+    the standby's span log carries the ``router.takeover`` span with
+    the superseded term."""
+    from qsm_tpu.obs import load_events
+    from qsm_tpu.serve.client import CheckClient
+
+    nodes, ra, rb = _ha_pair(run_dir, "kill_router")
+    takeover_s = [None]
+
+    def chaos():
+        t0 = time.monotonic()
+        ra.sigkill()
+        # the measured takeover bound: lease file holder flips to rB
+        deadline = t0 + 4 * LEASE_TTL_S
+        while time.monotonic() < deadline:
+            try:
+                with open(ra.lease_path) as f:
+                    rec = json.load(f)
+                if rec.get("holder") == "rB":
+                    takeover_s[0] = round(time.monotonic() - t0, 2)
+                    return
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.05)
+
+    try:
+        wall, lat, errors, wrong, served, _ = _drive(
+            f"{ra.unix_path},{rb.unix_path}", mix, N_CLIENTS,
+            CHAOS_ROUNDS, chaos=chaos, chaos_at_s=KILL_AFTER_S)
+        with CheckClient(rb.unix_path, timeout_s=30.0) as c:
+            stats = c.stats()["stats"]
+        lease = stats.get("lease") or {}
+        events = [e for e in load_events(rb.trace_log)
+                  if e.get("name") == "router.takeover"]
+        at = (events[0].get("attrs") or {}) if events else {}
+    finally:
+        ra.stop()
+        rb.stop()
+        for n in nodes:
+            n.stop()
+    row = _row("kill_router", 3, wall, lat, errors, wrong, served,
+               stats)
+    # the TTL gate: the takeover window is expiry (TTL) + grace
+    # (0.5*TTL) + one beat (TTL/3) + scheduling slack on a loaded
+    # 1-core host — the holder flip must land inside 2*TTL total,
+    # i.e. within ONE further TTL of the lease expiring
+    row.update({
+        "killed_router": "rA",
+        "lease_ttl_s": LEASE_TTL_S,
+        "takeover_observed_s": takeover_s[0],
+        "takeover_within_ttl": bool(
+            takeover_s[0] is not None
+            and takeover_s[0] <= 2 * LEASE_TTL_S),
+        "standby_promoted": lease.get("role") == "active"
+        and lease.get("term", 0) >= 2,
+        "standby_takeovers": lease.get("takeovers", 0),
+        "takeover_span_in_trace": bool(events),
+        "takeover_span_superseded_term": at.get("superseded_term"),
+        "verdicts_bit_identical": not wrong and not errors,
+    })
+    return row
+
+
+def bench_wedge_router(mix, run_dir: str) -> dict:
+    """SIGSTOP the active router (alive, renews nothing): the lease
+    expires, the standby promotes, the mix completes — and after
+    SIGCONT the stale-term router answers SHED ``router_superseded``,
+    never a verdict: the split-brain pin, live."""
+    from qsm_tpu.serve.client import CheckClient
+
+    nodes, ra, rb = _ha_pair(run_dir, "wedge_router",
+                             trace_standby=False)
+    try:
+        wall, lat, errors, wrong, served, _ = _drive(
+            f"{ra.unix_path},{rb.unix_path}", mix, N_CLIENTS,
+            CHAOS_ROUNDS, chaos=ra.sigstop, chaos_at_s=KILL_AFTER_S)
+        with CheckClient(rb.unix_path, timeout_s=30.0) as c:
+            stats = c.stats()["stats"]
+        lease = stats.get("lease") or {}
+        # wake the frozen active: its term is long gone — the stale
+        # router must refuse with router_superseded, never answer
+        ra.sigcont()
+        req = mix[0]
+        with CheckClient(ra.unix_path, timeout_s=30.0) as c:
+            stale = c.check(req["model"], req["rows"])
+    finally:
+        ra.stop()
+        rb.stop()
+        for n in nodes:
+            n.stop()
+    row = _row("wedge_router", 3, wall, lat, errors, wrong, served,
+               stats)
+    row.update({
+        "wedged_router": "rA",
+        "standby_promoted": lease.get("role") == "active"
+        and lease.get("term", 0) >= 2,
+        "stale_router_shed_superseded": bool(
+            stale.get("shed")
+            and stale.get("reason") == "router_superseded"
+            and not stale.get("ok")),
+        "stale_router_block": stale.get("router"),
+        "verdicts_bit_identical": not wrong and not errors,
+    })
+    return row
+
+
+def bench_gossip_router_dead(mix, run_dir: str) -> dict:
+    """Bank the mix through a router, then STOP every router: node-to-
+    node gossip alone must converge the replogs within a bounded
+    number of beats (coverage fixed point — see the inline note)."""
+    cell_dir = os.path.join(run_dir, "gossip_dead")
+    os.makedirs(cell_dir, exist_ok=True)
+    nodes = [Node(f"n{i}", cell_dir, seal_rows=1).spawn()
+             for i in range(3)]
+    from qsm_tpu.fleet.router import FleetRouter
+    from qsm_tpu.resilience.policy import preset
+
+    router = FleetRouter(
+        [(n.nid, n.unix_path) for n in nodes],
+        policy=preset("fleet-route").with_(timeout_s=10.0),
+        probe_policy=preset("fleet-probe").with_(timeout_s=1.0),
+        heartbeat_s=0.3, anti_entropy_s=0.0).start()
+    try:
+        wall, lat, errors, wrong, served, _ = _drive(
+            router, mix, N_CLIENTS, 1)
+        router.stop()  # every router DEAD from here on
+        router = None
+        _wire_gossip(nodes)  # beats start now, router already gone
+        # the gossip fixed point is COVERAGE, not held-set equality:
+        # with row-level subsumption, a node whose live set already
+        # holds a segment's rows records it covered and never holds
+        # it — so "every segment in the fleet union is held-or-
+        # covered by every node" is convergence (duplicate banking of
+        # one key on two nodes — a backpressure hop mid-drive — makes
+        # strict digest equality unreachable BY DESIGN)
+        t0 = time.monotonic()
+        deadline = t0 + 60.0
+        converged = False
+        union = set()
+        while time.monotonic() < deadline and not converged:
+            time.sleep(GOSSIP_BEAT_S)
+            docs = [_send_op(n.unix_path, {"op": "replog.digests"})
+                    for n in nodes]
+            if not all(d.get("ok") for d in docs):
+                continue
+            union = set().union(*[set(d.get("digests") or {})
+                                  for d in docs])
+            converged = bool(union) and all(
+                union <= (set(d.get("digests") or {})
+                          | set(d.get("absorbed") or {}))
+                for d in docs)
+        elapsed = time.monotonic() - t0
+        beats = max(1, int(elapsed / GOSSIP_BEAT_S + 0.999))
+        gsnaps = [
+            _send_op(n.unix_path,
+                     {"op": "stats"})["stats"].get("gossip") or {}
+            for n in nodes]
+    finally:
+        if router is not None:
+            router.stop()
+        for n in nodes:
+            n.stop()
+    return {
+        "nodes": 3, "clients": N_CLIENTS,
+        "histories": served, "errors": len(errors),
+        "wrong_verdicts": len(wrong),
+        "gossip_beat_s": GOSSIP_BEAT_S,
+        "router_alive_during_convergence": False,
+        "converged": converged,
+        "converged_s": round(elapsed, 2),
+        "converged_beats": beats,
+        "converged_segments": len(union),
+        "segments_pulled": sum(g.get("segments_pulled", 0)
+                               for g in gsnaps),
+        "segments_pushed": sum(g.get("segments_pushed", 0)
+                               for g in gsnaps),
+        "segments_subsumed": sum(g.get("segments_subsumed", 0)
+                                 for g in gsnaps),
+        "peer_faults": sum(g.get("peer_faults", 0) for g in gsnaps),
+    }
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -539,13 +859,15 @@ def run(tag: str, out_path, resume: bool) -> int:
         "mix": "cas check x6 + kv pcomp x2 + multireg pcomp x2 + "
                "cas shrink x2",
         "clients": N_CLIENTS, "rounds": ROUNDS,
+        "lease_ttl_s": LEASE_TTL_S, "gossip_beat_s": GOSSIP_BEAT_S,
         "host_cores": os.cpu_count(),
         "captured_iso": datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds"),
     }
     journal = CellJournal(path, header, resume=resume)
     todo = ["fleet_n1", "fleet_n2", "fleet_n3", "kill_node",
-            "wedge_node", "partition", "rolling_restart"]
+            "wedge_node", "partition", "rolling_restart",
+            "kill_router", "wedge_router", "gossip_router_dead"]
     mix = None
     if any(journal.complete(k) is None for k in todo):
         mix = _build_mix()
@@ -564,6 +886,15 @@ def run(tag: str, out_path, resume: bool) -> int:
         if journal.complete("rolling_restart") is None:
             journal.emit("rolling_restart",
                          bench_rolling_restart(mix, run_dir))
+        if journal.complete("kill_router") is None:
+            journal.emit("kill_router",
+                         bench_kill_router(mix, run_dir))
+        if journal.complete("wedge_router") is None:
+            journal.emit("wedge_router",
+                         bench_wedge_router(mix, run_dir))
+        if journal.complete("gossip_router_dead") is None:
+            journal.emit("gossip_router_dead",
+                         bench_gossip_router_dead(mix, run_dir))
 
     n1 = journal.complete("fleet_n1")
     n3 = journal.complete("fleet_n3")
@@ -571,6 +902,9 @@ def run(tag: str, out_path, resume: bool) -> int:
     wedge = journal.complete("wedge_node")
     part = journal.complete("partition")
     roll = journal.complete("rolling_restart")
+    rkill = journal.complete("kill_router")
+    rwedge = journal.complete("wedge_router")
+    gdead = journal.complete("gossip_router_dead")
     rows = [journal.complete(k) for k in todo]
     wrong_total = sum(r.get("wrong_verdicts", 0) for r in rows) \
         + roll.get("phase_b_wrong", 0)
@@ -614,6 +948,19 @@ def run(tag: str, out_path, resume: bool) -> int:
             roll.get("zero_lost_banked_verdicts")),
         "rolling_restart_shrink_bit_equal": bool(
             roll.get("shrink_results_bit_equal")),
+        # the r13 de-SPOF gates (ISSUE 13): router HA + gossip
+        "kill_router_survived": bool(
+            rkill.get("verdicts_bit_identical")),
+        "kill_router_takeover_within_ttl": bool(
+            rkill.get("takeover_within_ttl")),
+        "kill_router_takeover_span": bool(
+            rkill.get("takeover_span_in_trace")),
+        "wedge_router_survived": bool(
+            rwedge.get("verdicts_bit_identical")),
+        "split_brain_refused": bool(
+            rwedge.get("stale_router_shed_superseded")),
+        "gossip_converged_router_dead": bool(gdead.get("converged")),
+        "gossip_converged_beats": gdead.get("converged_beats"),
         "resumed_cells": journal.resumed_cells,
         "artifact": os.path.basename(path),
     }
@@ -629,6 +976,12 @@ def run(tag: str, out_path, resume: bool) -> int:
           and summary["wedge_detected"]
           and summary["partition_survived"]
           and summary["rolling_restart_zero_lost"]
+          and summary["kill_router_survived"]
+          and summary["kill_router_takeover_within_ttl"]
+          and summary["kill_router_takeover_span"]
+          and summary["wedge_router_survived"]
+          and summary["split_brain_refused"]
+          and summary["gossip_converged_router_dead"]
           and (summary["gate_2x_at_3_nodes"]
                or summary["gate_waived_insufficient_cores"]))
     return 0 if ok else 1
@@ -636,7 +989,7 @@ def run(tag: str, out_path, resume: bool) -> int:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--tag", default="r12")
+    ap.add_argument("--tag", default="r13")
     ap.add_argument("--out", default=None)
     ap.add_argument("--resume", action="store_true",
                     help="adopt completed cells from a prior journal "

@@ -80,7 +80,7 @@ CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 # interprocedural race analyzer), independent of the window artifacts'
 # ROUND_TAG — renaming those retires banked measurements, renaming this
 # just says which rule set produced the findings.
-LINT_ROUND = "r12"  # family (j) — fleet re-dispatch discipline — r12
+LINT_ROUND = "r13"  # family (j) + QSM-FLEET-LEASE (router HA) — r13
 LINT_ARTIFACT = os.path.join(REPO, f"LINT_{LINT_ROUND}.json")
 
 # Committed archive of the P-compositionality bench (tools/
@@ -123,10 +123,11 @@ _OBS_STATE: dict = {"attempted": False}
 # traffic mix with kill/wedge/partition/rolling-restart chaos cells —
 # refreshed off-window on CellJournal --resume rails.  Tracks its own
 # round tag (the fleet tier landed in r12).
-FLEET_ROUND = "r12"
+FLEET_ROUND = "r13"
 FLEET_ARTIFACT = os.path.join(REPO, f"BENCH_FLEET_{FLEET_ROUND}.json")
-# full scan = 3 scaling cells + 4 chaos cells + summary
-FLEET_MIN_ROWS = 8
+# full scan = 3 scaling cells + 4 node-chaos cells + 3 router-HA/
+# gossip cells (r13) + summary
+FLEET_MIN_ROWS = 11
 _FLEET_STATE: dict = {"attempted": False}
 
 # Cached verdict of the pre-seize lint gate, keyed on a SOURCE
