@@ -1,8 +1,8 @@
 # qsm_tpu CI/tooling entry points.
 #
 # `lint-gate` is the static-analysis gate: it runs every registered
-# qsmlint pass family (a–j, docs/ANALYSIS.md) over the full tree,
-# archives the JSON findings document to LINT_r12.json (the artifact
+# qsmlint pass family (a–k, docs/ANALYSIS.md) over the full tree,
+# archives the JSON findings document to LINT_r14.json (the artifact
 # probe_watcher also refreshes before every window seize) and FAILS
 # (exit 1) on any non-whitelisted error-severity finding.  The on-disk
 # result cache (.qsmlint-cache.json) keeps a warm full-tree run in the
@@ -11,7 +11,7 @@
 PYTHON ?= python
 # keep in lockstep with tools/probe_watcher.py LINT_ROUND (the watcher
 # archives the same document before every window seize)
-LINT_ARTIFACT ?= LINT_r13.json
+LINT_ARTIFACT ?= LINT_r14.json
 
 # P-compositionality bench (tools/bench_pcomp.py): host-only — no TPU
 # window needed — on CellJournal --resume rails; refreshes the
@@ -40,8 +40,15 @@ OBS_ARTIFACT ?= BENCH_OBS_r11.json
 # and router-dead gossip convergence; docs/SERVING.md "Fleet")
 FLEET_ARTIFACT ?= BENCH_FLEET_r13.json
 
+# Monitor bench (tools/bench_monitor.py): host-only, CellJournal
+# --resume rails; refreshes the committed BENCH_MONITOR artifact
+# (streamed vs re-check-from-scratch on a growing 1k-event stream,
+# decided-prefix bank resume, flip-to-push latency, streamed-vs-oneshot
+# parity soak at zero wrong verdicts; docs/MONITOR.md)
+MONITOR_ARTIFACT ?= BENCH_MONITOR_r14.json
+
 .PHONY: lint-gate lint-changed lint-sarif test bench-pcomp \
-	bench-shrink bench-obs bench-fleet bench-report
+	bench-shrink bench-obs bench-fleet bench-monitor bench-report
 
 lint-gate:
 	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT)
@@ -68,6 +75,10 @@ bench-obs:
 bench-fleet:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_fleet.py \
 		--out $(FLEET_ARTIFACT) --resume
+
+bench-monitor:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_monitor.py \
+		--out $(MONITOR_ARTIFACT) --resume
 
 # Aggregate every committed BENCH_*.json into one per-round trend
 # table (BENCH_REPORT.md + BENCH_REPORT.json, atomic + deterministic)

@@ -36,6 +36,15 @@ Layers (mirroring SURVEY.md §1, redesigned TPU-first):
   (split-brain-safe term takeover), node-to-node gossip replication,
   and row-level segment subsumption (docs/SERVING.md "Fleet" /
   "Router HA")
+* ``qsm_tpu.monitor``  — the streaming monitor plane: per-session
+  incremental quiescent-cut frontiers deciding a live event stream
+  the moment each prefix is decidable, decided prefixes banked in
+  the verdict cache under rolling prefix fingerprints (restarts
+  resume from the bank), flips pushed with shrink-plane-minimized
+  repros (docs/MONITOR.md)
+* ``qsm_tpu.ingest``   — foreign trace ingest: Jepsen/Knossos- and
+  porcupine-style event logs as first-class corpora (byte-stable
+  round trips) plus the live log tailer behind ``qsm-tpu monitor``
 * ``qsm_tpu.utils``    — config, structured logging, CLI
 """
 

@@ -106,6 +106,10 @@ DEFAULT_RACE_FILES = (
     "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/membership.py",
     "qsm_tpu/fleet/replog.py", "qsm_tpu/fleet/lease.py",
     "qsm_tpu/fleet/gossip.py",
+    # the monitor plane: session objects are driven from connection
+    # threads while the manager's totals and the router's journals are
+    # read from stats/replay paths — same closed program
+    "qsm_tpu/monitor/frontier.py", "qsm_tpu/monitor/session.py",
     "tools/bench_serve.py", "tools/bench_pcomp.py",
     "tools/bench_shrink.py", "tools/bench_fleet.py",
     "tools/probe_watcher.py", "tools/soak_prune.py")
@@ -123,6 +127,15 @@ DEFAULT_FLEET_FILES = (
     "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/membership.py",
     "qsm_tpu/fleet/replog.py", "qsm_tpu/fleet/lease.py",
     "qsm_tpu/fleet/gossip.py", "tools/bench_fleet.py")
+
+# the monitor-plane modules the session-bound pass covers (family k):
+# the streaming sessions + frontiers, the ingest adapters that feed
+# them, and the monitor bench driver
+DEFAULT_MONITOR_FILES = (
+    "qsm_tpu/monitor/frontier.py", "qsm_tpu/monitor/session.py",
+    "qsm_tpu/ingest/adapters.py", "qsm_tpu/ingest/edn.py",
+    "qsm_tpu/ingest/specmap.py", "qsm_tpu/ingest/tail.py",
+    "tools/bench_monitor.py")
 
 # the trace-plane discipline beat (family i): everything that opens
 # spans or writes metrics — the obs plane itself, the serving stack
@@ -315,6 +328,12 @@ def _per_file_fleet(path: str, root: str) -> List[Finding]:
     return check_fleet_file(path, root=root)
 
 
+def _per_file_monitor(path: str, root: str) -> List[Finding]:
+    from .monitor_passes import check_monitor_file
+
+    return check_monitor_file(path, root=root)
+
+
 FAMILIES: Dict[str, Family] = {f.fid: f for f in (
     Family(fid="a", key="spec",
            title="spec soundness (parity, domains, bounds, dtypes, "
@@ -383,6 +402,12 @@ FAMILIES: Dict[str, Family] = {f.fid: f for f in (
                  "promotion)",
            files=DEFAULT_FLEET_FILES, per_file=_per_file_fleet,
            triggers=("qsm_tpu/analysis/fleet_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+    Family(fid="k", key="monitor",
+           title="monitor-session bounds (capped buffers, "
+                 "decided-prefix eviction)",
+           files=DEFAULT_MONITOR_FILES, per_file=_per_file_monitor,
+           triggers=("qsm_tpu/analysis/monitor_passes.py",
                      "qsm_tpu/analysis/astutil.py")),
 )}
 

@@ -662,3 +662,45 @@ class LeasedTakeoverRouterStub:
         if got is not None:
             self.term = got["term"]  # the term rides every response
         return got
+
+
+class UnboundedSessionBufferStub:
+    """Seeded bug for the monitor passes (family k): a session object
+    whose event buffer grows on every append with NO cap comparison and
+    NO eviction anywhere in the class (QSM-MON-UNBOUNDED — a long-lived
+    production monitor accumulates it until the serving plane OOMs).
+    Never executed; tests point the monitor AST pass at this file and
+    assert the rule fires for exactly this class."""
+
+    def __init__(self):
+        self.events = []
+        self.window = []
+
+    def append(self, event):
+        self.events.append(event)        # <-- bug: no cap, no eviction
+        self.window.append(event)        # <-- bug: window never evicts
+
+    def verdict(self):
+        return 1 if self.window else 1
+
+
+class BoundedSessionBufferStub:
+    """The sanctioned twins the monitor pass must NOT flag: a capped
+    event log (the session.py ``max_events`` shape) and a window whose
+    decided prefix is EVICTED by pruning reassignment (the frontier.py
+    shape) — must stay CLEAN under QSM-MON-UNBOUNDED."""
+
+    MAX_EVENTS = 65_536
+
+    def __init__(self):
+        self.events = []
+        self.window = []
+
+    def append(self, event):
+        if len(self.events) >= self.MAX_EVENTS:   # explicit cap
+            raise RuntimeError("session event cap reached")
+        self.events.append(event)
+        self.window.append(event)
+
+    def commit_prefix(self, cut):
+        self.window = self.window[cut:]           # decided-prefix evict

@@ -1,0 +1,152 @@
+"""Monitor passes (pass family *k* of docs/ANALYSIS.md): session bounds.
+
+A monitor session is a LONG-LIVED accumulator by design — events arrive
+for as long as the monitored system runs — so the plane's one structural
+promise is that nothing it accumulates is unbounded: event logs are
+capped, frontier state sets are capped, and committed-prefix ops are
+EVICTED from the windows (qsm_tpu/monitor module docstrings).  A session
+buffer grown without a cap or eviction is the failure mode that turns
+one quiet production monitor into an OOM of the whole serving plane a
+week later.
+
+* ``QSM-MON-UNBOUNDED`` (error) — a class whose instance-attribute
+  container GROWS (``self.X.append/extend/add/insert``, or
+  ``heapq.heappush(self.X, …)``) while NOTHING in the class either
+  compares against a bound wherever that attribute is involved (a cap
+  check like ``len(self.X) >= self.max_events``) or evicts from it
+  (``self.X.pop/popitem/clear``, ``heappop(self.X)``, ``del
+  self.X[…]``, or a pruning reassignment ``self.X = self.X[cut:]`` —
+  the decided-prefix eviction shape).  Scope is the CLASS: the grow
+  site and its discipline legitimately live in different methods
+  (``append`` checks the cap, ``_apply`` grows).
+
+Scan set: qsm_tpu/monitor/ + qsm_tpu/ingest/ + tools/bench_monitor.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from .astutil import attr_chain, parse_module
+from .findings import ERROR, Finding
+
+_GROW_CALLS = {"append", "extend", "add", "insert"}
+_EVICT_CALLS = {"pop", "popitem", "clear", "popleft"}
+_HEAP_GROW = {"heappush"}
+_HEAP_EVICT = {"heappop"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` (or deeper ``self.X.y`` → ``X``) attribute name."""
+    chain = attr_chain(node)
+    if chain and len(chain) >= 2 and chain[0] == "self":
+        return chain[1]
+    return None
+
+
+def _mentions_attr(node: ast.AST, attr: str) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and _self_attr(sub) == attr:
+            return True
+    return False
+
+
+class _ClassScan:
+    __slots__ = ("grows", "disciplined")
+
+    def __init__(self):
+        # attr -> (method name, lineno, how) of the first grow site
+        self.grows: Dict[str, tuple] = {}
+        self.disciplined: Set[str] = set()
+
+
+def _scan_class(cls: ast.ClassDef) -> _ClassScan:
+    out = _ClassScan()
+    owner: dict = {}
+    for fn in ast.walk(cls):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(fn):
+                owner[id(sub)] = fn
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if not chain:
+                continue
+            tail = chain[-1]
+            # self.X.append(...) / self.X.pop(...)
+            if isinstance(node.func, ast.Attribute):
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    if tail in _GROW_CALLS and attr not in out.grows:
+                        fn = owner.get(id(node))
+                        out.grows[attr] = (
+                            fn.name if fn else "<class>", node.lineno,
+                            f".{tail}()")
+                    if tail in _EVICT_CALLS:
+                        out.disciplined.add(attr)
+            # heapq.heappush(self.X, ...) / heappop(self.X)
+            if tail in _HEAP_GROW | _HEAP_EVICT and node.args:
+                attr = _self_attr(node.args[0])
+                if attr is not None:
+                    if tail in _HEAP_GROW and attr not in out.grows:
+                        fn = owner.get(id(node))
+                        out.grows[attr] = (
+                            fn.name if fn else "<class>", node.lineno,
+                            f"{tail}()")
+                    if tail in _HEAP_EVICT:
+                        out.disciplined.add(attr)
+        elif isinstance(node, ast.Compare):
+            # a cap check anywhere in the class that INVOLVES the attr
+            # (len(self.X) >= cap, len(self.X) + n > self.max_events)
+            for sub in ast.walk(node):
+                a = (_self_attr(sub)
+                     if isinstance(sub, ast.Attribute) else None)
+                if a is not None:
+                    out.disciplined.add(a)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    a = _self_attr(tgt.value)
+                    if a is not None:
+                        out.disciplined.add(a)
+        elif isinstance(node, ast.Assign):
+            # pruning reassignment: self.X = <expr mentioning self.X>
+            for tgt in node.targets:
+                a = (_self_attr(tgt)
+                     if isinstance(tgt, ast.Attribute) else None)
+                if a is not None and _mentions_attr(node.value, a):
+                    out.disciplined.add(a)
+    return out
+
+
+def check_monitor_file(path: str, root: Optional[str] = None
+                       ) -> List[Finding]:
+    tree = parse_module(path)
+    relpath = path
+    if root:
+        try:
+            relpath = os.path.relpath(path, root)
+        except ValueError:
+            pass
+    out: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        scan = _scan_class(cls)
+        for attr, (fn_name, lineno, how) in sorted(scan.grows.items()):
+            if attr in scan.disciplined:
+                continue
+            out.append(Finding(
+                ERROR, "QSM-MON-UNBOUNDED",
+                f"{relpath}:{cls.name}.{fn_name}:{lineno}",
+                f"session buffer self.{attr} grows ({how}) with no cap "
+                "comparison or eviction anywhere in the class — a "
+                "long-lived monitor session accumulates it until the "
+                "serving plane OOMs",
+                "compare its size against an explicit bound before "
+                "growing (session.py max_events is the model) or evict "
+                "decided-prefix entries (frontier.py's window "
+                "reassignment)"))
+    return out

@@ -264,6 +264,24 @@ class _GroupResult:
         self.sheds = 0
 
 
+class _RoutedSession:
+    """One monitor session's router-side journal: the event stream the
+    failover replay re-feeds (bounded by the router's event cap) plus
+    the node currently owning the live session."""
+
+    __slots__ = ("sid", "model", "spec_kwargs", "events", "node",
+                 "lock", "last_used")
+
+    def __init__(self, sid: str, model: str, spec_kwargs: dict):
+        self.sid = sid
+        self.model = model
+        self.spec_kwargs = spec_kwargs or {}
+        self.events: List = []
+        self.node: Optional[str] = None
+        self.lock = threading.Lock()
+        self.last_used = time.monotonic()  # idle-eviction clock
+
+
 class FleetRouter:
     """See module docstring.  ``nodes`` is ``[(node_id, address),
     ...]``; ``start()`` binds and returns like ``CheckServer``."""
@@ -341,6 +359,26 @@ class FleetRouter:
         self.ae_segments_shipped = 0
         self.ae_segments_subsumed = 0  # ships skipped: rows already held
         self.ae_rows_shipped = 0
+        # monitor sessions (qsm_tpu/monitor): the router journals each
+        # session's event stream (bounded) and routes its ops by the
+        # session key; a node lost mid-session is excluded and the
+        # journal REPLAYED onto the next ring node — which resumes from
+        # the decided prefixes banked under prefix fingerprints (a
+        # respawned node reloads them from its replog), so failover
+        # costs bank hits, not re-searches (docs/MONITOR.md "Fleet").
+        self._sessions_lock = threading.Lock()
+        self._sessions: Dict[str, _RoutedSession] = {}
+        self.max_sessions = 1024
+        self.session_event_cap = 65_536   # per-session journal bound
+        # a client that crashed without closing must not pin a journal
+        # forever: at the cap, journals idle past this are reclaimed
+        # (the node-side session evicts on its own clock; a returning
+        # client re-opens and replays by seq)
+        self.session_idle_s = 3600.0
+        self.session_requests = 0
+        self.session_replays = 0          # journals replayed onto a node
+        self.session_evicted = 0          # idle journals reclaimed at cap
+        self._session_n = 0
         # router HA (fleet/lease.py; module docstring).  Without a
         # lease the router is unconditionally active — the single-
         # router deployment is byte-identical to PR 12.
@@ -506,9 +544,12 @@ class FleetRouter:
             doc = {**doc, "term": self.term}
         send_doc(conn, doc)
 
+    _SESSION_OPS = ("session.open", "session.append", "session.close")
+
     def _handle(self, conn: socket.socket, req: dict) -> None:
         op = req.get("op", "check")
-        if op in ("check", "shrink") and not self._active_now():
+        if op in ("check", "shrink") + self._SESSION_OPS \
+                and not self._active_now():
             # a non-active (or expired-term) router must never answer
             # a verdict: SHED with the router block, client hops on
             trace = str(req.get("trace") or "") or new_trace_id()
@@ -533,6 +574,15 @@ class FleetRouter:
                 raise
             except Exception as e:  # noqa: BLE001 — answer, don't die
                 self._send(conn, {"id": req.get("id"), "ok": False,
+                                  "error": f"{type(e).__name__}: {e}"})
+        elif op in self._SESSION_OPS:
+            try:
+                self._handle_session(conn, op, req)
+            except OSError:
+                raise
+            except Exception as e:  # noqa: BLE001 — answer, don't die
+                self._send(conn, {"id": req.get("id"), "ok": False,
+                                  "session": req.get("session"),
                                   "error": f"{type(e).__name__}: {e}"})
         else:
             self._send(conn, {"ok": False,
@@ -1001,6 +1051,224 @@ class FleetRouter:
             doc["node_faults"] = faults
         return doc
 
+    # -- monitor sessions (qsm_tpu/monitor; docs/MONITOR.md "Fleet") ---
+    def _handle_session(self, conn: socket.socket, op: str,
+                        req: dict) -> None:
+        """Route one session verb by the session key.  The router
+        journals every event it forwards; a node lost mid-session
+        (death/wedge/partition) is excluded and the journal replayed
+        onto the next ring node — re-open + seq-0 re-append, idempotent
+        on both legs, with the decided-prefix bank absorbing the
+        engine cost (a respawned node's replog serves the prefixes).
+        SHED semantics match ``check``: queue-full, caps and an
+        exhausted fleet answer SHED, never a wrong or partial verdict."""
+        from ..models.registry import MODELS
+
+        t_req = time.perf_counter()
+        trace = str(req.get("trace") or "") or new_trace_id()
+        root = ""
+        if self.obs.on:
+            root = new_span_id()
+            self.obs.tracer.emit("route.request", trace=trace,
+                                 span=root, op=op,
+                                 session=req.get("session"))
+        with self._lock:
+            self.requests += 1
+            self.session_requests += 1
+        if op == "session.open":
+            model = req.get("model")
+            if model not in MODELS:
+                self._send(conn, {"id": req.get("id"), "ok": False,
+                                  "trace": trace,
+                                  "error": f"unknown model {model!r}; "
+                                           f"one of {sorted(MODELS)}"})
+                return
+            sid = req.get("session")
+            with self._sessions_lock:
+                if sid is not None and str(sid) in self._sessions:
+                    sess = self._sessions[str(sid)]
+                    if sess.model != model:
+                        self._send(conn, {
+                            "id": req.get("id"), "ok": False,
+                            "trace": trace,
+                            "error": f"session {sid} is open against "
+                                     f"{sess.model!r}"})
+                        return
+                else:
+                    if len(self._sessions) >= self.max_sessions:
+                        now = time.monotonic()
+                        for stale in [k for k, v in
+                                      self._sessions.items()
+                                      if now - v.last_used
+                                      >= self.session_idle_s]:
+                            self._sessions.pop(stale)
+                            self.session_evicted += 1
+                    if len(self._sessions) >= self.max_sessions:
+                        self._respond(conn, self._shed(
+                            req, "session cap", trace, root), trace,
+                            root, t_req)
+                        return
+                    if sid is None:
+                        self._session_n += 1
+                        sid = f"{self.node_id}-s{self._session_n:06d}"
+                    sess = _RoutedSession(str(sid), model,
+                                          req.get("spec_kwargs") or {})
+                    self._sessions[sess.sid] = sess
+        else:
+            sid = str(req.get("session") or "")
+            with self._sessions_lock:
+                sess = self._sessions.get(sid)
+            if sess is None:
+                self._send(conn, {"id": req.get("id"), "ok": False,
+                                  "session": sid, "trace": trace,
+                                  "error": f"unknown session {sid!r}"})
+                return
+        if not self.admission.try_admit(1):
+            self._respond(conn, {**self._shed(req, "queue full", trace,
+                                              root), "session":
+                                 sess.sid}, trace, root, t_req)
+            return
+        try:
+            from ..monitor import SessionLimit
+
+            sess.last_used = time.monotonic()
+            deadline = self.admission.deadline_for(req.get("deadline_s"))
+            try:
+                with sess.lock:
+                    doc = self._route_session(sess, op, req, deadline,
+                                              trace, root)
+            except SessionLimit as e:
+                doc = {**self._shed(req, str(e), trace, root),
+                       "session": sess.sid}
+            if doc is None:
+                doc = {**self._shed(req, "fleet exhausted", trace,
+                                    root), "session": sess.sid}
+            elif op == "session.close" and doc.get("ok"):
+                with self._sessions_lock:
+                    self._sessions.pop(sess.sid, None)
+            self._respond(conn, doc, trace, root, t_req,
+                          status="shed" if doc.get("shed") else "ok")
+        finally:
+            self.admission.release(1)
+
+    def _route_session(self, sess: _RoutedSession, op: str, req: dict,
+                       deadline: float, trace: str, root: str
+                       ) -> Optional[dict]:
+        """One session verb under bounded exclude-and-replay failover;
+        None = no node could take it (the caller sheds)."""
+        subreq = {**req, "session": sess.sid, "trace": trace}
+        if op == "session.append":
+            events = req.get("events")
+            if not isinstance(events, list) or not events:
+                raise ValueError("session.append needs a non-empty "
+                                 "'events' array")
+            seq = req.get("seq")
+            start = int(seq) if seq is not None else len(sess.events)
+            if start > len(sess.events):
+                raise ValueError(
+                    f"session {sess.sid}: append seq {start} leaves a "
+                    f"gap (journal holds {len(sess.events)})")
+            fresh = events[max(0, len(sess.events) - start):]
+            if len(sess.events) + len(fresh) > self.session_event_cap:
+                from ..monitor import SessionLimit
+
+                raise SessionLimit(
+                    f"session {sess.sid}: router journal cap "
+                    f"{self.session_event_cap} reached")
+            sess.events.extend(fresh)
+            # the forwarded append is ALWAYS seq-stamped with the
+            # batch's journal position: a seq-less client's events
+            # were just replayed inside the journal (a fresh/restarted
+            # owner), and forwarding them unframed would apply them a
+            # second time and desync the node's stream counter
+            subreq["seq"] = start
+        if op == "session.open":
+            subreq.setdefault("spec_kwargs", sess.spec_kwargs)
+        key = f"session:{sess.sid}"
+        tried: Set[str] = set()
+        target = sess.node if sess.node is not None \
+            and sess.node in self.membership.routable_ids() \
+            else self.membership.node_for(key)
+        faults = 0
+        for _attempt in range(max(1, self.policy.attempts)):
+            if target is None or self._stop.is_set():
+                return None
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            tried.add(target)
+            timeout_s = min(self.policy.timeout_s or 30.0, remaining)
+            self.obs.event("node.dispatch", trace=trace, parent=root,
+                           node=target, op=op, session=sess.sid,
+                           traces=[trace])
+            try:
+                if target != sess.node:
+                    # a fresh owner (first dispatch, or post-failover):
+                    # re-establish the session there — open + full
+                    # journal replay, both idempotent (seq framing; a
+                    # respawned node resumes from its banked prefixes)
+                    self._replay_session(sess, target, timeout_s,
+                                         trace, root)
+                resp = self.links[target].request(subreq, timeout_s)
+            except NodeBusy:
+                target = self._hop_busy(key, target, tried, trace,
+                                        root)
+                continue
+            except _LINK_FAULTS as e:
+                faults += 1
+                sess.node = None
+                target = self._shed_node(key, target, tried, e, trace,
+                                         root)
+                continue
+            if resp.get("unknown_session"):
+                # the node restarted and lost the live object (its
+                # answer is machine-readable by contract): force a
+                # journal replay onto it on the next attempt — NOT a
+                # health fault, the node is up and answering
+                self.membership.note_success(target)
+                sess.node = None
+                continue
+            if resp.get("ok") or resp.get("shed"):
+                self.membership.note_success(target)
+                sess.node = target if resp.get("ok") else sess.node
+                doc = {**resp, "id": req.get("id"), "trace": trace}
+                if faults:
+                    doc["node_faults"] = faults
+                return doc
+            # a clean error (bad events reach every node the same way):
+            # surface it — re-dispatch cannot help
+            return {**resp, "id": req.get("id"), "trace": trace}
+        return None
+
+    def _replay_session(self, sess: _RoutedSession, target: str,
+                        timeout_s: float, trace: str, root: str
+                        ) -> None:
+        """Re-establish a journaled session on ``target`` (link faults
+        propagate to the caller's failover loop)."""
+        link = self.links[target]
+        opened = link.request({"op": "session.open", "id": "fleet-sub",
+                               "model": sess.model,
+                               "spec_kwargs": sess.spec_kwargs,
+                               "session": sess.sid, "trace": trace},
+                              timeout_s)
+        if not opened.get("ok"):
+            raise NodeFault(f"node {target}: session.open refused: "
+                            f"{opened.get('error') or opened}")
+        if sess.events:
+            with self._lock:
+                self.session_replays += 1
+            self.obs.event("session.replay", trace=trace, parent=root,
+                           session=sess.sid, node=target,
+                           events=len(sess.events))
+            replayed = link.request(
+                {"op": "session.append", "id": "fleet-sub",
+                 "session": sess.sid, "seq": 0,
+                 "events": sess.events, "trace": trace}, timeout_s)
+            if not replayed.get("ok"):
+                raise NodeFault(
+                    f"node {target}: session replay refused: "
+                    f"{replayed.get('error') or replayed}")
+
     # -- shed / respond ------------------------------------------------
     def _shed(self, req: dict, reason: str, trace: str = "",
               parent: str = "") -> dict:
@@ -1343,6 +1611,15 @@ class FleetRouter:
                 "ladder_batches": self.ladder_batches,
                 "ladder_lanes": self.ladder_lanes,
             }
+            with self._sessions_lock:
+                sessions = {
+                    "live": len(self._sessions),
+                    "requests": self.session_requests,
+                    "replays": self.session_replays,
+                    "evicted": self.session_evicted,
+                    "max_sessions": self.max_sessions,
+                    "event_cap": self.session_event_cap,
+                }
             ae = {"sweeps": self.ae_sweeps,
                   "segments_shipped": self.ae_segments_shipped,
                   "segments_subsumed": self.ae_segments_subsumed,
@@ -1371,6 +1648,9 @@ class FleetRouter:
             "uptime_s": round(time.monotonic() - self._t0, 1),
             "lease": lease,
             **counters,
+            # routed monitor sessions: live journals, replays performed
+            # on failover, and the journal bounds (docs/MONITOR.md)
+            "session": sessions,
             "policy": self.policy.name,
             "admission": self.admission.snapshot(),
             "membership": self.membership.snapshot(),

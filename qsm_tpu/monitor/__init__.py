@@ -1,0 +1,25 @@
+"""qsm_tpu.monitor — linearizability monitoring as a live service.
+
+The streaming plane (docs/MONITOR.md): sessions accumulate a running
+system's invocation/response events, an incremental frontier
+(``frontier.py`` — the forward-running twin of ops/segdc.py's
+quiescent-cut algebra) decides each prefix the moment it is decidable,
+decided prefixes bank in the serve verdict cache under rolling prefix
+fingerprints, and a verdict flip is pushed with a shrink-plane-minimized
+repro.  ``session.py`` owns event validation/ordering and the bounded
+session registry; serve/server.py speaks the ``session.*`` protocol ops
+over it and fleet/router.py routes + replays sessions across nodes.
+"""
+
+from .frontier import (IncrementalFrontier, PrefixHasher,
+                       decode_frontier_states, encode_frontier_states)
+from .session import (DEFAULT_MAX_EVENTS, DEFAULT_MAX_SESSIONS,
+                      MonitorSession, SessionError, SessionLimit,
+                      SessionManager)
+
+__all__ = [
+    "IncrementalFrontier", "PrefixHasher", "MonitorSession",
+    "SessionManager", "SessionError", "SessionLimit",
+    "encode_frontier_states", "decode_frontier_states",
+    "DEFAULT_MAX_EVENTS", "DEFAULT_MAX_SESSIONS",
+]

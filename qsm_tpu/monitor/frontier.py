@@ -1,0 +1,320 @@
+"""Incremental per-session frontier — linearizability decided as events arrive.
+
+The decrease-and-conquer monitoring shape (PAPERS.md: arXiv:2410.04581;
+the same quiescent-cut algebra ``ops/segdc.py`` uses for batch work,
+run FORWARD over a growing event stream instead of backward over a
+finished history):
+
+* events append in real-time order (the session layer enforces
+  monotonic timestamps), so a **committed quiescent cut stays a cut
+  forever** — every later op invokes after every earlier response;
+* whenever the open window grows a new quiescent cut, the completed
+  segment behind it is folded into the frontier: ``F' = end_states(seg,
+  F)`` (ops/segdc.py ``_end_states`` — ALL model states reachable by
+  some complete valid linearization).  ``F' = ∅`` is an exact
+  VIOLATION of the whole stream so far (segdc's block-decomposition
+  iff); a non-empty ``F'`` banks in the verdict cache under the
+  prefix's **incremental fingerprint** (:class:`PrefixHasher` — a
+  rolling sha256, so a growing prefix never re-hashes from scratch)
+  with the frontier states riding the row, and the committed ops leave
+  the window (the decided-prefix eviction that keeps a long-lived
+  session's memory O(window), not O(stream));
+* the open window (pending ops allowed; no internal cuts) is re-checked
+  for satisfiability from the frontier states only —
+  ``oracle.check_from`` per state, exactly segdc's final-segment rule —
+  so re-deciding after k appended events costs o(n) engine work on an
+  n-event stream.
+
+**Verdict exactness.**  LINEARIZABLE ⟺ some frontier chain reaches a
+satisfiable window (segdc's iff).  A VIOLATION is *stable under
+extension*: every new op invokes after every already-completed op's
+response, so any linearization of the extended stream, truncated at its
+first new op, is a linearization of the old stream with trailing
+pendings pruned — if none existed before, none exists after
+(docs/MONITOR.md "Why a flip is final").  The monitor therefore treats
+VIOLATION as terminal, and a session's final verdict equals the
+whole-history ``check`` verdict bit-for-bit (tests/test_monitor.py
+parity pins).
+
+**Prefix banking / resume.**  A committed prefix banks
+``verdict=LINEARIZABLE`` with the frontier state set encoded in the
+row's witness slot (:func:`encode_frontier_states` — prefix keys live
+in their own fingerprint domain, disjoint from check rows, so no
+``verify_witness`` consumer ever sees one).  Re-feeding the same event
+stream — a client resuming after a node restart, a router replaying a
+session onto a respawned node — advances cut-by-cut on bank hits with
+ZERO engine work (``prefix_hits`` counts them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core.history import History, Op
+from ..core.spec import Spec
+from ..ops.backend import Verdict
+from ..ops.segdc import _Budget, _end_states, default_middle_oracle
+from ..sched.runner import PENDING_T
+
+# the prefix rows' own fingerprint domain: a prefix key can never
+# collide with serve.cache.fingerprint_key's (spec, whole-history) doc
+_PREFIX_DOMAIN = "qsm_tpu_monitor_prefix_v1"
+# witness-slot header tag for encoded frontier state sets
+_FRONTIER_TAG = -7741
+
+# bounded by contract (QSM-MON-UNBOUNDED, analysis/monitor_passes.py):
+# a frontier state set past this cap stops cut-committing (the window
+# keeps growing toward the session's own event cap instead — honest
+# degradation, never an unbounded set)
+DEFAULT_MAX_STATES = 64
+DEFAULT_NODE_BUDGET = 2_000_000
+
+
+class PrefixHasher:
+    """Rolling sha256 over a spec identity header plus each committed
+    op — ``key()`` is O(1) via digest-state copy, so the n-th prefix
+    fingerprint never re-hashes the n-1 ops before it."""
+
+    def __init__(self, spec: Spec):
+        self._h = hashlib.sha256()
+        self._h.update(json.dumps(
+            [_PREFIX_DOMAIN, spec.name, spec.spec_kwargs()],
+            sort_keys=True).encode())
+        self.ops_hashed = 0
+
+    def push(self, op: Op) -> None:
+        self._h.update(json.dumps(
+            [op.pid, op.cmd, op.arg, op.resp, op.invoke_time,
+             op.response_time]).encode())
+        self.ops_hashed += 1
+
+    def key(self) -> str:
+        return self._h.copy().hexdigest()
+
+
+def encode_frontier_states(states: Sequence[Tuple[int, ...]]) -> List[list]:
+    """Frontier state set → the bank row's witness-slot encoding:
+    ``[[_FRONTIER_TAG, state_dim], *states]`` (sorted — deterministic
+    rows compact/replicate byte-stably)."""
+    states = sorted(tuple(int(v) for v in s) for s in states)
+    dim = len(states[0]) if states else 0
+    return [[_FRONTIER_TAG, dim]] + [list(s) for s in states]
+
+
+def decode_frontier_states(witness) -> Optional[Set[Tuple[int, ...]]]:
+    """Inverse of :func:`encode_frontier_states`; None when the slot
+    holds anything else (an alien row must never masquerade as a
+    frontier)."""
+    if not witness:
+        return None
+    head = list(witness[0])
+    if len(head) != 2 or head[0] != _FRONTIER_TAG:
+        return None
+    dim = int(head[1])
+    out: Set[Tuple[int, ...]] = set()
+    for row in list(witness)[1:]:
+        s = tuple(int(v) for v in row)
+        if len(s) != dim:
+            return None
+        out.add(s)
+    return out if out else None
+
+
+@dataclasses.dataclass
+class FrontierCounters:
+    """One frontier's cost/shape record (session + SearchStats feed)."""
+
+    events: int = 0            # ops applied (invokes; responses update)
+    advances: int = 0          # quiescent cuts committed
+    prefix_hits: int = 0       # cuts committed from the bank, zero engine
+    window_checks: int = 0     # satisfiability re-checks of the window
+    committed_ops: int = 0     # ops evicted behind the committed cut
+    states: int = 0            # current frontier state-set size
+
+
+class IncrementalFrontier:
+    """One spec's incremental frontier (module docstring).  The session
+    layer owns event validation and ordering; this class owns the cut
+    algebra, the bank hand-off and the window re-check."""
+
+    def __init__(self, spec: Spec, *, bank=None, oracle=None,
+                 node_budget: int = DEFAULT_NODE_BUDGET,
+                 max_states: int = DEFAULT_MAX_STATES):
+        self.spec = spec
+        self.bank = bank                     # VerdictCache-shaped get/put
+        # the planner's host from-state engine: the native C++ checker
+        # when the toolchain is present (its end_states/check_from walk
+        # middles 3-10x faster), else the memoised Python oracle —
+        # exactly SegDC's ladder (ops/segdc.py default_middle_oracle)
+        self.oracle = oracle or default_middle_oracle(spec)
+        self.node_budget = int(node_budget)
+        self.max_states = max(1, int(max_states))
+        self.window: List[Op] = []           # open ops, invoke order
+        self.states: Set[Tuple[int, ...]] = {
+            tuple(int(v) for v in spec.initial_state())}
+        self.hasher = PrefixHasher(spec)
+        self.counters = FrontierCounters(states=1)
+        self.verdict = int(Verdict.LINEARIZABLE)  # empty stream: vacuous
+        self._saturated = False  # state-set cap hit: stop committing cuts
+        # pid -> window index of its one outstanding op.  Pendings block
+        # every later cut, so they never commit out of the window; their
+        # indices only SHIFT on eviction (adjusted in advance()).
+        self._pending: Dict[int, int] = {}
+
+    # -- event application --------------------------------------------
+    def invoke(self, pid: int, cmd: int, arg: int, t: int) -> None:
+        """One invocation whose time is >= every event already applied
+        (the session layer enforces the order and the one-outstanding-
+        op-per-pid history model)."""
+        self._pending[pid] = len(self.window)
+        self.window.append(make_pending_op(pid, cmd, arg, t))
+        self.counters.events += 1
+
+    def respond(self, pid: int, resp: int, t: int) -> bool:
+        """Complete ``pid``'s outstanding op (Op is frozen — replaced
+        in place); False when the pid has none here."""
+        i = self._pending.pop(pid, None)
+        if i is None:
+            return False
+        self.window[i] = dataclasses.replace(
+            self.window[i], resp=resp, response_time=t)
+        return True
+
+    def append_completed(self, op: Op) -> None:
+        """One already-completed op (ingested rows, replayed streams)
+        whose invoke_time respects the arrival order."""
+        self.window.append(op)
+        self.counters.events += 1
+
+    # -- the frontier step --------------------------------------------
+    def advance(self) -> int:
+        """Commit every quiescent cut the window now contains (bank
+        hits first, engine folds otherwise); returns the frontier
+        verdict — VIOLATION the moment some committed fold empties the
+        state set.  Cheap no-op when no new cut exists."""
+        if self.verdict == int(Verdict.VIOLATION):
+            return self.verdict
+        while not self._saturated:
+            cut = self._first_cut()
+            if cut is None:
+                break
+            seg = self.window[:cut]
+            # key of the prefix INCLUDING this segment, via a peek copy
+            # (the live hasher only advances on a successful commit)
+            peek = self._peek_hasher(seg)
+            key = peek.key()
+            nxt = self._bank_get(key)
+            if nxt is not None:
+                self.counters.prefix_hits += 1
+            else:
+                budget = _Budget(self.node_budget)
+                nxt = _end_states(self.spec, seg, self.states, budget)
+                if nxt is None:
+                    # budget blown mid-fold: leave the cut uncommitted
+                    # (the window re-check still answers, just from an
+                    # older frontier) — never guess, never wedge
+                    break
+                if not nxt:
+                    self.verdict = int(Verdict.VIOLATION)
+                    return self.verdict
+                if len(nxt) > self.max_states:
+                    # bounded by contract: an exploding frontier stops
+                    # cut-committing instead of growing without cap
+                    self._saturated = True
+                    break
+                self._bank_put(key, nxt)
+            self.hasher = peek
+            self.window = self.window[cut:]
+            # every pending op sits at index >= cut (a pending blocks
+            # all later cuts), so the shift can never go negative
+            self._pending = {p: i - cut for p, i in self._pending.items()}
+            self.states = nxt
+            self.counters.advances += 1
+            self.counters.committed_ops += cut
+            self.counters.states = len(nxt)
+        return self.verdict
+
+    def _peek_hasher(self, seg: Sequence[Op]) -> PrefixHasher:
+        peek = PrefixHasher.__new__(PrefixHasher)
+        peek._h = self.hasher._h.copy()
+        peek.ops_hashed = self.hasher.ops_hashed
+        for op in seg:
+            peek.push(op)
+        return peek
+
+    def _first_cut(self) -> Optional[int]:
+        """Smallest i>0 such that every window op before i responded
+        before window op i invoked (pending = sentinel, blocks all
+        later cuts)."""
+        max_resp = -1
+        for i, op in enumerate(self.window):
+            if i and max_resp < op.invoke_time:
+                return i
+            max_resp = max(max_resp, op.response_time)
+        return None
+
+    # -- the window re-check ------------------------------------------
+    def check_window(self) -> int:
+        """Satisfiability of the open window from the frontier states
+        (segdc's final-segment rule): LINEARIZABLE from ANY state wins;
+        all states VIOLATION is an exact stream violation; any budget
+        blow-up stays honestly undecided."""
+        if self.verdict == int(Verdict.VIOLATION):
+            return self.verdict
+        if not self.window:
+            self.verdict = int(Verdict.LINEARIZABLE)
+            return self.verdict
+        self.counters.window_checks += 1
+        last = History(list(self.window))
+        saw_budget = False
+        # sorted: deterministic state try-order (replayable cost records)
+        for state in sorted(self.states):
+            v = self.oracle.check_from(self.spec, last,
+                                       np.asarray(state, np.int32))
+            if v == Verdict.LINEARIZABLE:
+                self.verdict = int(Verdict.LINEARIZABLE)
+                return self.verdict
+            if v == Verdict.BUDGET_EXCEEDED:
+                saw_budget = True
+        self.verdict = int(Verdict.BUDGET_EXCEEDED if saw_budget
+                           else Verdict.VIOLATION)
+        return self.verdict
+
+    # -- bank plumbing -------------------------------------------------
+    def _bank_get(self, key: str) -> Optional[Set[Tuple[int, ...]]]:
+        if self.bank is None:
+            return None
+        e = self.bank.get(key)
+        if e is None or e.verdict != int(Verdict.LINEARIZABLE):
+            return None
+        return decode_frontier_states(e.witness)
+
+    def _bank_put(self, key: str, states: Set[Tuple[int, ...]]) -> None:
+        if self.bank is None:
+            return
+        self.bank.put(key, int(Verdict.LINEARIZABLE),
+                      encode_frontier_states(states))
+
+    # -- introspection -------------------------------------------------
+    def snapshot(self) -> dict:
+        c = self.counters
+        return {"window_ops": len(self.window),
+                "committed_ops": c.committed_ops,
+                "advances": c.advances,
+                "prefix_hits": c.prefix_hits,
+                "window_checks": c.window_checks,
+                "states": len(self.states),
+                "saturated": self._saturated,
+                "verdict": self.verdict}
+
+
+def make_pending_op(pid: int, cmd: int, arg: int, invoke_time: int) -> Op:
+    """A just-invoked op (no response yet) — the monitor's unit of
+    arrival; :meth:`IncrementalFrontier.complete_op` fills it in."""
+    return Op(pid=pid, cmd=cmd, arg=arg, resp=-1,
+              invoke_time=invoke_time, response_time=PENDING_T)

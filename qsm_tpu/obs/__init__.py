@@ -55,7 +55,13 @@ _DUMP_TRIGGERS = {"worker.shed": "worker_crash",
                   # partitioned): the new active's first act leaves an
                   # artifact recording what it observed when it took
                   # the term (fleet/router.py _promote)
-                  "router.takeover": "router_takeover"}
+                  "router.takeover": "router_takeover",
+                  # a monitor session deciding a VIOLATION is the
+                  # production incident the whole plane exists for:
+                  # the dump names the session's trace id even when no
+                  # client ever reads the flip response
+                  # (serve/server.py _session_flip)
+                  "session.flip": "session_flip"}
 
 
 class Observability:

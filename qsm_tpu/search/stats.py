@@ -85,6 +85,15 @@ class SearchStats:
     # event carries the compact record back — observability cost is
     # accounted like any other search cost, in both directions.
     obs_events: int = 0
+    # Monitor plane (qsm_tpu/monitor): the streaming-session cost/shape
+    # record — events streamed through sessions, quiescent cuts the
+    # frontiers committed, cuts resumed from the prefix bank with ZERO
+    # engine work, and verdict flips pushed to clients.  A monitoring
+    # run's record must say how much of its deciding was incremental.
+    session_events: int = 0      # events applied to live sessions
+    frontier_advances: int = 0   # quiescent cuts committed
+    flips_pushed: int = 0        # violation flips handed to clients
+    prefix_hits: int = 0         # cuts resumed from the decided-prefix bank
 
     # -- derived -----------------------------------------------------------
     @property
@@ -110,7 +119,9 @@ class SearchStats:
                   "segments_total", "degradations", "retries",
                   "worker_faults", "node_faults", "pcomp_split",
                   "pcomp_subs", "pcomp_recombine_ms", "shrink_rounds",
-                  "shrink_lanes", "shrink_memo_hits", "obs_events"):
+                  "shrink_lanes", "shrink_memo_hits", "obs_events",
+                  "session_events", "frontier_advances", "flips_pushed",
+                  "prefix_hits"):
             setattr(self, f, getattr(self, f) + getattr(other, f))
         # a maximum, not a tally: the composed record's worst sub-history
         # is the worst either side saw
@@ -173,6 +184,14 @@ class SearchStats:
             # emitted (qsm_tpu/obs) — a traced batch's cost record
             # says what the tracing itself cost
             "obe": self.obs_events,
+            # monitor-session counters (qsm_tpu/monitor): a monitoring
+            # bench row must say how many events streamed, how many
+            # cuts committed, how many resumed from the bank for free,
+            # and how many flips the run pushed
+            "sev": self.session_events,
+            "fad": self.frontier_advances,
+            "flp": self.flips_pushed,
+            "pfh": self.prefix_hits,
         }
 
     def to_timings(self) -> Dict[str, float]:
@@ -217,6 +236,13 @@ class SearchStats:
         # tracing-off run
         if self.obs_events:
             out["obs_events"] = float(self.obs_events)
+        # session accounting only when events actually streamed — zeros
+        # would claim "monitored, saw nothing" on every batch-check run
+        if self.session_events:
+            out["session_events"] = float(self.session_events)
+            out["frontier_advances"] = float(self.frontier_advances)
+            out["flips_pushed"] = float(self.flips_pushed)
+            out["prefix_hits"] = float(self.prefix_hits)
         return out
 
 
@@ -227,7 +253,8 @@ _COUNTER_FIELDS = ("histories", "lockstep_iters", "nodes_explored",
                    "retries", "worker_faults", "node_faults",
                    "pcomp_split", "pcomp_subs", "pcomp_recombine_ms",
                    "shrink_rounds", "shrink_lanes", "shrink_memo_hits",
-                   "obs_events")
+                   "obs_events", "session_events", "frontier_advances",
+                   "flips_pushed", "prefix_hits")
 # pcomp_max_sub and shrink_ratio_pct are deliberately NOT delta fields:
 # a maximum/ratio has no meaningful "per-run difference", so stats_delta
 # keeps `after`'s value.

@@ -20,7 +20,18 @@ ones — admission → micro-batch → dispatch → cache (docs/SERVING.md):
   re-dispatch, bounded-backoff respawn and per-spec quarantine;
 * ``worker``    — the pool worker process entry point (bank-free warm
   host-ladder engines over a length-prefixed pipe protocol);
-* ``client``    — :class:`CheckClient` (``qsm-tpu submit`` / bench).
+* ``client``    — :class:`CheckClient` (``qsm-tpu submit`` / bench)
+  and :class:`SessionHandle` (seq-tracked streaming sessions).
+
+Monitor sessions (qsm_tpu/monitor, docs/MONITOR.md): the protocol's
+``session.open`` / ``session.append`` / ``session.close`` verbs turn
+request/response checking into a LIVE service — clients stream
+invocation/response events as they happen, per-session incremental
+frontiers bank decided prefixes in the verdict cache under rolling
+prefix fingerprints (a restarted node resumes from the bank), and a
+verdict flip is answered the moment it is decidable with a
+shrink-plane-minimized repro and certificate.  ``qsm-tpu monitor``
+tails a foreign event log (qsm_tpu/ingest) into a session.
 
 Observability (qsm_tpu/obs, docs/OBSERVABILITY.md): every response
 carries a request-scoped trace id; ``--trace-log`` records the full
@@ -51,7 +62,7 @@ CLI: ``qsm-tpu serve`` / ``qsm-tpu submit`` / ``qsm-tpu fleet``
 from .admission import AdmissionController
 from .batcher import Lane, MicroBatcher
 from .cache import CacheEntry, VerdictCache, fingerprint_key
-from .client import CheckClient
+from .client import CheckClient, SessionHandle
 from .pool import (WorkerDead, WorkerFault, WorkerPool, WorkerTimeout)
 from .protocol import (VERDICT_NAMES, history_to_rows, parse_address,
                        rows_to_history)
@@ -61,6 +72,6 @@ __all__ = [
     "AdmissionController", "CacheEntry", "CheckClient", "CheckServer",
     "Lane", "MicroBatcher", "VERDICT_NAMES", "VerdictCache",
     "WorkerDead", "WorkerFault", "WorkerPool", "WorkerTimeout",
-    "fingerprint_key", "history_to_rows", "parse_address",
-    "rows_to_history",
+    "SessionHandle", "fingerprint_key", "history_to_rows",
+    "parse_address", "rows_to_history",
 ]

@@ -125,6 +125,62 @@ class CheckClient:
             req["trace"] = trace
         return self._round_trip(req)
 
+    # -- monitor sessions (qsm_tpu/monitor, docs/MONITOR.md) -----------
+    def session_open(self, model: str, *,
+                     spec_kwargs: Optional[dict] = None,
+                     session: Optional[str] = None,
+                     deadline_s: Optional[float] = None,
+                     trace: Optional[str] = None) -> dict:
+        """Open (or resume) a streaming monitor session; the response
+        carries the server-assigned ``session`` id and current
+        ``seq``.  :class:`SessionHandle` wraps the three verbs with
+        the seq bookkeeping replays need."""
+        req = {"op": "session.open", "id": f"q{next(_ids)}",
+               "model": model}
+        if spec_kwargs:
+            req["spec_kwargs"] = spec_kwargs
+        if session is not None:
+            req["session"] = session
+        if deadline_s is not None:
+            req["deadline_s"] = deadline_s
+        if trace:
+            req["trace"] = trace
+        return self._round_trip(req)
+
+    def session_append(self, session: str, events, *,
+                       seq: Optional[int] = None,
+                       deadline_s: Optional[float] = None,
+                       trace: Optional[str] = None) -> dict:
+        """Stream events into a session.  ``seq`` (the stream index of
+        the first event) makes the append IDEMPOTENT: a re-send after
+        a failover or reconnect applies only what the server has not
+        seen — the same replay-safety contract every fleet op has.
+        The response carries the current verdict, and the ``flip``
+        payload (minimized repro + certificate) on the append that
+        made a violation decidable."""
+        req = {"op": "session.append", "id": f"q{next(_ids)}",
+               "session": session, "events": list(events)}
+        if seq is not None:
+            req["seq"] = int(seq)
+        if deadline_s is not None:
+            req["deadline_s"] = deadline_s
+        if trace:
+            req["trace"] = trace
+        return self._round_trip(req)
+
+    def session_close(self, session: str, *, witness: bool = False,
+                      deadline_s: Optional[float] = None,
+                      trace: Optional[str] = None) -> dict:
+        req = {"op": "session.close", "id": f"q{next(_ids)}",
+               "session": session}
+        if witness:
+            req["witness"] = True
+        if deadline_s is not None:
+            req["deadline_s"] = deadline_s
+        if trace:
+            req["trace"] = trace
+        return self._round_trip(req)
+
     def stats(self) -> dict:
         return self._round_trip({"op": "stats"})
 
@@ -272,3 +328,60 @@ class CheckClient:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class SessionHandle:
+    """One live monitor session, seq-tracked (docs/MONITOR.md).
+
+    Wraps the three ``session.*`` verbs so callers just ``append``
+    events: every append carries ``seq``, so the client's bounded
+    retry/failover ladder (the ``_round_trip`` machinery, multi-address
+    included) can safely re-send — the server applies only what it has
+    not seen, and a replay onto a restarted node resumes from the
+    banked decided prefix.  ``flips`` collects every pushed flip
+    payload (minimized repro + certificate)."""
+
+    def __init__(self, client: CheckClient, model: str, *,
+                 spec_kwargs: Optional[dict] = None,
+                 session: Optional[str] = None,
+                 deadline_s: Optional[float] = None):
+        self.client = client
+        self.model = model
+        self.spec_kwargs = spec_kwargs
+        self.deadline_s = deadline_s
+        doc = client.session_open(model, spec_kwargs=spec_kwargs,
+                                  session=session,
+                                  deadline_s=deadline_s)
+        if not doc.get("ok"):
+            raise RuntimeError(f"session.open refused: {doc}")
+        self.sid: str = doc["session"]
+        self.seq: int = int(doc.get("seq", 0))
+        self.verdict: str = doc.get("verdict", "LINEARIZABLE")
+        self.trace: str = doc.get("trace", "")
+        self.flips: List[dict] = []
+        self.last: dict = doc
+
+    def append(self, events) -> dict:
+        """Stream events; returns the response (current verdict, and
+        the flip payload on the deciding append)."""
+        events = list(events)
+        doc = self.client.session_append(
+            self.sid, events, seq=self.seq,
+            deadline_s=self.deadline_s,
+            trace=self.trace or None)
+        self.last = doc
+        if doc.get("ok"):
+            self.seq = int(doc.get("seq", self.seq))
+            self.verdict = doc.get("verdict", self.verdict)
+            if doc.get("flip"):
+                self.flips.append(doc["flip"])
+        return doc
+
+    def close(self, witness: bool = False) -> dict:
+        doc = self.client.session_close(self.sid, witness=witness,
+                                        deadline_s=self.deadline_s,
+                                        trace=self.trace or None)
+        self.last = doc
+        if doc.get("ok"):
+            self.verdict = doc.get("verdict", self.verdict)
+        return doc

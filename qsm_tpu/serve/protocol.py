@@ -49,6 +49,30 @@ difference: a deadline firing MID-shrink returns the best-so-far
 history with ``complete: false`` and an honest ``why`` instead of
 discarding the rounds already paid for.
 
+Monitor sessions (qsm_tpu/monitor, docs/MONITOR.md) grow the protocol
+from request/response to STREAMS::
+
+    {"op": "session.open", "id": "m0", "model": "kv", "spec_kwargs": {}}
+    {"op": "session.append", "session": "s000001", "seq": 0, "events":
+     [{"type": "invoke", "pid": 0, "cmd": 1, "arg": 5},
+      {"type": "respond", "pid": 0, "resp": 0}]}
+    {"op": "session.close", "session": "s000001", "witness": true}
+
+Events are invoke/respond dicts (live streams; arrival order is time
+order) or raw history 6-rows (recorded corpora, invoke-time order).
+Every append answers the CURRENT verdict — exact at every step, equal
+to the whole-history ``check`` of the same prefix — and the append
+that makes a violation decidable carries ``flip``: the verdict, a
+shrink-plane-minimized ``repro`` (1-minimal rows) and its
+``certificate``.  ``seq`` (stream index of the append's first event)
+makes appends idempotent: reconnects, router failover replay and
+node restarts re-send safely, and a restarted node resumes from the
+decided prefixes banked in the verdict cache under rolling prefix
+fingerprints.  Session caps (sessions, events) answer SHED exactly
+like admission pressure.  Routed through a ``FleetRouter``, a
+session's ops route by its session key and a lost node is replayed
+onto the next ring node (fleet/router.py).
+
 Fleet tier (qsm_tpu/fleet, docs/SERVING.md "Fleet"): a server started
 with a ``node_id`` stamps ``node`` on EVERY response (ok/SHED/error),
 so router-merged answers say which node decided which lanes; a server

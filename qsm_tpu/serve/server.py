@@ -211,7 +211,11 @@ class CheckServer:
                  replog_seal_rows: int = 256,
                  peers: Optional[list] = None,
                  gossip_s: float = 0.0,
-                 gossip_fanout: int = 2):
+                 gossip_fanout: int = 2,
+                 max_sessions: int = 256,
+                 session_events: int = 65_536,
+                 session_states: int = 64,
+                 session_budget: int = 2_000_000):
         if engine not in ("auto", "planned"):
             raise ValueError(f"unknown serve engine {engine!r}; "
                              "one of ('auto', 'planned')")
@@ -330,6 +334,18 @@ class CheckServer:
         self.shrink_rounds = 0      # frontier rounds across all requests
         self.shrink_lanes = 0      # candidate lanes those rounds carried
         self.shrink_memo_hits = 0  # candidates answered without checking
+        # Monitor sessions (qsm_tpu/monitor, docs/MONITOR.md): clients
+        # stream invocation/response events through the session.* ops;
+        # per-session incremental frontiers bank decided prefixes in
+        # THE verdict cache (prefix fingerprints — a node restart
+        # resumes from the bank), and a verdict flip is answered the
+        # moment it is decidable with a shrink-plane-minimized repro.
+        from ..monitor import SessionManager
+
+        self.monitor = SessionManager(
+            bank=self.cache, max_sessions=max_sessions,
+            max_events=session_events, node_budget=session_budget,
+            max_states=session_states)
 
     def _make_gossip(self, peers) -> None:
         from ..fleet.gossip import GossipAgent
@@ -589,6 +605,15 @@ class CheckServer:
             self._handle_replog(conn, op, req)
         elif op == "gossip.peers":
             self._handle_gossip_peers(conn, req)
+        elif op in ("session.open", "session.append", "session.close"):
+            try:
+                self._handle_session(conn, op, req)
+            except OSError:
+                raise  # peer gone: let the connection close
+            except Exception as e:  # noqa: BLE001 — answer, don't die
+                self._send(conn, {"id": req.get("id"), "ok": False,
+                                  "session": req.get("session"),
+                                  "error": f"{type(e).__name__}: {e}"})
         elif op == "shutdown":
             if self.allow_shutdown:
                 self._send(conn, {"ok": True, "stopping": True})
@@ -1035,6 +1060,236 @@ class CheckServer:
             dispatched += 1
         return True
 
+    # -- monitor sessions (qsm_tpu/monitor, docs/MONITOR.md) -----------
+    def _handle_session(self, conn: socket.socket, op: str,
+                        req: dict) -> None:
+        """The streaming verbs: ``session.open`` binds a spec and
+        returns a session id (idempotent for a live id — failover
+        replay and reconnects resume), ``session.append`` applies
+        events and answers the CURRENT verdict — carrying the ``flip``
+        payload (1-minimal shrunk repro + certificate) on the append
+        that made a violation decidable — and ``session.close`` flushes,
+        decides once more, optionally serves the whole-stream witness
+        through the exact check-path machinery, and frees the session.
+        Admission/SHED semantics match ``check``: a full queue or a
+        session/event cap answers SHED, never a wrong or partial
+        verdict; engine time is bounded by the frontier node budget and
+        the request deadline."""
+        from ..monitor import SessionError, SessionLimit
+
+        t_req = time.perf_counter()
+        trace = str(req.get("trace") or "") or new_trace_id()
+        root = ""
+        if self.obs.on:
+            root = new_span_id()
+            self.obs.tracer.emit("request", trace=trace, span=root,
+                                 op=op, session=req.get("session"))
+        self.requests += 1
+        if op == "session.open":
+            self._session_open(conn, req, trace, root, t_req)
+            return
+        sid = str(req.get("session") or "")
+        s = self.monitor.get(sid)
+        if s is None:
+            # machine-readable: a router reads `unknown_session` as
+            # "this node restarted and lost the live object" and
+            # replays the journal (fleet/router.py _route_session) —
+            # the banked decided prefixes make the replay cheap
+            self._send(conn, {"id": req.get("id"), "ok": False,
+                              "session": sid, "trace": trace,
+                              "unknown_session": True,
+                              "error": f"unknown session {sid!r} "
+                                       "(open one first; a restarted "
+                                       "node resumes by re-open + "
+                                       "replay)"})
+            return
+        if not self.admission.try_admit(1):
+            self._respond(conn, {**self._shed(req, "queue full", trace,
+                                              root), "session": sid},
+                          trace, root, t_req)
+            return
+        try:
+            deadline = self.admission.deadline_for(req.get("deadline_s"))
+            with s.lock:
+                if op == "session.append":
+                    doc = self._session_append(s, req, deadline, trace,
+                                               root)
+                else:
+                    doc = self._session_close(s, req, deadline, trace,
+                                              root)
+        except SessionLimit as e:
+            self.admission.release(1)
+            self._respond(conn, {**self._shed(req, str(e), trace, root),
+                                 "session": sid}, trace, root, t_req)
+            return
+        except Exception:
+            self.admission.release(1)
+            raise
+        self.admission.release(1)
+        doc["seconds"] = round(time.perf_counter() - t_req, 4)
+        self._respond(conn, doc, trace, root, t_req)
+
+    def _session_open(self, conn, req: dict, trace: str, root: str,
+                      t_req: float) -> None:
+        from ..models.registry import MODELS
+        from ..monitor import SessionLimit
+
+        model = req.get("model")
+        if model not in MODELS:
+            self._send(conn, {"id": req.get("id"), "ok": False,
+                              "trace": trace,
+                              "error": f"unknown model {model!r}; one "
+                                       f"of {sorted(MODELS)}"})
+            return
+        spec_kwargs = req.get("spec_kwargs") or {}
+        # engine/projection validation BEFORE admission, like check
+        entry = self._engine_for(model, spec_kwargs)
+        if not self.admission.try_admit(1):
+            self._respond(conn, self._shed(req, "queue full", trace,
+                                           root), trace, root, t_req)
+            return
+        try:
+            sid = req.get("session")
+            try:
+                s, resumed = self.monitor.open(
+                    str(sid) if sid is not None else None, entry.spec,
+                    entry.proj, trace=trace)
+            except SessionLimit as e:
+                self._respond(conn, self._shed(req, str(e), trace,
+                                               root), trace, root,
+                              t_req)
+                return
+            with s.lock:
+                s.model, s.spec_kwargs = model, spec_kwargs
+                verdict = s.decide()
+            self.obs.event("session.open", trace=trace, parent=root,
+                           session=s.sid, model=model, resumed=resumed)
+            self._respond(conn, {
+                "id": req.get("id"), "ok": True, "session": s.sid,
+                "model": model, "resumed": resumed, "seq": s.seq,
+                "per_key": s.proj is not None,
+                "verdict": VERDICT_NAMES[verdict], "trace": trace,
+                "seconds": round(time.perf_counter() - t_req, 4),
+            }, trace, root, t_req)
+        finally:
+            self.admission.release(1)
+
+    def _session_append(self, s, req: dict, deadline: float,
+                        trace: str, root: str) -> dict:
+        events = req.get("events")
+        if not isinstance(events, list) or not events:
+            raise ValueError("session.append needs a non-empty "
+                             "'events' array")
+        applied = s.append(events, seq=req.get("seq"))
+        already_pushed = s.flip_pushed
+        verdict = s.decide()
+        c = s.counters()
+        self.obs.event("session.append", trace=trace, parent=root,
+                       session=s.sid, events=applied,
+                       verdict=VERDICT_NAMES[verdict])
+        doc = {"id": req.get("id"), "ok": True, "session": s.sid,
+               "seq": s.seq, "applied": applied,
+               "verdict": VERDICT_NAMES[verdict], "trace": trace,
+               "decided_prefix": c["committed_ops"],
+               "window_ops": c["window_ops"]}
+        if s.flipped and not already_pushed:
+            # the flip: pushed on the append that made the violation
+            # decidable (a verdict only changes when an event arrives,
+            # so this response IS the earliest possible push), carrying
+            # the shrink-plane-minimized repro + its certificate
+            s.flip_pushed = True
+            self.monitor.note_flip()
+            doc["flip"] = self._session_flip(s, deadline, trace, root)
+        elif s.flipped:
+            doc["flipped"] = True  # terminal; repro already delivered
+        return doc
+
+    def _session_flip(self, s, deadline: float, trace: str,
+                      root: str) -> dict:
+        """Auto-minimize the violating stream through the PR 10 shrink
+        plane (frontier candidates ride the shared micro-batcher and
+        bank in the shared cache) and certify the result; the
+        ``session.flip`` event is a flight-recorder dump trigger, so a
+        production flip leaves an artifact naming the session's trace
+        id even if no client ever reads the response."""
+        from ..shrink.shrinker import Shrinker, minimality_certificate
+
+        entry = self._engine_for(s.model, s.spec_kwargs)
+        spec_key = self._spec_key(s.model, s.spec_kwargs)
+        h = rows_to_history([list(r) for r in (s.flip_rows or s.rows)])
+
+        def decide(hists):
+            return self._decide_candidates(entry, spec_key, hists,
+                                           deadline, trace=trace,
+                                           parent=root)
+
+        shrinker = Shrinker(entry.spec, decide, bank=self.cache,
+                            bank_put=False, deadline=deadline)
+        res = shrinker.run(h)
+        flip = {"verdict": VERDICT_NAMES[int(res.verdict)],
+                "initial_ops": res.initial_ops,
+                "final_ops": res.final_ops,
+                "rounds": res.rounds,
+                "one_minimal": res.one_minimal,
+                "complete": res.complete,
+                "repro": history_to_rows(res.history),
+                "why": res.why}
+        if res.ok and res.complete:
+            cert = minimality_certificate(entry.spec, res.history,
+                                          deadline=deadline)
+            if cert is not None:
+                flip["certificate"] = cert
+        with self._shrink_lock:
+            self.shrink_rounds += res.rounds
+            self.shrink_lanes += res.lanes_checked
+            self.shrink_memo_hits += res.memo_hits
+        self.obs.event("session.flip", trace=trace, parent=root,
+                       session=s.sid, model=s.model,
+                       ops=len(s.rows), final_ops=res.final_ops,
+                       traces=[trace])
+        return flip
+
+    def _session_close(self, s, req: dict, deadline: float,
+                       trace: str, root: str) -> dict:
+        verdict = s.close()
+        doc = {"id": req.get("id"), "ok": True, "session": s.sid,
+               "seq": s.seq, "verdict": VERDICT_NAMES[verdict],
+               "trace": trace, "flipped": s.flipped,
+               **{k: v for k, v in s.counters().items()
+                  if k != "frontiers"}}
+        if bool(req.get("witness")) and s.rows:
+            # the whole-stream witness rides the EXACT check-path
+            # machinery (cache row under the whole-history fingerprint,
+            # decomposed stitching when the split pays), so a streamed
+            # session's witness is bit-identical to `check --witness`
+            # of the same history (tests/test_monitor.py parity pin)
+            entry = self._engine_for(s.model, s.spec_kwargs)
+            h = s.history()
+            key = fingerprint_key(entry.spec, h)
+            e = self.cache.get(key)
+            if e is not None and not (e.witness is None and e.verdict
+                                      == int(Verdict.LINEARIZABLE)):
+                v, w = e.verdict, e.witness
+            elif self._split_pays(entry, h):
+                with self._pcomp_lock:
+                    if entry.pcomp is None:
+                        from ..ops.pcomp import PComp
+
+                        entry.pcomp = PComp(entry.spec)
+                v, w = entry.pcomp.check_witness(entry.spec, h)
+                self.cache.put(key, int(v), w)
+            else:
+                v, w = entry.oracle.check_witness(entry.spec, h)
+                self.cache.put(key, int(v), w)
+            doc["verdict"] = VERDICT_NAMES[int(v)]
+            doc["witness"] = ([list(p) for p in w]
+                              if w is not None else None)
+        self.obs.event("session.close", trace=trace, parent=root,
+                       session=s.sid, events=s.seq,
+                       verdict=doc["verdict"])
+        self.monitor.close(s.sid)
+        return doc
+
     # -- the shrink verb (qsm_tpu/shrink, docs/SHRINK.md) --------------
     def _handle_shrink(self, conn: socket.socket, req: dict) -> None:
         """Minimize one failing history.  Admission/deadline/SHED
@@ -1449,6 +1704,11 @@ class CheckServer:
             # their frontiers cost in shared lanes, and how much the
             # fingerprint memo + result bank saved (docs/SHRINK.md)
             "shrink": self._shrink_snapshot(),
+            # monitor-session accounting (qsm_tpu/monitor): live
+            # sessions, events streamed, frontier advances, prefix-bank
+            # hits and flips pushed — the session block `qsm-tpu stats`
+            # renders and the metrics collector reads (one source)
+            "session": self.monitor.snapshot(),
             "worker_faults": (self.pool.worker_faults
                               if self.pool is not None else 0),
             "budget_resolved": self.budget_resolved,
@@ -1481,6 +1741,7 @@ class CheckServer:
         cache = self.cache.stats()
         pc = self._pcomp_snapshot()
         sh = self._shrink_snapshot()
+        sess = self.monitor.totals()
         c, g = "counter", "gauge"
         out = [
             ("qsm_serve_requests_total", c, "requests received", {},
@@ -1524,6 +1785,19 @@ class CheckServer:
              float(sh["requests"])),
             ("qsm_shrink_rounds_total", c, "shrink frontier rounds",
              {}, float(sh["rounds"])),
+            ("qsm_session_live", g, "live monitor sessions", {},
+             float(sess["sessions_live"])),
+            ("qsm_session_events_total", c, "session events streamed",
+             {}, float(sess["session_events"])),
+            ("qsm_session_frontier_advances_total", c,
+             "quiescent cuts committed", {},
+             float(sess["frontier_advances"])),
+            ("qsm_session_prefix_hits_total", c,
+             "cuts resumed from the prefix bank", {},
+             float(sess["prefix_hits"])),
+            ("qsm_session_flips_pushed_total", c,
+             "verdict flips pushed to clients", {},
+             float(sess["flips_pushed"])),
             ("qsm_obs_span_events_total", c, "span events emitted", {},
              float(self.obs.tracer.events)),
         ]

@@ -732,6 +732,193 @@ def cmd_submit(args) -> int:
     return 2 if res.get("undecided") else 0
 
 
+def cmd_ingest(args) -> int:
+    """Turn a foreign event log (qsm_tpu/ingest, docs/MONITOR.md) into
+    a first-class corpus: Jepsen/Knossos-style (``--format jepsen``)
+    or porcupine-style (``--format porcupine``) EDN event lines decode
+    into the repo's ONE history encoding, so the result feeds
+    ``check``, ``submit``, ``shrink`` and bench unchanged.  Default:
+    print (or ``--out``) the `check`-CLI JSON document.  ``--check``
+    decides it in-process (exit 0 linearizable / 1 violation / 2
+    undecided); ``--submit ADDR`` sends it to a running server (exit
+    codes mirror ``submit``); ``--emit`` re-renders the canonical log
+    text (the byte-stable round trip the golden tests pin).  Parse and
+    domain errors exit 2, loudly — an adapter never guesses."""
+    from ..ingest import EdnError, IngestError, emit_trace, parse_trace
+
+    spec, _ = make(args.spec, "atomic",
+                   json.loads(args.spec_kwargs)
+                   if args.spec_kwargs else None)
+    with open(args.trace) as f:
+        text = f.read()
+    try:
+        rows = parse_trace(args.format, text, args.spec, spec)
+    except (EdnError, IngestError) as e:
+        print(f"ingest: {e}", file=sys.stderr)
+        return 2
+    from .report import history_from_rows
+
+    h = history_from_rows(rows)
+    if args.emit:
+        out_text = emit_trace(args.format, h, args.spec, spec)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out_text)
+        else:
+            sys.stdout.write(out_text)
+        return 0
+    doc = {"model": args.spec, "spec_kwargs": spec.spec_kwargs(),
+           "format": args.format, "ops": len(h),
+           "pending": h.n_pending,
+           "history": [[o.pid, o.cmd, o.arg, o.resp, o.invoke_time,
+                        o.response_time] for o in h.ops]}
+    if args.submit:
+        from ..serve.client import CheckClient
+
+        client = CheckClient(args.submit, timeout_s=args.timeout)
+        try:
+            res = client.check(args.spec, [doc["history"]],
+                               spec_kwargs=spec.spec_kwargs() or None,
+                               witness=args.witness)
+        finally:
+            client.close()
+        print(json.dumps(res))
+        if not res.get("ok"):
+            return 3
+        return 1 if res.get("violations") else (
+            2 if res.get("undecided") else 0)
+    if args.check:
+        from ..resilience.failover import host_fallback
+
+        v = int(host_fallback(spec).check_histories(spec, [h])[0])
+        print(json.dumps({**{k: doc[k] for k in
+                             ("model", "format", "ops", "pending")},
+                          "verdict": _VERDICT_NAMES[v]}))
+        return 0 if v == 1 else (2 if v == 2 else 1)
+    payload = json.dumps(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload + "\n")
+        print(json.dumps({"out": args.out, "ops": len(h),
+                          "pending": h.n_pending}))
+    else:
+        print(payload)
+    return 0
+
+
+def cmd_monitor(args) -> int:
+    """Tail a growing foreign event log into a LIVE monitor session
+    (docs/MONITOR.md): each appended line becomes a session event the
+    moment it lands, the session's incremental frontier decides it,
+    and a verdict flip prints the pushed payload — the 1-minimal
+    shrink-plane repro with certificate — the moment it is decidable.
+    With ``--addr`` the session is served by a running check server or
+    fleet router (``session.*`` ops, seq-tracked and failover-safe);
+    without it an in-process session decides locally (flip repro via
+    ``shrink_history``).  Exit codes: 0 linearizable, 1 violation,
+    2 undecided, 3 shed/error."""
+    from ..ingest import EdnError, EventTailer, IngestError, tail_file
+
+    spec, _ = make(args.spec, "atomic",
+                   json.loads(args.spec_kwargs)
+                   if args.spec_kwargs else None)
+    tailer = EventTailer(args.format, args.spec, spec)
+    flip_doc = None
+    verdict = "LINEARIZABLE"
+    try:
+        if args.addr:
+            from ..serve.client import CheckClient, SessionHandle
+
+            client = CheckClient(args.addr, timeout_s=args.timeout)
+            try:
+                handle = SessionHandle(
+                    client, args.spec,
+                    spec_kwargs=spec.spec_kwargs() or None,
+                    session=args.session, deadline_s=args.deadline)
+                print(json.dumps({"session": handle.sid,
+                                  "resumed": handle.last.get("resumed"),
+                                  "trace": handle.trace}),
+                      file=sys.stderr)
+                for line in tail_file(args.trace, follow=args.follow,
+                                      max_idle_s=args.max_idle):
+                    events = tailer.events_for_line(line)
+                    if not events:
+                        continue
+                    out = handle.append(events)
+                    if not out.get("ok"):
+                        print(json.dumps(out))
+                        return 3
+                    if out.get("flip"):
+                        flip_doc = out["flip"]
+                        print(json.dumps({"event": "session.flip",
+                                          "session": handle.sid,
+                                          **flip_doc}), flush=True)
+                fin = handle.close(witness=args.witness)
+                if not fin.get("ok"):
+                    print(json.dumps(fin))
+                    return 3
+                verdict = fin.get("verdict", verdict)
+                print(json.dumps(fin))
+            finally:
+                client.close()
+        else:
+            from ..monitor import MonitorSession
+            from ..core.spec import projection_report
+
+            proj = None
+            if not projection_report(spec):
+                p = spec.projected_spec()
+                if p.name in MODELS:
+                    proj = p
+            s = MonitorSession("local", spec, proj_spec=proj)
+            for line in tail_file(args.trace, follow=args.follow,
+                                  max_idle_s=args.max_idle):
+                events = tailer.events_for_line(line)
+                if not events:
+                    continue
+                s.append(events)
+                already = s.flip_pushed
+                if s.decide() == 0 and not already:
+                    s.flip_pushed = True
+                    from ..shrink.shrinker import shrink_history
+
+                    res = shrink_history(
+                        spec, s.history(),
+                        deadline_s=args.deadline,
+                        certificate=True)
+                    flip_doc = {
+                        "verdict": "VIOLATION",
+                        "initial_ops": res.initial_ops,
+                        "final_ops": res.final_ops,
+                        "one_minimal": res.one_minimal,
+                        "complete": res.complete,
+                        "repro": [[o.pid, o.cmd, o.arg, o.resp,
+                                   o.invoke_time, o.response_time]
+                                  for o in res.history.ops]}
+                    if res.certificate is not None:
+                        flip_doc["certificate"] = res.certificate
+                    print(json.dumps({"event": "session.flip",
+                                      "session": s.sid, **flip_doc}),
+                          flush=True)
+            v = s.close()
+            verdict = _VERDICT_NAMES[v]
+            print(json.dumps({"session": s.sid, "verdict": verdict,
+                              **s.counters()}))
+    except (EdnError, IngestError) as e:
+        print(f"monitor: {e}", file=sys.stderr)
+        return 2
+    if args.save and flip_doc is not None:
+        from ..resilience.checkpoint import atomic_write_json
+
+        atomic_write_json(args.save, {
+            "model": args.spec, "spec_kwargs": spec.spec_kwargs(),
+            "history": flip_doc["repro"]})
+        print(json.dumps({"saved": args.save}), file=sys.stderr)
+    if verdict == "VIOLATION":
+        return 1
+    return 0 if verdict == "LINEARIZABLE" else 2
+
+
 def cmd_trace(args) -> int:
     """Reconstruct ONE request's causal tree from a span log
     (qsm_tpu/obs, docs/OBSERVABILITY.md): admission, every micro-batch
@@ -792,6 +979,14 @@ def _render_stats_watch(doc: dict) -> str:
         f"{pc.get('sub_cache_hits', 0)} cached)  shrink: "
         f"{sh.get('requests', 0)} req / {sh.get('rounds', 0)} rounds",
     ]
+    sess = doc.get("session")
+    if sess:
+        lines.append(
+            f"  session: live {sess.get('sessions_live', 0)}  events "
+            f"{sess.get('session_events', 0)}  advances "
+            f"{sess.get('frontier_advances', 0)}  prefix_hits "
+            f"{sess.get('prefix_hits', 0)}  flips "
+            f"{sess.get('flips_pushed', 0)}")
     if pool:
         live = [w for w in pool.get("workers", []) if w.get("alive")]
         lines.append(
@@ -1766,6 +1961,66 @@ def main(argv=None) -> int:
     p.add_argument("--timeout", type=float, default=60.0,
                    help="client-side response bound")
     p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser(
+        "ingest",
+        help="decode a Jepsen/Knossos- or porcupine-style event log "
+             "into a first-class corpus (check/submit/shrink/bench "
+             "take it unchanged)")
+    p.add_argument("trace", help="the event-log file (EDN maps, one "
+                                 "event per line)")
+    p.add_argument("--format", required=True,
+                   choices=("jepsen", "porcupine"))
+    p.add_argument("--spec", required=True, choices=sorted(MODELS),
+                   help="the model the trace's ops map onto "
+                        "(ingest/specmap.py owns the vocabulary)")
+    p.add_argument("--spec-kwargs", default=None,
+                   help="JSON spec constructor kwargs (e.g. "
+                        "'{\"n_keys\": 8}')")
+    p.add_argument("--out", default=None,
+                   help="write the decoded trace document here "
+                        "(default: stdout)")
+    p.add_argument("--check", action="store_true",
+                   help="decide in-process (exit 0/1/2 = "
+                        "linearizable/violation/undecided)")
+    p.add_argument("--submit", default=None, metavar="ADDR",
+                   help="submit to a running check server instead")
+    p.add_argument("--witness", action="store_true",
+                   help="with --submit: ask for the linearization")
+    p.add_argument("--emit", action="store_true",
+                   help="re-render the canonical log text (the "
+                        "byte-stable round trip) instead of JSON")
+    p.add_argument("--timeout", type=float, default=60.0)
+    p.set_defaults(fn=cmd_ingest)
+
+    p = sub.add_parser(
+        "monitor",
+        help="tail a growing event log into a LIVE monitor session: "
+             "verdict flips print the minimized repro the moment "
+             "they are decidable (docs/MONITOR.md)")
+    p.add_argument("trace", help="the event-log file to tail")
+    p.add_argument("--format", required=True,
+                   choices=("jepsen", "porcupine"))
+    p.add_argument("--spec", required=True, choices=sorted(MODELS))
+    p.add_argument("--spec-kwargs", default=None)
+    p.add_argument("--addr", default=None,
+                   help="serve the session at this check server / "
+                        "fleet router (session.* ops; omitted = an "
+                        "in-process session)")
+    p.add_argument("--session", default=None,
+                   help="resume this server-side session id")
+    p.add_argument("--follow", action="store_true",
+                   help="keep tailing as the file grows (stops after "
+                        "--max-idle quiet seconds)")
+    p.add_argument("--max-idle", type=float, default=30.0)
+    p.add_argument("--witness", action="store_true",
+                   help="ask for the whole-stream witness at close")
+    p.add_argument("--save", default=None,
+                   help="write the flip's minimized repro here as a "
+                        "`check`-format trace file")
+    p.add_argument("--deadline", type=float, default=None)
+    p.add_argument("--timeout", type=float, default=60.0)
+    p.set_defaults(fn=cmd_monitor)
 
     p = sub.add_parser(
         "lint",

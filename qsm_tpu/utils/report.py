@@ -144,19 +144,40 @@ def save_regression(path: str, model: str, impl: str, spec: Spec,
 def history_from_rows(rows) -> History:
     """The ONE decoder for the ``[pid, cmd, arg, resp, invoke_time,
     response_time]`` history encoding (regression files, external trace
-    files — the `check` CLI).  Normalizes pending markers: a null/
-    negative resp or a response_time at/past the sentinel both mean
-    pending, canonicalized to ``resp=-1, response_time=PENDING_T``.
-    Row order is preserved (witness op indices refer to it)."""
+    files — the `check` CLI, the ingest adapters).  Normalizes pending
+    markers: a null/negative resp or a response_time at/past the
+    sentinel both mean pending, canonicalized to ``resp=-1,
+    response_time=PENDING_T``.
+
+    Op order is CANONICAL, not insertion-luck: rows sort under the
+    deterministic total order ``(invoke_time, response_time, pid, cmd,
+    arg, resp)``, so any permutation of the same rows decodes to the
+    same History (same fingerprint, same cache row, same witness
+    indices).  Rows already in invocation order — every in-tree writer
+    — are unchanged.  Witness op indices refer to this canonical
+    order.
+
+    A completed row whose response precedes its invocation is not a
+    history at all (no schedule produces one; an adapter that builds
+    one mis-paired its events) — refused loudly, never silently
+    reordered into something checkable."""
     from ..sched.runner import PENDING_T
 
     ops = []
-    for pid, cmd, arg, resp, inv, ret in rows:
+    for i, (pid, cmd, arg, resp, inv, ret) in enumerate(rows):
         pending = resp is None or resp < 0 or ret is None or ret >= PENDING_T
+        if not pending and ret < inv:
+            raise ValueError(
+                f"history row {i} ({[pid, cmd, arg, resp, inv, ret]}): "
+                f"response_time {ret} precedes invoke_time {inv} — "
+                "responses cannot precede their invocations (mis-paired "
+                "events in the producer?)")
         ops.append(Op(pid=pid, cmd=cmd, arg=arg,
                       resp=-1 if pending else resp,
                       invoke_time=inv,
                       response_time=PENDING_T if pending else ret))
+    ops.sort(key=lambda o: (o.invoke_time, o.response_time, o.pid,
+                            o.cmd, o.arg, o.resp))
     return History(ops)
 
 

@@ -1,0 +1,233 @@
+"""Foreign-trace ingest (qsm_tpu/ingest) — the ISSUE 14 satellite gates.
+
+What is pinned, in order of importance:
+
+* the golden Jepsen and porcupine logs round-trip BYTE-STABLY
+  (parse → History → re-emit → identical bytes) and check end-to-end
+  with pinned CLI exit codes — ingested traces are ordinary corpora;
+* ``utils/report.py history_from_rows`` is deterministic under row
+  permutation (the satellite fix: canonical total order, no
+  insertion-order luck) and refuses response-before-invocation rows
+  loudly;
+* ingested traces are accepted by ``submit`` and ``shrink`` against a
+  running server exactly like native corpora;
+* adapter errors (unknown ops, out-of-domain values, mis-paired
+  events) are refused with line context, never guessed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from qsm_tpu.ingest import (EdnError, IngestError, emit_trace,
+                            parse_trace)
+from qsm_tpu.models.registry import MODELS
+from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+from qsm_tpu.utils.report import history_from_rows
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+GOLDEN_JEPSEN = os.path.join(DATA, "golden_jepsen_register.edn")
+GOLDEN_PORCUPINE = os.path.join(DATA, "golden_porcupine_kv.edn")
+
+
+def _golden(path):
+    with open(path) as f:
+        return f.read()
+
+
+# --- golden round trips ----------------------------------------------------
+
+def test_golden_jepsen_round_trip_byte_stable():
+    text = _golden(GOLDEN_JEPSEN)
+    spec = MODELS["register"].make_spec()
+    rows = parse_trace("jepsen", text, "register", spec)
+    h = history_from_rows(rows)
+    assert emit_trace("jepsen", h, "register", spec) == text
+    # the trailing :invoke with no completion decodes as a pending op
+    assert h.n_pending == 1
+    v = int(WingGongCPU(memo=True).check_histories(spec, [h])[0])
+    assert v == 1  # LINEARIZABLE
+
+
+def test_golden_porcupine_round_trip_byte_stable():
+    text = _golden(GOLDEN_PORCUPINE)
+    spec = MODELS["kv"].make_spec()
+    rows = parse_trace("porcupine", text, "kv", spec)
+    h = history_from_rows(rows)
+    assert emit_trace("porcupine", h, "kv", spec) == text
+    v = int(WingGongCPU(memo=True).check_histories(spec, [h])[0])
+    assert v == 0  # the seeded stale read on key 1: VIOLATION
+
+
+def test_jepsen_cas_fail_completes_with_failure_response():
+    spec = MODELS["cas"].make_spec()
+    text = ("{:process 0, :type :invoke, :f :cas, :value [1 2]}\n"
+            "{:process 0, :type :fail, :f :cas, :value [1 2]}\n")
+    rows = parse_trace("jepsen", text, "cas", spec)
+    assert rows[0][3] == 0  # cas resp 0 = precondition failed
+    h = history_from_rows(rows)
+    assert emit_trace("jepsen", h, "cas", spec) == text
+    assert int(WingGongCPU().check_histories(spec, [h])[0]) == 1
+
+
+def test_info_leaves_op_pending():
+    spec = MODELS["register"].make_spec()
+    text = ("{:process 0, :type :invoke, :f :write, :value 1}\n"
+            "{:process 0, :type :info, :f :write, :value 1}\n")
+    rows = parse_trace("jepsen", text, "register", spec)
+    h = history_from_rows(rows)
+    assert h.n_pending == 1
+
+
+# --- refusal paths ---------------------------------------------------------
+
+def test_adapter_refuses_unknown_op_and_out_of_domain():
+    spec = MODELS["register"].make_spec()
+    with pytest.raises(IngestError, match="unknown op"):
+        parse_trace("jepsen",
+                    "{:process 0, :type :invoke, :f :append, "
+                    ":value 1}\n", "register", spec)
+    nv = spec.CMDS[0].n_resps
+    with pytest.raises(IngestError, match="outside spec domain"):
+        parse_trace("jepsen",
+                    "{:process 0, :type :invoke, :f :write, "
+                    f":value {nv + 3}}}\n", "register", spec)
+
+
+def test_nemesis_info_lines_are_skipped_in_both_paths():
+    """Real Jepsen logs carry ``:process :nemesis`` lifecycle lines —
+    not history operations.  Both the batch adapter and the live
+    tailer (the ONE shared decode) skip them; a non-integer process on
+    a real op still refuses."""
+    from qsm_tpu.ingest import EventTailer
+
+    spec = MODELS["register"].make_spec()
+    text = ("{:process :nemesis, :type :info, :f :start, :value nil}\n"
+            "{:process 0, :type :invoke, :f :write, :value 1}\n"
+            "{:process :nemesis, :type :info, :f :stop, :value nil}\n"
+            "{:process 0, :type :ok, :f :write, :value 1}\n")
+    rows = parse_trace("jepsen", text, "register", spec)
+    assert len(rows) == 1 and rows[0][:4] == [0, 1, 1, 0]
+    tailer = EventTailer("jepsen", "register", spec)
+    events = []
+    for ln in text.splitlines():
+        events.extend(tailer.events_for_line(ln))
+    assert [e["type"] for e in events] == ["invoke", "respond"]
+    with pytest.raises(IngestError, match="must be an integer"):
+        parse_trace("jepsen",
+                    "{:process :nemesis, :type :invoke, :f :write, "
+                    ":value 1}\n", "register", spec)
+
+
+def test_adapter_refuses_mispaired_events_with_line_context():
+    spec = MODELS["register"].make_spec()
+    with pytest.raises(IngestError, match="line 1"):
+        parse_trace("jepsen",
+                    "{:process 0, :type :ok, :f :read, :value 0}\n",
+                    "register", spec)
+    with pytest.raises(EdnError, match="line 1"):
+        parse_trace("jepsen", "{:process oops}\n", "register", spec)
+
+
+# --- the history_from_rows satellite (deterministic decode) ----------------
+
+def test_history_from_rows_is_permutation_invariant():
+    """The ONE decoder's op order is canonical, not insertion luck:
+    any permutation of the same rows decodes to the same History —
+    same fingerprint, same cache row, same witness indices."""
+    rows = [[0, 1, 1, 0, 0, 3],
+            [1, 0, 0, 1, 1, 2],    # overlaps the write
+            [2, 1, 2, 0, 4, 5],
+            [1, 0, 0, 2, 4, 6]]    # equal invoke_time as row 3
+    base = history_from_rows(rows).fingerprint()
+    import itertools
+
+    for perm in itertools.permutations(rows):
+        assert history_from_rows(list(perm)).fingerprint() == base
+
+
+def test_history_from_rows_refuses_response_before_invocation():
+    with pytest.raises(ValueError, match="precedes invoke_time"):
+        history_from_rows([[0, 1, 1, 0, 5, 3]])
+    # pending rows (sentinel resp) are exempt: they have no response
+    h = history_from_rows([[0, 1, 1, -1, 5, 0]])
+    assert h.n_pending == 1
+
+
+# --- CLI exit codes --------------------------------------------------------
+
+def test_cli_ingest_check_exit_codes(capsys):
+    from qsm_tpu.utils.cli import main
+
+    rc = main(["ingest", GOLDEN_JEPSEN, "--format", "jepsen",
+               "--spec", "register", "--check"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["verdict"] == "LINEARIZABLE"
+    rc = main(["ingest", GOLDEN_PORCUPINE, "--format", "porcupine",
+               "--spec", "kv", "--check"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and out["verdict"] == "VIOLATION"
+
+
+def test_cli_ingest_emit_is_byte_stable(capsys):
+    from qsm_tpu.utils.cli import main
+
+    rc = main(["ingest", GOLDEN_PORCUPINE, "--format", "porcupine",
+               "--spec", "kv", "--emit"])
+    assert rc == 0
+    assert capsys.readouterr().out == _golden(GOLDEN_PORCUPINE)
+
+
+def test_cli_ingest_parse_error_exits_2(tmp_path, capsys):
+    from qsm_tpu.utils.cli import main
+
+    bad = tmp_path / "bad.edn"
+    bad.write_text("{:process 0, :type :invoke, :f :append, "
+                   ":value 1}\n")
+    rc = main(["ingest", str(bad), "--format", "jepsen",
+               "--spec", "register", "--check"])
+    assert rc == 2
+    capsys.readouterr()
+
+
+def test_cli_ingest_out_feeds_check_and_shrink(tmp_path, capsys):
+    """An ingested trace document is an ordinary corpus: the `check`
+    CLI decides it and the in-process `shrink` CLI minimizes it."""
+    from qsm_tpu.utils.cli import main
+
+    out_path = tmp_path / "trace.json"
+    rc = main(["ingest", GOLDEN_PORCUPINE, "--format", "porcupine",
+               "--spec", "kv", "--out", str(out_path)])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["check", "--trace", str(out_path)])
+    assert rc == 1  # the golden's seeded violation
+    capsys.readouterr()
+    rc = main(["shrink", "--trace", str(out_path)])
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and doc["verdict"] == "VIOLATION"
+    assert doc["final_ops"] <= doc["initial_ops"]
+
+
+def test_ingested_trace_accepted_by_submit_and_serve_shrink(tmp_path):
+    """The serve tier takes ingested corpora unchanged: `submit` banks
+    the verdict, the `shrink` verb minimizes the same rows."""
+    from qsm_tpu.serve import CheckClient, CheckServer
+
+    spec = MODELS["kv"].make_spec()
+    rows = parse_trace("porcupine", _golden(GOLDEN_PORCUPINE), "kv",
+                       spec)
+    srv = CheckServer(flush_s=0.005, max_lanes=16).start()
+    try:
+        c = CheckClient(srv.address)
+        res = c.check("kv", [rows])
+        assert res["ok"] and res["verdicts"] == ["VIOLATION"]
+        sh = c.shrink("kv", rows)
+        assert sh["ok"] and sh["verdict"] == "VIOLATION"
+        assert sh["final_ops"] <= sh["initial_ops"]
+        c.close()
+    finally:
+        srv.stop()

@@ -16,6 +16,7 @@ import qsm_tpu.analysis.fixtures as fixtures
 from qsm_tpu.analysis import (ERROR, FAMILIES, Finding, Whitelist,
                               run_lint)
 from qsm_tpu.analysis.engine import (DEFAULT_FLEET_FILES,
+                                     DEFAULT_MONITOR_FILES,
                                      DEFAULT_OBS_FILES,
                                      DEFAULT_OPS_FILES,
                                      DEFAULT_POOL_FILES,
@@ -77,9 +78,13 @@ def test_in_tree_corpus_is_clean(report):
     # replog + the r13 lease/gossip modules + the soak bench
     assert len(DEFAULT_FLEET_FILES) == 6
     assert "fleet" in report.passes
-    # a–j all registered and all ran in the default lane
-    assert sorted(FAMILIES) == list("abcdefghij")
-    assert report.families == list("abcdefghij")
+    # the monitor-session bounds family (k): monitor/ + ingest/ + the
+    # monitor bench driver (ISSUE 14)
+    assert len(DEFAULT_MONITOR_FILES) == 7
+    assert "monitor" in report.passes
+    # a–k all registered and all ran in the default lane
+    assert sorted(FAMILIES) == list("abcdefghijk")
+    assert report.families == list("abcdefghijk")
     assert report.ok, "\n".join(
         f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
 
@@ -284,6 +289,42 @@ def test_fleet_live_tree_is_clean():
     for rel in DEFAULT_FLEET_FILES:
         findings += check_fleet_file(os.path.join(REPO_ROOT, rel),
                                      root=REPO_ROOT)
+    assert findings == []
+
+
+def test_monitor_unbounded_buffer_is_caught():
+    """The monitor pass's bulb check (family k, ISSUE 14): the session
+    stub whose event buffer AND window grow with no cap comparison or
+    eviction fires QSM-MON-UNBOUNDED once per unbounded attribute; the
+    capped/evicting twin (session.py max_events shape + frontier.py
+    decided-prefix reassignment) must NOT be flagged."""
+    from qsm_tpu.analysis.monitor_passes import check_monitor_file
+
+    findings = [f for f in check_monitor_file(fixtures.__file__)
+                if f.rule_id == "QSM-MON-UNBOUNDED"]
+    assert len(findings) == 2  # self.events and self.window
+    assert {f.severity for f in findings} == {ERROR}
+    assert all("UnboundedSessionBufferStub" in f.location
+               for f in findings)
+    assert any("self.events" in f.message for f in findings)
+    assert any("self.window" in f.message for f in findings)
+    assert not any("BoundedSessionBufferStub" in f.location
+                   for f in findings)
+
+
+def test_monitor_live_tree_is_clean():
+    """The monitor plane itself keeps the discipline its pass gates:
+    capped event logs (session.py), capped frontier state sets and
+    decided-prefix window eviction (frontier.py), bounded ingest."""
+    import os
+
+    from qsm_tpu.analysis.engine import REPO_ROOT
+    from qsm_tpu.analysis.monitor_passes import check_monitor_file
+
+    findings = []
+    for rel in DEFAULT_MONITOR_FILES:
+        findings += check_monitor_file(os.path.join(REPO_ROOT, rel),
+                                       root=REPO_ROOT)
     assert findings == []
 
 

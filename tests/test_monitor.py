@@ -1,0 +1,543 @@
+"""Streaming monitor sessions (qsm_tpu/monitor) — the ISSUE 14 gates.
+
+What is pinned, in order of importance:
+
+* STREAMING PARITY: a history fed event-by-event through a session
+  yields the same verdict — and, through the serve path, a
+  bit-identical witness — as the whole-history ``check`` path, across
+  register/cas/queue/kv (per-key composition included), with zero
+  wrong verdicts;
+* INCREMENTALITY: re-feeding a stream resumes every committed cut
+  from the decided-prefix bank with ZERO engine folds (pinned by
+  making the engine fold unreachable), and a one-key kv event
+  re-checks exactly one key's frontier;
+* THE FLIP: a seeded mid-stream violation is pushed on the deciding
+  append with a 1-minimal shrink-plane repro whose certificate
+  replays via ``verify_witness``; a flip is terminal;
+* FLEET RESUME: a session routed through a FleetRouter survives its
+  owning node being SIGKILLed and respawned on the same replog —
+  the replayed journal resumes from the banked decided prefix and the
+  flight dump names the session's trace id;
+* bounds and refusals: session/event caps SHED, gap seqs and
+  backwards timestamps are refused loudly, appends are idempotent
+  under seq replay.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from qsm_tpu.core.spec import projection_report
+from qsm_tpu.models.registry import MODELS
+from qsm_tpu.monitor import (IncrementalFrontier, MonitorSession,
+                             PrefixHasher, SessionError, SessionLimit,
+                             SessionManager, decode_frontier_states,
+                             encode_frontier_states)
+from qsm_tpu.ops.backend import Verdict, verify_witness
+from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+from qsm_tpu.serve import (CheckClient, CheckServer, SessionHandle,
+                           VerdictCache)
+from qsm_tpu.serve.protocol import history_to_rows
+from qsm_tpu.utils.corpus import build_corpus
+from qsm_tpu.utils.report import history_from_rows
+
+FAMILIES = ("register", "cas", "queue", "kv")
+
+
+def _corpus(family, n=8, pids=3, ops=10, prefix="mon"):
+    entry = MODELS[family]
+    spec = entry.make_spec()
+    hists = build_corpus(
+        spec, (entry.impls["atomic"], entry.impls["racy"]), n=n,
+        n_pids=pids, max_ops=ops, seed_prefix=f"{prefix}_{family}")
+    return spec, hists
+
+
+def _proj_for(spec):
+    if projection_report(spec):
+        return None
+    p = spec.projected_spec()
+    return p if p.name in MODELS else None
+
+
+# --- frontier units --------------------------------------------------------
+
+def test_prefix_hasher_is_incremental_and_spec_scoped():
+    spec = MODELS["register"].make_spec()
+    a, b = PrefixHasher(spec), PrefixHasher(spec)
+    h = history_from_rows([[0, 1, 1, 0, 0, 1], [0, 0, 0, 1, 2, 3]])
+    for op in h.ops:
+        a.push(op)
+    # same ops, one at a time with key() peeks in between: the rolling
+    # digest must not depend on when keys were taken
+    mid_keys = []
+    for op in h.ops:
+        b.push(op)
+        mid_keys.append(b.key())
+    assert a.key() == mid_keys[-1]
+    assert len(set(mid_keys)) == len(mid_keys)  # every prefix distinct
+    # a different spec identity hashes into a different domain
+    c = PrefixHasher(MODELS["cas"].make_spec())
+    for op in h.ops:
+        c.push(op)
+    assert c.key() != a.key()
+
+
+def test_frontier_states_round_trip_through_witness_slot():
+    states = {(0, 3), (1, 2), (2, 0)}
+    enc = encode_frontier_states(states)
+    assert decode_frontier_states(enc) == states
+    # the bank load path converts rows to tuples — decode takes both
+    assert decode_frontier_states([tuple(r) for r in enc]) == states
+    # an ordinary witness (op_index, resp) payload is NOT a frontier
+    assert decode_frontier_states([(0, 1), (1, 0)]) is None
+    assert decode_frontier_states(None) is None
+
+
+def test_frontier_commits_cuts_and_evicts_window():
+    spec = MODELS["register"].make_spec()
+    f = IncrementalFrontier(spec)
+    # two sequential writes: each creates a quiescent cut
+    f.invoke(0, 1, 1, 0)
+    f.respond(0, 0, 1)
+    f.invoke(0, 1, 2, 2)
+    f.respond(0, 0, 3)
+    f.invoke(1, 0, 0, 4)   # pending read
+    assert f.advance() == int(Verdict.LINEARIZABLE)
+    assert f.counters.advances >= 1
+    assert f.counters.committed_ops >= 1
+    assert len(f.window) < 3  # decided prefix evicted
+    assert f.check_window() == int(Verdict.LINEARIZABLE)
+
+
+def test_frontier_empty_fold_is_exact_violation():
+    spec = MODELS["register"].make_spec()
+    f = IncrementalFrontier(spec)
+    f.invoke(0, 1, 1, 0)
+    f.respond(0, 0, 1)
+    f.invoke(0, 0, 0, 2)
+    f.respond(0, 2, 3)     # reads 2: impossible after write 1
+    f.invoke(0, 1, 1, 10)  # forces a cut behind the poisoned prefix
+    assert f.advance() == int(Verdict.VIOLATION)
+
+
+# --- streaming parity ------------------------------------------------------
+
+# sized so every family's racy corpus contains at least one violation
+# (the parity sample must not be vacuous) while staying test-lane cheap
+_PARITY_SHAPE = {"register": (16, 4, 12), "cas": (24, 4, 14),
+                 "queue": (8, 3, 10), "kv": (32, 6, 16)}
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_streamed_verdicts_equal_whole_history_check(family):
+    """THE parity pin: event-by-event streaming decides identically to
+    the one-shot oracle on every history of a racy corpus — per-key
+    composition included (kv) — and mid-stream verdicts are exact at
+    every step (a flip only ever fires on a real violation)."""
+    n, pids, ops = _PARITY_SHAPE[family]
+    spec, hists = _corpus(family, n=n, pids=pids, ops=ops)
+    oracle = WingGongCPU(memo=True)
+    want = [int(v) for v in oracle.check_histories(spec, hists)]
+    proj = _proj_for(spec)
+    assert (proj is not None) == (family == "kv")
+    wrong = 0
+    for k, h in enumerate(hists):
+        s = MonitorSession(f"p{k}", spec, proj_spec=proj)
+        for row in history_to_rows(h):
+            s.append([row])
+            s.decide()
+        if s.close() != want[k]:
+            wrong += 1
+    assert wrong == 0
+    assert any(v == int(Verdict.VIOLATION) for v in want)  # not vacuous
+
+
+def test_served_session_witness_bit_identical_to_check(server_pair):
+    """Through the serve path, a streamed session's close witness is
+    BIT-IDENTICAL to `check --witness` of the same history (both ride
+    the same machinery and the same cache row)."""
+    srv, client = server_pair
+    spec, hists = _corpus("cas", n=6)
+    oneshot = client.check("cas", hists, witness=True)
+    assert oneshot["ok"]
+    for h, want_v, want_w in zip(hists, oneshot["verdicts"],
+                                 oneshot["witnesses"]):
+        handle = SessionHandle(client, "cas")
+        for row in history_to_rows(h):
+            handle.append([row])
+        out = handle.close(witness=True)
+        assert out["ok"] and out["verdict"] == want_v
+        assert out.get("witness") == want_w
+        if want_w is not None:
+            assert verify_witness(spec, h,
+                                  [tuple(p) for p in out["witness"]])
+
+
+@pytest.fixture()
+def server_pair():
+    srv = CheckServer(flush_s=0.005, max_lanes=16).start()
+    client = CheckClient(srv.address)
+    yield srv, client
+    client.close()
+    srv.stop()
+
+
+# --- incrementality --------------------------------------------------------
+
+def test_resume_replays_from_bank_with_zero_engine_folds(monkeypatch):
+    """The decided-prefix bank hit pin: re-feeding a stream through a
+    fresh session sharing the bank must commit every cut as a bank hit
+    — the engine fold is made UNREACHABLE, so a single miss fails."""
+    from qsm_tpu.core.history import sequential_history
+
+    spec = MODELS["register"].make_spec()
+    h = sequential_history([(0, 1, 1, 0), (0, 0, 0, 1),
+                            (1, 1, 2, 0), (1, 0, 0, 2)] * 10)
+    rows = history_to_rows(h)
+    bank = VerdictCache(max_entries=4096)
+    s1 = MonitorSession("a", spec, bank=bank)
+    for r in rows:
+        s1.append([r])
+        s1.decide()
+    assert s1.close() == int(Verdict.LINEARIZABLE)
+    c1 = s1.counters()
+    assert c1["advances"] > 10 and c1["prefix_hits"] == 0
+
+    import qsm_tpu.monitor.frontier as frontier_mod
+
+    def _boom(*_a, **_k):
+        raise AssertionError("engine fold reached on a banked resume")
+
+    monkeypatch.setattr(frontier_mod, "_end_states", _boom)
+    s2 = MonitorSession("b", spec, bank=bank)
+    for r in rows:
+        s2.append([r])
+        s2.decide()
+    assert s2.close() == int(Verdict.LINEARIZABLE)
+    c2 = s2.counters()
+    assert c2["advances"] == c1["advances"]
+    assert c2["prefix_hits"] == c2["advances"]
+
+
+def test_one_key_event_rechecks_one_keys_frontier():
+    """The per-key shape: a kv session's append touching key 0 must
+    re-check key 0's window only (pcomp per suffix — the o(n) claim)."""
+    spec = MODELS["kv"].make_spec()
+    proj = _proj_for(spec)
+    assert proj is not None
+    nv = spec.n_values
+    s = MonitorSession("k", spec, proj_spec=proj)
+    # seed three keys with one completed put each — LIVE events, so
+    # every response is final on arrival (row responses wait for the
+    # invoke horizon by design, re-dirtying keys later)
+    for key in (0, 1, 2):
+        s.append([{"type": "invoke", "pid": 0, "cmd": 1,
+                   "arg": key * nv + 1},
+                  {"type": "respond", "pid": 0, "resp": 0}])
+        s.decide()
+    before = {k: f.counters.window_checks
+              for k, f in s._frontiers.items()}
+    s.append([{"type": "invoke", "pid": 1, "cmd": 0, "arg": 0},
+              {"type": "respond", "pid": 1, "resp": 1}])  # get k0 -> 1
+    s.decide()
+    after = {k: f.counters.window_checks
+             for k, f in s._frontiers.items()}
+    assert after[0] == before[0] + 1
+    for k in (1, 2):
+        assert after[k] == before[k]
+
+
+# --- the flip --------------------------------------------------------------
+
+def test_flip_is_pushed_with_minimal_repro_and_certificate(server_pair):
+    srv, client = server_pair
+    spec = MODELS["register"].make_spec()
+    handle = SessionHandle(client, "register")
+    for _ in range(5):
+        handle.append([{"type": "invoke", "pid": 0, "cmd": 1, "arg": 1},
+                       {"type": "respond", "pid": 0, "resp": 0}])
+    assert handle.verdict == "LINEARIZABLE" and not handle.flips
+    out = handle.append([{"type": "invoke", "pid": 1, "cmd": 0,
+                          "arg": 0},
+                         {"type": "respond", "pid": 1, "resp": 2}])
+    assert out["verdict"] == "VIOLATION"
+    flip = out["flip"]
+    assert flip["one_minimal"] and flip["complete"]
+    repro = history_from_rows(flip["repro"])
+    assert len(repro) == flip["final_ops"] <= flip["initial_ops"]
+    # the repro IS a violation
+    assert int(WingGongCPU(memo=True).check_histories(
+        spec, [repro])[0]) == int(Verdict.VIOLATION)
+    # and its certificate replays via verify_witness, independently
+    cert = flip["certificate"]
+    assert cert, "flip carries no certificate"
+    for entry in cert:
+        keep = [i for i in range(len(repro)) if i != entry["drop"]]
+        neighbor = repro.subhistory(keep)
+        w = [tuple(p) for p in entry["witness"]]
+        assert verify_witness(spec, neighbor, w)
+    # terminal: a later append answers flipped, no second payload
+    out2 = handle.append([{"type": "invoke", "pid": 0, "cmd": 1,
+                           "arg": 1},
+                          {"type": "respond", "pid": 0, "resp": 0}])
+    assert out2["verdict"] == "VIOLATION"
+    assert "flip" not in out2 and out2.get("flipped")
+    fin = handle.close()
+    assert fin["verdict"] == "VIOLATION" and fin["flipped"]
+    assert len(handle.flips) == 1
+    # the session block counted the push
+    st = client.stats()["stats"]["session"]
+    assert st["flips_pushed"] == 1
+
+
+def test_flip_dump_fires_on_session_flip(tmp_path):
+    srv = CheckServer(flush_s=0.005,
+                      trace_log=str(tmp_path / "trace.jsonl"),
+                      flight_dir=str(tmp_path / "flight")).start()
+    try:
+        client = CheckClient(srv.address)
+        handle = SessionHandle(client, "register")
+        handle.append([{"type": "invoke", "pid": 0, "cmd": 0, "arg": 0},
+                       {"type": "respond", "pid": 0, "resp": 2}])
+        assert handle.flips
+        dumps = [f for f in os.listdir(tmp_path / "flight")
+                 if "session_flip" in f]
+        assert dumps, "no session_flip flight dump"
+        doc = json.loads((tmp_path / "flight" / dumps[0]).read_text())
+        assert handle.trace in json.dumps(doc)
+        client.close()
+    finally:
+        srv.stop()
+
+
+# --- bounds / refusals -----------------------------------------------------
+
+def test_event_cap_sheds_and_session_cap_sheds():
+    mgr = SessionManager(max_sessions=1, max_events=4)
+    spec = MODELS["register"].make_spec()
+    s, resumed = mgr.open(None, spec, None)
+    assert not resumed
+    with pytest.raises(SessionLimit):
+        mgr.open(None, spec, None)
+    s.append([[0, 1, 1, 0, 2 * i, 2 * i + 1] for i in range(4)])
+    with pytest.raises(SessionLimit):
+        s.append([[0, 1, 1, 0, 10, 11]])
+    # served: the cap answers SHED, never an error or a wrong verdict
+    srv = CheckServer(flush_s=0.005, max_sessions=1).start()
+    try:
+        client = CheckClient(srv.address)
+        a = client.session_open("register")
+        assert a["ok"]
+        b = client.session_open("register")
+        assert b.get("shed") and "session cap" in b["reason"]
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_seq_replay_is_idempotent_and_gaps_refuse():
+    spec = MODELS["register"].make_spec()
+    s = MonitorSession("r", spec)
+    rows = [[0, 1, 1, 0, 0, 1], [0, 0, 0, 1, 2, 3]]
+    assert s.append(rows, seq=0) == 2
+    assert s.append(rows, seq=0) == 0          # full replay: no-op
+    assert s.append([rows[1], [1, 1, 2, 0, 4, 5]], seq=1) == 1
+    with pytest.raises(SessionError, match="gap"):
+        s.append([[1, 0, 0, 2, 6, 7]], seq=99)
+
+
+def test_backwards_time_and_mispaired_events_refuse():
+    spec = MODELS["register"].make_spec()
+    s = MonitorSession("t", spec)
+    s.append([{"type": "invoke", "pid": 0, "cmd": 1, "arg": 1,
+               "t": 10}])
+    with pytest.raises(SessionError, match="runs backwards"):
+        s.append([{"type": "respond", "pid": 0, "resp": 0, "t": 5}])
+    s2 = MonitorSession("t2", spec)
+    with pytest.raises(SessionError, match="no outstanding"):
+        s2.append([{"type": "respond", "pid": 3, "resp": 0}])
+    s3 = MonitorSession("t3", spec)
+    s3.append([[0, 1, 1, 0, 5, 9]])
+    with pytest.raises(SessionError, match="behind the stream"):
+        s3.append([[1, 1, 1, 0, 2, 3]])
+
+
+def test_row_responses_wait_for_the_invoke_horizon():
+    """A recorded row's response is not final until no future op can
+    invoke before it: the overlap case that would otherwise flip
+    prematurely (w(1) invoked inside the read's span fixes it)."""
+    spec = MODELS["register"].make_spec()
+    s = MonitorSession("h", spec)
+    s.append([[0, 0, 0, 1, 0, 10]])      # read->1 spanning [0,10]
+    assert s.decide() != int(Verdict.VIOLATION)  # a write may still come
+    s.append([[1, 1, 1, 0, 2, 3]])       # ...and it does
+    s.decide()
+    assert s.close() == int(Verdict.LINEARIZABLE)
+
+
+# --- the fleet resume acceptance (subprocess node, real SIGKILL) -----------
+
+def _spawn_node(nid: str, tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("QSM_TPU_FAULTS", None)
+    unix = str(tmp_path / f"{nid}.sock")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "qsm_tpu", "serve", "--unix", unix,
+         "--node-id", nid,
+         "--replog-dir", str(tmp_path / f"replog_{nid}")],
+        stdout=subprocess.PIPE, text=True, env=env)
+    banner = json.loads(proc.stdout.readline())
+    assert banner["serving"] == unix
+    return proc, unix
+
+
+def test_sigkill_node_mid_session_resumes_from_banked_prefix(tmp_path):
+    """THE fleet acceptance pin: the owning node is SIGKILLed
+    mid-session and respawned on the same replog; the router replays
+    the journal, the respawned node resumes every previously-committed
+    cut from the BANK (prefix_hits > 0, pinned from the close
+    response), the stream finishes with the exact verdict, and the
+    router's flight dump names the session's trace id."""
+    from qsm_tpu.core.history import sequential_history
+    from qsm_tpu.fleet.router import FleetRouter
+    from qsm_tpu.resilience.policy import preset
+
+    proc, unix = _spawn_node("n0", tmp_path)
+    flight_dir = str(tmp_path / "flight")
+    router = FleetRouter(
+        [("n0", unix)],
+        policy=preset("fleet-route").with_(timeout_s=2.0),
+        probe_policy=preset("fleet-probe").with_(timeout_s=1.0),
+        heartbeat_s=0.5, anti_entropy_s=0.0,
+        trace_log=str(tmp_path / "rt.jsonl"),
+        flight_dir=flight_dir).start()
+    client = None
+    try:
+        client = CheckClient(router.address, timeout_s=15.0)
+        h = sequential_history([(0, 1, 1, 0), (0, 0, 0, 1),
+                                (1, 1, 2, 0), (1, 0, 0, 2)] * 8)
+        rows = history_to_rows(h)
+        handle = SessionHandle(client, "register")
+        half = len(rows) // 2
+        for r in rows[:half]:
+            assert handle.append([r])["ok"]
+        banked = handle.last["decided_prefix"]
+        assert banked > 4  # cuts committed (and banked) pre-kill
+        # SIGKILL the owning node MID-SESSION; the next append must be
+        # observed failing (node.shed -> flight dump naming the
+        # session's trace) and answered SHED, never wrong
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        dead = handle.append([rows[half]])
+        assert dead.get("shed"), dead
+        # respawn the node on the SAME unix socket + replog dir
+        proc, unix2 = _spawn_node("n0", tmp_path)
+        assert unix2 == unix
+        # continue the stream; the router replays through the restart
+        # (a SHED while membership readmits is retried — appends are
+        # idempotent by seq)
+        for r in rows[half:]:
+            for _ in range(60):
+                out = handle.append([r])
+                if out.get("ok"):
+                    break
+                assert out.get("shed"), out
+                time.sleep(0.25)
+            assert out.get("ok"), out
+        fin = handle.close()
+        assert fin["ok"] and fin["verdict"] == "LINEARIZABLE"
+        # the respawned node resumed the replayed prefix from its bank
+        assert fin["prefix_hits"] > 0
+        assert router.session_replays >= 1
+        # the flight dump (node death trigger) names the session trace
+        dumps = sorted(os.listdir(flight_dir))
+        assert dumps, "no flight dump after the node SIGKILL"
+        named = any(handle.trace in (tmp_path / "flight" / d).read_text()
+                    for d in dumps)
+        assert named, f"session trace {handle.trace} not in {dumps}"
+    finally:
+        if client is not None:
+            client.close()
+        router.stop()
+        try:
+            proc.kill()
+            proc.wait(timeout=5)
+        except Exception:
+            pass
+
+
+def test_idle_sessions_are_evicted_at_the_cap():
+    """An abandoned session (crashed client) must not pin its slot
+    forever: at the cap, idle sessions reclaim LRU-first and the next
+    open succeeds; counters fold into the running totals."""
+    mgr = SessionManager(max_sessions=1, idle_s=0.0)
+    spec = MODELS["register"].make_spec()
+    s, _ = mgr.open("dead", spec, None)
+    s.append([[0, 1, 1, 0, 0, 1]])
+    s2, resumed = mgr.open("fresh", spec, None)   # evicts "dead"
+    assert not resumed and s2.sid == "fresh"
+    assert mgr.get("dead") is None
+    t = mgr.totals()
+    assert t["evicted"] == 1 and t["session_events"] == 1
+
+
+def test_router_seqless_append_applies_events_exactly_once():
+    """A seq-less client append through the router must not
+    double-apply: the router journals it, may replay the journal onto
+    the node, and forwards the append seq-stamped with its journal
+    position — the node applies each event exactly once."""
+    from qsm_tpu.core.history import sequential_history
+    from qsm_tpu.fleet.router import FleetRouter
+
+    srv = CheckServer(flush_s=0.005).start()
+    router = FleetRouter([("n0", srv.address)],
+                         heartbeat_s=5.0, anti_entropy_s=0.0).start()
+    client = None
+    try:
+        client = CheckClient(router.address, timeout_s=10.0)
+        h = sequential_history([(0, 1, 1, 0), (0, 0, 0, 1)] * 4)
+        rows = history_to_rows(h)
+        opened = client.session_open("register")
+        sid = opened["session"]
+        total = 0
+        for r in rows:   # NO seq on any append
+            out = client.session_append(sid, [r])
+            assert out["ok"], out
+            total += out["applied"]
+            assert out["seq"] == total  # node counter stays in sync
+        fin = client.session_close(sid)
+        assert fin["ok"] and fin["verdict"] == "LINEARIZABLE"
+        assert fin["ops"] == len(rows)
+    finally:
+        if client is not None:
+            client.close()
+        router.stop()
+        srv.stop()
+
+
+# --- manager accounting ----------------------------------------------------
+
+def test_manager_totals_and_search_stats_agree():
+    mgr = SessionManager()
+    spec = MODELS["register"].make_spec()
+    s, _ = mgr.open("x", spec, None)
+    s.append([[0, 1, 1, 0, 0, 1], [0, 0, 0, 1, 2, 3]])
+    s.decide()
+    t = mgr.totals()
+    st = mgr.search_stats()
+    assert st.session_events == t["session_events"] == 2
+    assert st.frontier_advances == t["frontier_advances"]
+    assert st.prefix_hits == t["prefix_hits"]
+    assert st.flips_pushed == t["flips_pushed"] == 0
+    mgr.close("x")
+    assert mgr.totals()["session_events"] == 2  # folded at close
+    c = st.to_compact()
+    assert c["sev"] == 2 and "fad" in c and "pfh" in c and "flp" in c

@@ -17,8 +17,10 @@ is pinned here field-by-field:
 plus the first-wins strings (``plan``/``fallback_engine``), the OR'd
 ``ordering`` flag, the ``count_histories`` gate, and ``stats_delta``'s
 counter-subtraction with its keep-``after`` exemptions for the
-max/ratio fields.  The new span-bridge counter ``obs_events``
-(compact ``obe``) rides the additive class.
+max/ratio fields.  The span-bridge counter ``obs_events`` (compact
+``obe``) and the four monitor-session counters ``session_events`` /
+``frontier_advances`` / ``flips_pushed`` / ``prefix_hits`` (compact
+``sev``/``fad``/``flp``/``pfh`` — ISSUE 14) ride the additive class.
 """
 
 from __future__ import annotations
@@ -38,7 +40,8 @@ _ADDITIVE = ("lockstep_iters", "nodes_explored", "memo_prunes",
              "segments_total", "degradations", "retries",
              "worker_faults", "node_faults", "pcomp_split", "pcomp_subs",
              "pcomp_recombine_ms", "shrink_rounds", "shrink_lanes",
-             "shrink_memo_hits", "obs_events")
+             "shrink_memo_hits", "obs_events", "session_events",
+             "frontier_advances", "flips_pushed", "prefix_hits")
 
 
 def _filled(base: int) -> SearchStats:
@@ -162,10 +165,14 @@ def test_to_compact_full_key_set_and_values():
     assert sorted(c) == sorted(
         ("iph", "nph", "prunes", "rescued", "segs", "ord", "plan",
          "deg", "fb", "wf", "ndf", "pcs", "pcn", "pcm", "shr", "shl",
-         "shm", "sho", "obe"))
+         "shm", "sho", "obe", "sev", "fad", "flp", "pfh"))
     assert c["pcm"] == st.pcomp_max_sub
     assert c["sho"] == st.shrink_ratio_pct
     assert c["obe"] == st.obs_events
+    assert c["sev"] == st.session_events
+    assert c["fad"] == st.frontier_advances
+    assert c["flp"] == st.flips_pushed
+    assert c["pfh"] == st.prefix_hits
     assert c["wf"] == st.worker_faults
     assert c["ndf"] == st.node_faults
     assert c["iph"] == round(st.lockstep_iters / st.histories, 1)
@@ -182,6 +189,7 @@ def test_to_timings_gates_optional_blocks():
     assert "pcomp_subs" not in t
     assert "shrink_rounds" not in t
     assert "obs_events" not in t
+    assert "session_events" not in t
     assert "resilience_degradations" not in t
     full = _filled(2)
     t2 = full.to_timings()
@@ -189,6 +197,9 @@ def test_to_timings_gates_optional_blocks():
     assert t2["shrink_ratio"] == round(full.shrink_ratio_pct / 100, 3)
     assert t2["obs_events"] == float(full.obs_events)
     assert t2["resilience_worker_faults"] == float(full.worker_faults)
+    assert t2["session_events"] == float(full.session_events)
+    assert t2["prefix_hits"] == float(full.prefix_hits)
+    assert t2["flips_pushed"] == float(full.flips_pushed)
 
 
 def test_absorb_round_trips_through_collect_composition():

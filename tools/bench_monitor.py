@@ -1,0 +1,284 @@
+"""Monitor bench — what does streaming buy over re-checking from scratch?
+
+ISSUE 14's acceptance bars, as journal cells:
+
+* ``streamed_growing``  — a growing EVENTS-event register stream fed
+  chunk-by-chunk through an in-process ``MonitorSession`` (decide after
+  every chunk — the live-monitor cadence).  The incremental frontier
+  commits quiescent cuts as they appear, so each re-decide touches the
+  o(n) open window only.
+* ``scratch_growing``   — the same stream re-checked FROM SCRATCH at
+  every chunk boundary (fresh memoised oracle per re-check: the cost a
+  session-less serve tier would pay).  The headline ratio
+  ``scratch_s / streamed_s`` is the incrementality measurement; the
+  gate is streamed strictly cheaper (expected: orders of magnitude on
+  1k events).
+* ``resume_banked``     — the SAME stream replayed into a fresh session
+  sharing the first run's verdict cache: every cut must resume from the
+  decided-prefix bank (``prefix_hits == advances``, zero engine folds)
+  — the node-restart path priced.
+* ``flip_latency``      — a served session (CheckServer ``session.*``
+  ops) fed a stream with a seeded mid-stream violation; measures
+  append→flip-response wall clock (the flip carries the minimized
+  repro, so this prices detection + shrink + certificate).
+* ``parity_soak``       — streamed event-by-event verdicts vs the
+  one-shot host ladder across register/cas/queue/kv racy corpora;
+  ``wrong_verdicts`` MUST be 0 (the zero-wrong acceptance bar).
+
+Output: resumable ``CellJournal`` committed as
+``BENCH_MONITOR_<tag>.json`` (``make bench-monitor``; probe_watcher
+archives it off-window beside the LINT/PCOMP/SHRINK/OBS artifacts and
+``bench_report.py`` folds it into BENCH_REPORT.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+EVENTS = 1000          # the growing-history cell's stream length
+CHUNK = 20             # events appended per decide
+FLIP_REPS = 5
+PARITY_N = 10          # histories per family in the parity soak
+FAMILIES = ("register", "cas", "queue", "kv")
+
+
+def _stream_rows(n_ops: int):
+    """A mostly-sequential register stream with overlap bursts: long
+    quiescent runs (the monitor's friendly case) interrupted by real
+    concurrency every 8 ops so windows are exercised too."""
+    rows = []
+    t = 0
+    for i in range(n_ops):
+        val = (i % 3) + 1
+        if i % 8 == 7:
+            # one overlapping pair: two pids in flight at once
+            rows.append([0, 1, val, 0, t, t + 3])
+            rows.append([1, 0, 0, val, t + 1, t + 2])
+            t += 4
+        else:
+            cmd = 1 if i % 2 == 0 else 0
+            arg = val if cmd == 1 else 0
+            resp = 0 if cmd == 1 else rows[-1][2] if rows else 0
+            if cmd == 0:
+                # read back the last written value (linearizable)
+                last_w = next((r[2] for r in reversed(rows)
+                               if r[1] == 1), 0)
+                resp = last_w
+            rows.append([0, cmd, arg, resp, t, t + 1])
+            t += 2
+    return rows
+
+
+def _cell_streamed(spec, rows, bank) -> dict:
+    from qsm_tpu.monitor import MonitorSession
+
+    s = MonitorSession("bench", spec, bank=bank)
+    t0 = time.perf_counter()
+    for i in range(0, len(rows), CHUNK):
+        s.append(rows[i:i + CHUNK])
+        s.decide()
+    v = s.close()
+    dt = time.perf_counter() - t0
+    c = s.counters()
+    return {"seconds": round(dt, 4), "verdict": int(v),
+            "events": c["events"], "advances": c["advances"],
+            "prefix_hits": c["prefix_hits"],
+            "window_checks": c["window_checks"],
+            "decides": -(-len(rows) // CHUNK),
+            "search": s_stats(c)}
+
+
+def s_stats(c) -> dict:
+    """The compact monitor record bench rows embed (SearchStats keys)."""
+    from qsm_tpu.search.stats import SearchStats
+
+    return SearchStats(engine="monitor", session_events=c["events"],
+                       frontier_advances=c["advances"],
+                       prefix_hits=c["prefix_hits"]).to_compact()
+
+
+def _cell_scratch(spec, rows) -> dict:
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+    from qsm_tpu.utils.report import history_from_rows
+
+    t0 = time.perf_counter()
+    nodes = 0
+    v = 1
+    for i in range(CHUNK, len(rows) + CHUNK, CHUNK):
+        oracle = WingGongCPU(memo=True)   # fresh: no cross-check memo
+        h = history_from_rows(rows[:i])
+        v = int(oracle.check_histories(spec, [h])[0])
+        nodes += oracle.nodes_explored
+    dt = time.perf_counter() - t0
+    return {"seconds": round(dt, 4), "verdict": v,
+            "nodes_explored": nodes,
+            "rechecks": -(-len(rows) // CHUNK)}
+
+
+def _cell_flip(workdir: str) -> dict:
+    from qsm_tpu.serve.client import CheckClient, SessionHandle
+    from qsm_tpu.serve.server import CheckServer
+
+    lat = []
+    shrunk = []
+    for rep in range(FLIP_REPS):
+        server = CheckServer(flush_s=0.005, max_lanes=16).start()
+        try:
+            client = CheckClient(f"127.0.0.1:{server.port}")
+            h = SessionHandle(client, "register")
+            # a clean prefix (writes of 1) as LIVE events — the
+            # monitor cadence: a respond is final on arrival
+            for _ in range(6):
+                h.append([{"type": "invoke", "pid": 0, "cmd": 1,
+                           "arg": 1},
+                          {"type": "respond", "pid": 0, "resp": 0}])
+            t0 = time.perf_counter()
+            out = h.append([{"type": "invoke", "pid": 1, "cmd": 0,
+                             "arg": 0},
+                            {"type": "respond", "pid": 1,
+                             "resp": 2}])  # reads unwritten 2
+            dt = time.perf_counter() - t0
+            assert out.get("flip"), out
+            lat.append(dt)
+            shrunk.append(out["flip"]["final_ops"])
+            h.close()
+            client.close()
+        finally:
+            server.stop()
+    lat.sort()
+    return {"reps": FLIP_REPS,
+            "flip_latency_p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+            "flip_latency_max_ms": round(lat[-1] * 1e3, 2),
+            "repro_final_ops": shrunk}
+
+
+def _cell_parity() -> dict:
+    from qsm_tpu.core.spec import projection_report
+    from qsm_tpu.models.registry import MODELS
+    from qsm_tpu.monitor import MonitorSession
+    from qsm_tpu.resilience.failover import host_fallback
+    from qsm_tpu.serve.protocol import history_to_rows
+    from qsm_tpu.utils.corpus import build_corpus
+
+    wrong = 0
+    checked = 0
+    per_family = {}
+    for fam in FAMILIES:
+        entry = MODELS[fam]
+        spec = entry.make_spec()
+        hists = build_corpus(
+            spec, (entry.impls["atomic"], entry.impls["racy"]),
+            n=PARITY_N, n_pids=3, max_ops=10,
+            seed_prefix=f"bench_mon_{fam}")
+        ladder = host_fallback(spec)
+        want = [int(v) for v in ladder.check_histories(spec, hists)]
+        proj = None
+        if not projection_report(spec):
+            p = spec.projected_spec()
+            if p.name in MODELS:
+                proj = p
+        fam_wrong = 0
+        for k, h in enumerate(hists):
+            s = MonitorSession(f"par{k}", spec, proj_spec=proj)
+            for row in history_to_rows(h):
+                s.append([row])
+                s.decide()
+            got = s.close()
+            checked += 1
+            if got != want[k]:
+                fam_wrong += 1
+        wrong += fam_wrong
+        per_family[fam] = {"histories": len(hists), "wrong": fam_wrong,
+                           "per_key": proj is not None}
+    return {"histories": checked, "wrong_verdicts": wrong,
+            "families": per_family}
+
+
+def run(tag: str, out_path, resume: bool) -> dict:
+    from qsm_tpu.models.registry import MODELS
+    from qsm_tpu.resilience.checkpoint import CellJournal
+    from qsm_tpu.serve.cache import VerdictCache
+
+    path = out_path or os.path.join(REPO, f"BENCH_MONITOR_{tag}.json")
+    header = {
+        "artifact": "BENCH_MONITOR",
+        "device_fallback": None,   # host-only bench: no window involved
+        "platform": "cpu",
+        "events": EVENTS, "chunk": CHUNK,
+        "flip_reps": FLIP_REPS, "parity_n": PARITY_N,
+        "families": list(FAMILIES),
+        "captured_iso": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+    }
+    journal = CellJournal(path, header, resume=resume)
+    spec = MODELS["register"].make_spec()
+    rows = _stream_rows(EVENTS // 2)   # 2 events (inv+resp) per op
+    bank = VerdictCache(max_entries=65_536)
+
+    streamed = journal.complete("streamed_growing")
+    resume_row = journal.complete("resume_banked")
+    if streamed is None or resume_row is None:
+        # the two cells share one bank: resume must replay THIS run
+        streamed = journal.emit("streamed_growing",
+                                _cell_streamed(spec, rows, bank))
+        resume_row = journal.emit("resume_banked",
+                                  _cell_streamed(spec, rows, bank))
+    scratch = journal.complete("scratch_growing")
+    if scratch is None:
+        scratch = journal.emit("scratch_growing",
+                               _cell_scratch(spec, rows))
+    flip = journal.complete("flip_latency")
+    if flip is None:
+        flip = journal.emit("flip_latency", _cell_flip(""))
+    parity = journal.complete("parity_soak")
+    if parity is None:
+        parity = journal.emit("parity_soak", _cell_parity())
+
+    ratio = (scratch["seconds"] / streamed["seconds"]
+             if streamed["seconds"] else float("inf"))
+    summary = {
+        "events": EVENTS,
+        "streamed_s": streamed["seconds"],
+        "scratch_s": scratch["seconds"],
+        "scratch_over_streamed": round(ratio, 1),
+        "resume_prefix_hits": resume_row["prefix_hits"],
+        "resume_advances": resume_row["advances"],
+        "resume_all_banked": (resume_row["prefix_hits"]
+                              == resume_row["advances"]
+                              and resume_row["advances"] > 0),
+        "flip_latency_p50_ms": flip["flip_latency_p50_ms"],
+        "wrong_verdicts": parity["wrong_verdicts"],
+        # the gates: streamed strictly cheaper than scratch on the
+        # growing history, every resumed cut a bank hit, zero wrong
+        "gate_ok": (ratio > 2.0
+                    and resume_row["prefix_hits"]
+                    == resume_row["advances"]
+                    and parity["wrong_verdicts"] == 0),
+    }
+    if journal.complete("summary") is None:
+        journal.emit("summary", summary)
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tag", default="r14")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--resume", action="store_true",
+                    help="skip cells already banked in a compatible "
+                         "prior artifact (CellJournal rails)")
+    args = ap.parse_args(argv)
+    summary = run(args.tag, args.out, args.resume)
+    print(summary)
+    return 0 if summary["gate_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

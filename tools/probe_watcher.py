@@ -80,7 +80,7 @@ CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 # interprocedural race analyzer), independent of the window artifacts'
 # ROUND_TAG — renaming those retires banked measurements, renaming this
 # just says which rule set produced the findings.
-LINT_ROUND = "r13"  # family (j) + QSM-FLEET-LEASE (router HA) — r13
+LINT_ROUND = "r14"  # family (k) QSM-MON-UNBOUNDED (monitor plane) — r14
 LINT_ARTIFACT = os.path.join(REPO, f"LINT_{LINT_ROUND}.json")
 
 # Committed archive of the P-compositionality bench (tools/
@@ -129,6 +129,19 @@ FLEET_ARTIFACT = os.path.join(REPO, f"BENCH_FLEET_{FLEET_ROUND}.json")
 # gossip cells (r13) + summary
 FLEET_MIN_ROWS = 11
 _FLEET_STATE: dict = {"attempted": False}
+
+# Committed archive of the monitor bench (tools/bench_monitor.py):
+# HOST-ONLY like the other off-window gates — a growing event stream
+# decided incrementally vs from scratch, decided-prefix bank resume,
+# flip-to-push latency, streamed-vs-oneshot parity — refreshed
+# off-window on CellJournal --resume rails.  Tracks its own round tag
+# (the monitor plane landed in r14).
+MONITOR_ROUND = "r14"
+MONITOR_ARTIFACT = os.path.join(REPO,
+                                f"BENCH_MONITOR_{MONITOR_ROUND}.json")
+# full scan = streamed + resume + scratch + flip + parity + summary
+MONITOR_MIN_ROWS = 6
+_MONITOR_STATE: dict = {"attempted": False}
 
 # Cached verdict of the pre-seize lint gate, keyed on a SOURCE
 # fingerprint — not process lifetime: the watcher runs all round while
@@ -327,6 +340,15 @@ def _maybe_archive_fleet(timeout: float = 1200.0) -> None:
     lost verdicts) archived beside the other host-only gates."""
     _maybe_archive(_FLEET_STATE, FLEET_ARTIFACT, "bench_fleet.py",
                    FLEET_MIN_ROWS, "fleet_bench", timeout)
+
+
+def _maybe_archive_monitor(timeout: float = 900.0) -> None:
+    """The monitor bench artifact (tools/bench_monitor.py): the
+    streamed-vs-scratch incrementality ratio, the decided-prefix bank
+    resume and the flip-to-push latency archived beside the other
+    host-only gates."""
+    _maybe_archive(_MONITOR_STATE, MONITOR_ARTIFACT, "bench_monitor.py",
+                   MONITOR_MIN_ROWS, "monitor_bench", timeout)
 
 
 def _run_window_bench(bench_timeout: float, extra_args, label: str,
@@ -712,6 +734,7 @@ def main() -> int:
         _maybe_archive_shrink()
         _maybe_archive_obs()
         _maybe_archive_fleet()
+        _maybe_archive_monitor()
     while True:
         t0 = time.time()
         _maybe_compact_probe_log()  # bounded; no-op below the threshold
