@@ -2,7 +2,7 @@
 #
 # `lint-gate` is the static-analysis gate: it runs every registered
 # qsmlint pass family (a–k, docs/ANALYSIS.md) over the full tree,
-# archives the JSON findings document to LINT_r14.json (the artifact
+# archives the JSON findings document to LINT_r15.json (the artifact
 # probe_watcher also refreshes before every window seize) and FAILS
 # (exit 1) on any non-whitelisted error-severity finding.  The on-disk
 # result cache (.qsmlint-cache.json) keeps a warm full-tree run in the
@@ -11,7 +11,7 @@
 PYTHON ?= python
 # keep in lockstep with tools/probe_watcher.py LINT_ROUND (the watcher
 # archives the same document before every window seize)
-LINT_ARTIFACT ?= LINT_r14.json
+LINT_ARTIFACT ?= LINT_r15.json
 
 # P-compositionality bench (tools/bench_pcomp.py): host-only — no TPU
 # window needed — on CellJournal --resume rails; refreshes the
@@ -28,8 +28,10 @@ SHRINK_ARTIFACT ?= BENCH_SHRINK_r10.json
 # Obs-overhead bench (tools/bench_obs.py): host-only, CellJournal
 # --resume rails; refreshes the committed BENCH_OBS artifact (serve
 # path with obs absent / tracing off / tracing on — the ≤5%
-# tracing-off gate of docs/OBSERVABILITY.md)
-OBS_ARTIFACT ?= BENCH_OBS_r11.json
+# tracing-off gate of docs/OBSERVABILITY.md — plus the r15 fleet
+# cells: span collection on/off through a 2-node router and the
+# federated /metrics scrape latency)
+OBS_ARTIFACT ?= BENCH_OBS_r15.json
 
 # Fleet soak (tools/bench_fleet.py): host-only, CellJournal --resume
 # rails; refreshes the committed BENCH_FLEET artifact (1/2/3-node

@@ -110,6 +110,11 @@ DEFAULT_RACE_FILES = (
     # threads while the manager's totals and the router's journals are
     # read from stats/replay paths — same closed program
     "qsm_tpu/monitor/frontier.py", "qsm_tpu/monitor/session.py",
+    # the fleet-observability plane: the collector's sweep runs on the
+    # router's beat thread while obs.trace readers and the federation
+    # fan-out run on connection threads; the SLO evaluator is hit from
+    # health ops, metrics scrapes and the breach trigger concurrently
+    "qsm_tpu/obs/collect.py", "qsm_tpu/obs/slo.py",
     "tools/bench_serve.py", "tools/bench_pcomp.py",
     "tools/bench_shrink.py", "tools/bench_fleet.py",
     "tools/probe_watcher.py", "tools/soak_prune.py")
@@ -138,12 +143,16 @@ DEFAULT_MONITOR_FILES = (
     "tools/bench_monitor.py")
 
 # the trace-plane discipline beat (family i): everything that opens
-# spans or writes metrics — the obs plane itself, the serving stack
-# that emits through it, and the resilience layers that report into
-# the global sink
+# spans or writes metrics — the obs plane itself (collection and SLO
+# modules included), the serving stack that emits through it, the
+# resilience layers that report into the global sink, and — since the
+# plane went fleet-wide (ISSUE 15) — the fleet tier, the monitor
+# sessions and the ingest adapters, whose emit sites previously sat
+# outside the family's gate
 DEFAULT_OBS_FILES = (
     "qsm_tpu/obs/__init__.py", "qsm_tpu/obs/trace.py",
     "qsm_tpu/obs/metrics.py", "qsm_tpu/obs/flight.py",
+    "qsm_tpu/obs/collect.py", "qsm_tpu/obs/slo.py",
     "qsm_tpu/serve/server.py", "qsm_tpu/serve/batcher.py",
     "qsm_tpu/serve/admission.py", "qsm_tpu/serve/cache.py",
     "qsm_tpu/serve/client.py", "qsm_tpu/serve/protocol.py",
@@ -151,7 +160,14 @@ DEFAULT_OBS_FILES = (
     "qsm_tpu/serve/frames.py",
     "qsm_tpu/resilience/policy.py", "qsm_tpu/resilience/failover.py",
     "qsm_tpu/resilience/faults.py", "qsm_tpu/resilience/checkpoint.py",
-    "tools/bench_obs.py")
+    "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/membership.py",
+    "qsm_tpu/fleet/replog.py", "qsm_tpu/fleet/lease.py",
+    "qsm_tpu/fleet/gossip.py",
+    "qsm_tpu/monitor/frontier.py", "qsm_tpu/monitor/session.py",
+    "qsm_tpu/ingest/adapters.py", "qsm_tpu/ingest/edn.py",
+    "qsm_tpu/ingest/specmap.py", "qsm_tpu/ingest/tail.py",
+    "tools/bench_obs.py", "tools/bench_fleet.py",
+    "tools/bench_monitor.py")
 
 
 def default_whitelist_path() -> str:

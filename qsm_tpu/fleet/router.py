@@ -307,7 +307,11 @@ class FleetRouter:
                  trace_log: Optional[str] = None,
                  flight_dir: Optional[str] = None,
                  metrics_port: Optional[int] = None,
-                 obs: Optional[Observability] = None):
+                 obs: Optional[Observability] = None,
+                 collect_dir: Optional[str] = None,
+                 collect_s: float = 1.0,
+                 slo: Optional[str] = None,
+                 slo_window_s: float = 60.0):
         from .membership import Membership
 
         if not nodes:
@@ -402,10 +406,50 @@ class FleetRouter:
         else:
             self.ha_grace_s = 0.0
             self._beat_s = anti_entropy_s
+        # the span id of the event that opened THIS router's current
+        # term (router.elect / router.takeover / router.superseded):
+        # every route.request roots under it, so `qsm-tpu trace` pulls
+        # the fleet-level cause (the takeover) into a request's tree
+        # via the causal closure — edges, never cross-process clocks
+        self._term_span = ""
+        # fleet-wide span collection (obs/collect.py): a dedicated
+        # loop pulls every node's span log into ONE collected log with
+        # per-node cursors persisted under collect_dir — what `qsm-tpu
+        # trace <id> --addr ROUTER` reconstructs cross-process trees
+        # from.  Its OWN thread, never the lease beat's: a wedged
+        # node's scrape timeout must not delay lease renewal into a
+        # spurious takeover.  NOT gated on the lease either: a standby
+        # keeps its collected log warm, so a takeover does not lose
+        # the old era's node spans.
+        self.collector = None
+        self.collect_s = max(0.1, float(collect_s))
+        if collect_dir is not None:
+            from ..obs import SpanCollector
+
+            self.collector = SpanCollector(collect_dir)
         self._m_route_s = self.obs.metrics.histogram(
             "qsm_fleet_route_seconds",
-            "router end-to-end request latency")
+            "router end-to-end request latency, labeled by verb")
         self.obs.metrics.register_collector(self._metric_samples)
+        # metrics federation (docs/OBSERVABILITY.md "Fleet"): the
+        # router's /metrics scrape fans out obs.metrics to every node
+        # at scrape time and re-labels the samples with `node` — down
+        # nodes become a staleness gauge, never a hang (bounded
+        # round-trips, parallel fan-out)
+        self.obs.metrics.register_collector(self._federated_samples)
+        # SLO plane (obs/slo.py): same shape as CheckServer's — the
+        # router's own per-verb route latency + shed counters under
+        # declared objectives, health op + slo.breach flight trigger
+        self.slo = None
+        if slo:
+            from ..obs import SloEvaluator, parse_slo
+
+            self.slo = SloEvaluator(
+                parse_slo(slo), latency_hist=self._m_route_s,
+                requests_fn=lambda: self.requests,
+                sheds_fn=self._shed_total, window_s=slo_window_s,
+                on_breach=self._on_slo_breach)
+            self.obs.metrics.register_collector(self.slo.metric_samples)
 
     # -- lifecycle -----------------------------------------------------
     @property
@@ -459,6 +503,11 @@ class FleetRouter:
                                  daemon=True, name="qsm-fleet-beat")
             t.start()
             self._threads.append(t)
+        if self.collector is not None:
+            t = threading.Thread(target=self._collect_loop,
+                                 daemon=True, name="qsm-fleet-collect")
+            t.start()
+            self._threads.append(t)
         return self
 
     def stop(self) -> None:
@@ -487,7 +536,13 @@ class FleetRouter:
             link.close_all()
         if first_stop:
             self.obs.dump_flight("router_stop", force=True)
+        if self.collector is not None:
+            self.collector.close()
         self.obs.metrics.unregister_collector(self._metric_samples)
+        self.obs.metrics.unregister_collector(self._federated_samples)
+        if self.slo is not None:
+            self.obs.metrics.unregister_collector(
+                self.slo.metric_samples)
         if self._metrics_server is not None:
             self._metrics_server.stop()
             self._metrics_server = None
@@ -557,6 +612,17 @@ class FleetRouter:
             return
         if op == "stats":
             self._send(conn, {"ok": True, "stats": self.stats()})
+        elif op in ("obs.spans", "obs.trace", "obs.metrics", "health"):
+            # the observability surface stays up whatever the lease
+            # says: a standby's collected log and health answer are
+            # exactly what an operator needs mid-takeover
+            try:
+                self._handle_obs(conn, op, req)
+            except OSError:
+                raise
+            except Exception as e:  # noqa: BLE001 — answer, don't die
+                self._send(conn, {"id": req.get("id"), "ok": False,
+                                  "error": f"{type(e).__name__}: {e}"})
         elif op == "shutdown":
             if self.allow_shutdown:
                 self._send(conn, {"ok": True, "stopping": True})
@@ -692,8 +758,8 @@ class FleetRouter:
         if self.obs.on:
             root = new_span_id()
             self.obs.tracer.emit("route.request", trace=trace,
-                                 span=root, model=model,
-                                 lanes=len(hists))
+                                 span=root, parent=self._term_span,
+                                 model=model, lanes=len(hists))
         with self._lock:
             self.requests += 1
             self.histories += len(hists)
@@ -830,9 +896,14 @@ class FleetRouter:
                 return res  # deadline: undecided lanes stay None (shed)
             tried.add(target)
             timeout_s = min(self.policy.timeout_s or 30.0, remaining)
-            self.obs.event("node.dispatch", trace=trace, parent=root,
-                           node=target, lanes=len(hists),
-                           traces=[trace])
+            dispatch_span = self.obs.event(
+                "node.dispatch", trace=trace, parent=root,
+                node=target, lanes=len(hists), traces=[trace])
+            if dispatch_span:
+                # the node's own `request` root parents under THIS
+                # dispatch edge, so the collected fleet tree shows
+                # router -> node causally (docs/OBSERVABILITY.md)
+                subreq["parent"] = dispatch_span
             try:
                 resp = self.links[target].request(subreq, timeout_s)
             except NodeBusy:
@@ -950,14 +1021,15 @@ class FleetRouter:
         if self.obs.on:
             root = new_span_id()
             self.obs.tracer.emit("route.request", trace=trace,
-                                 span=root, model=model, op="shrink",
-                                 ops=len(h))
+                                 span=root, parent=self._term_span,
+                                 model=model, op="shrink", ops=len(h))
         with self._lock:
             self.requests += 1
             self.shrink_requests += 1
         if not self.admission.try_admit(1):
             self._respond(conn, self._shed(req, "queue full", trace,
-                                           root), trace, root, t_req)
+                                           root), trace, root,
+                          t_req, verb='shrink')
             return
         try:
             subreq = {**req, "trace": trace}
@@ -977,9 +1049,11 @@ class FleetRouter:
                 # still banks its result for the re-ask to hit)
                 timeout_s = min(self.policy.timeout_s or 30.0,
                                 remaining)
-                self.obs.event("node.dispatch", trace=trace,
-                               parent=root, node=target, op="shrink",
-                               traces=[trace])
+                dispatch_span = self.obs.event(
+                    "node.dispatch", trace=trace, parent=root,
+                    node=target, op="shrink", traces=[trace])
+                if dispatch_span:
+                    subreq["parent"] = dispatch_span
                 try:
                     resp = self.links[target].request(subreq, timeout_s)
                 except NodeBusy:
@@ -998,13 +1072,13 @@ class FleetRouter:
                         doc["node_faults"] = faults
                     self._respond(conn, doc, trace, root, t_req,
                                   status=("shed" if resp.get("shed")
-                                          else "ok"))
+                                          else "ok"), verb='shrink')
                     return
                 break  # clean error answer: the ladder will say why
             doc = self._ladder_shrink(req, model, spec_kwargs, h,
                                       deadline, trace, root, faults,
                                       t_req)
-            self._respond(conn, doc, trace, root, t_req)
+            self._respond(conn, doc, trace, root, t_req, verb='shrink')
         finally:
             self.admission.release(1)
 
@@ -1070,8 +1144,8 @@ class FleetRouter:
         if self.obs.on:
             root = new_span_id()
             self.obs.tracer.emit("route.request", trace=trace,
-                                 span=root, op=op,
-                                 session=req.get("session"))
+                                 span=root, parent=self._term_span,
+                                 op=op, session=req.get("session"))
         with self._lock:
             self.requests += 1
             self.session_requests += 1
@@ -1106,7 +1180,7 @@ class FleetRouter:
                     if len(self._sessions) >= self.max_sessions:
                         self._respond(conn, self._shed(
                             req, "session cap", trace, root), trace,
-                            root, t_req)
+                            root, t_req, verb='session')
                         return
                     if sid is None:
                         self._session_n += 1
@@ -1126,7 +1200,7 @@ class FleetRouter:
         if not self.admission.try_admit(1):
             self._respond(conn, {**self._shed(req, "queue full", trace,
                                               root), "session":
-                                 sess.sid}, trace, root, t_req)
+                                 sess.sid}, trace, root, t_req, verb='session')
             return
         try:
             from ..monitor import SessionLimit
@@ -1147,7 +1221,8 @@ class FleetRouter:
                 with self._sessions_lock:
                     self._sessions.pop(sess.sid, None)
             self._respond(conn, doc, trace, root, t_req,
-                          status="shed" if doc.get("shed") else "ok")
+                          status="shed" if doc.get("shed") else "ok",
+                          verb='session')
         finally:
             self.admission.release(1)
 
@@ -1198,9 +1273,11 @@ class FleetRouter:
                 return None
             tried.add(target)
             timeout_s = min(self.policy.timeout_s or 30.0, remaining)
-            self.obs.event("node.dispatch", trace=trace, parent=root,
-                           node=target, op=op, session=sess.sid,
-                           traces=[trace])
+            dispatch_span = self.obs.event(
+                "node.dispatch", trace=trace, parent=root,
+                node=target, op=op, session=sess.sid, traces=[trace])
+            if dispatch_span:
+                subreq["parent"] = dispatch_span
             try:
                 if target != sess.node:
                     # a fresh owner (first dispatch, or post-failover):
@@ -1246,24 +1323,29 @@ class FleetRouter:
         """Re-establish a journaled session on ``target`` (link faults
         propagate to the caller's failover loop)."""
         link = self.links[target]
-        opened = link.request({"op": "session.open", "id": "fleet-sub",
-                               "model": sess.model,
-                               "spec_kwargs": sess.spec_kwargs,
-                               "session": sess.sid, "trace": trace},
-                              timeout_s)
+        open_doc = {"op": "session.open", "id": "fleet-sub",
+                    "model": sess.model,
+                    "spec_kwargs": sess.spec_kwargs,
+                    "session": sess.sid, "trace": trace}
+        if root:
+            open_doc["parent"] = root
+        opened = link.request(open_doc, timeout_s)
         if not opened.get("ok"):
             raise NodeFault(f"node {target}: session.open refused: "
                             f"{opened.get('error') or opened}")
         if sess.events:
             with self._lock:
                 self.session_replays += 1
-            self.obs.event("session.replay", trace=trace, parent=root,
-                           session=sess.sid, node=target,
-                           events=len(sess.events))
-            replayed = link.request(
-                {"op": "session.append", "id": "fleet-sub",
-                 "session": sess.sid, "seq": 0,
-                 "events": sess.events, "trace": trace}, timeout_s)
+            replay_span = self.obs.event(
+                "session.replay", trace=trace, parent=root,
+                session=sess.sid, node=target,
+                events=len(sess.events))
+            replay_doc = {"op": "session.append", "id": "fleet-sub",
+                          "session": sess.sid, "seq": 0,
+                          "events": sess.events, "trace": trace}
+            if replay_span:
+                replay_doc["parent"] = replay_span
+            replayed = link.request(replay_doc, timeout_s)
             if not replayed.get("ok"):
                 raise NodeFault(
                     f"node {target}: session replay refused: "
@@ -1286,7 +1368,8 @@ class FleetRouter:
         return doc
 
     def _respond(self, conn, doc: dict, trace: str, root: str,
-                 t_req: float, status: str = "ok") -> None:
+                 t_req: float, status: str = "ok",
+                 verb: str = "check") -> None:
         if doc.get("shed") and status == "ok":
             # every shed — admission-driven included — must close its
             # causal tree as a shed, or span tooling undercounts them
@@ -1298,7 +1381,7 @@ class FleetRouter:
                                  ms=round(dt * 1000.0, 3),
                                  status=status,
                                  shed=bool(doc.get("shed")))
-        self._m_route_s.observe(dt)
+        self._m_route_s.observe(dt, verb=verb)
         self._send(conn, doc)
 
     # -- the HA lease (fleet/lease.py; module docstring) ---------------
@@ -1367,14 +1450,17 @@ class FleetRouter:
             # the takeover span (the bench/test acceptance: `qsm-tpu
             # trace` shows it with the superseded term) — also a
             # flight-dump trigger (obs._DUMP_TRIGGERS), so a takeover
-            # leaves an artifact naming what the new active saw
-            self.obs.event(
+            # leaves an artifact naming what the new active saw.  Its
+            # span id becomes the term's root edge: every request this
+            # term serves parents under it, so the causal closure of
+            # any post-takeover trace includes the takeover itself.
+            self._term_span = self.obs.event(
                 "router.takeover", node=self.node_id, term=self.term,
                 superseded_term=superseded.get("term"),
                 superseded_holder=superseded.get("holder"))
         else:
-            self.obs.event("router.elect", node=self.node_id,
-                           term=self.term)
+            self._term_span = self.obs.event(
+                "router.elect", node=self.node_id, term=self.term)
 
     def _demote(self, seen: Optional[dict]) -> None:
         """One-way per term: our term is gone (superseded or expired
@@ -1387,10 +1473,14 @@ class FleetRouter:
         with self._lock:
             self.ha_role = "superseded"
         self._lease_expires = 0.0
-        self.obs.event("router.superseded", node=self.node_id,
-                       term=self.term,
-                       active_term=(seen or {}).get("term"),
-                       active_holder=(seen or {}).get("holder"))
+        # the supersession becomes this router's term edge: its
+        # subsequent router_superseded SHED spans parent under it, so
+        # a client's bounce off the stale brain reconstructs with its
+        # cause in the collected tree
+        self._term_span = self.obs.event(
+            "router.superseded", node=self.node_id, term=self.term,
+            active_term=(seen or {}).get("term"),
+            active_holder=(seen or {}).get("holder"))
 
     def _ha_shed(self, req: dict, trace: str) -> dict:
         """The non-active refusal: SHED with the ``router`` block — a
@@ -1415,7 +1505,15 @@ class FleetRouter:
                     "term": rec.get("term"),
                     "holder": rec.get("holder"),
                     "expires_at": rec.get("expires_at")}
-        self.obs.event("admission.shed", trace=trace, reason=reason)
+        # the refusal leaves a SPAN, parented under this router's term
+        # edge (router.superseded / the standby's last observation):
+        # a client bouncing between `--addr a,b` during a takeover
+        # window reconstructs in the collected tree — its trace shows
+        # the stale door's refusal AND the active door's answer
+        # (test-pinned in tests/test_obs_fleet.py)
+        self.obs.event("admission.shed", trace=trace,
+                       parent=self._term_span, reason=reason,
+                       role=self.ha_role, term=self.term)
         doc = {"id": req.get("id"), "ok": False, "shed": True,
                "reason": reason, "node": self.node_id}
         if trace:
@@ -1563,6 +1661,196 @@ class FleetRouter:
         cache[name] = cov
         return cov
 
+    # -- fleet observability: collection / federation / health ---------
+    def collect_sweep(self) -> dict:
+        """One span-collection sweep (obs/collect.py): pull bounded
+        cursor pages of every reachable node's span log into the
+        collected log.  Public so tests and the bench drive it
+        synchronously; the beat loop runs it every ``collect_s``."""
+        if self.collector is None:
+            return {}
+        timeout_s = self.membership.policy.timeout_s or 5.0
+        routable = self.membership.routable_ids()
+        nodes = [nid for nid in self.membership.all_ids()
+                 if nid in routable]
+
+        def fetch(nid: str, cursor, max_events: int) -> dict:
+            return self.links[nid].request(
+                {"op": "obs.spans", "cursor": cursor,
+                 "max_events": max_events}, timeout_s)
+
+        res = self.collector.sweep(nodes, fetch)
+        if res.get("events") or res.get("gaps"):
+            self.obs.event("obs.collect", **res)
+        return res
+
+    def _collect_loop(self) -> None:
+        while not self._stop.wait(self.collect_s):
+            try:
+                self.collect_sweep()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                pass
+
+    def _handle_obs(self, conn: socket.socket, op: str,
+                    req: dict) -> None:
+        """The router's observability ops: ``obs.trace`` answers from
+        the COLLECTED fleet log merged with the router's own span log
+        (causal closure — the cross-process tree `qsm-tpu trace <id>
+        --addr ROUTER` renders); ``obs.spans`` pages the router's own
+        log; ``obs.metrics`` returns the full federated sample set;
+        ``health`` folds the router's SLO with every node's."""
+        if op == "health":
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              **self.health_doc()})
+            return
+        if op == "obs.metrics":
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "samples": [list(s) for s in
+                                          self.obs.metrics.collect()]})
+            return
+        if op == "obs.spans":
+            from ..obs.collect import span_page_response
+
+            self._send(conn, span_page_response(self.obs.tracer, req))
+            return
+        # obs.trace: own log + the collected fleet log, one closure
+        from ..obs import load_events, trace_closure
+
+        path = self.obs.tracer.path
+        trace_id = str(req.get("trace") or "")
+        events: List[dict] = []
+        if path is not None:
+            self.obs.tracer.flush()
+            events.extend(load_events(path))
+        if self.collector is not None:
+            events.extend(load_events(self.collector.out_path))
+        self._send(conn, {"id": req.get("id"), "ok": True,
+                          "trace": trace_id,
+                          "enabled": path is not None,
+                          "collected": self.collector is not None,
+                          "events": trace_closure(events, trace_id)})
+
+    def _fan_out_nodes(self, fn, timeout_s: float) -> List[str]:
+        """Run ``fn(nid)`` for every ROUTABLE node in parallel daemon
+        threads with a bounded join — the one fan-out shape behind
+        per-node stats, metrics federation and fleet health (a wedged
+        node costs the caller ONE timeout, never one per node).
+        Returns the live ids attempted; nodes membership already
+        knows are down are skipped (the caller reports the hole)."""
+        routable = self.membership.routable_ids()
+        live = [nid for nid in self.membership.all_ids()
+                if nid in routable]
+        threads = [threading.Thread(target=fn, args=(nid,),
+                                    daemon=True) for nid in live[1:]]
+        for t in threads:
+            t.start()
+        if live:
+            fn(live[0])
+        for t in threads:
+            t.join(timeout_s + 1.0)
+        return live
+
+    def _federated_samples(self):
+        """Scrape-time metrics federation: every node's own collectors
+        re-labeled with ``node`` (bounded label set — node ids come
+        from the fleet config), plus a per-node staleness gauge so a
+        down node shows as a hole, never as a hang or silence."""
+        timeout_s = self.membership.policy.timeout_s or 5.0
+        results: Dict[str, Optional[tuple]] = {}
+
+        def fetch(nid: str) -> None:
+            t0 = time.perf_counter()
+            try:
+                resp = self.links[nid].request({"op": "obs.metrics"},
+                                               timeout_s)
+            except (NodeBusy, *_LINK_FAULTS):
+                results[nid] = None
+                return
+            if not resp.get("ok"):
+                results[nid] = None
+                return
+            results[nid] = (resp.get("samples") or [],
+                            time.perf_counter() - t0)
+
+        self._fan_out_nodes(fetch, timeout_s)
+        out = []
+        for nid in self.membership.all_ids():
+            got = results.get(nid)
+            stale = got is None
+            out.append(("qsm_fleet_node_scrape_stale", "gauge",
+                        "1 when the node's metrics could not be "
+                        "scraped (down, busy, or unreachable)",
+                        {"node": nid}, 1.0 if stale else 0.0))
+            if stale:
+                continue
+            samples, dt = got
+            out.append(("qsm_fleet_node_scrape_seconds", "gauge",
+                        "last federated scrape round-trip",
+                        {"node": nid}, round(dt, 4)))
+            for s in samples:
+                try:
+                    name, mtype, help_, labels, value = s
+                    out.append((str(name), str(mtype), str(help_),
+                                {**dict(labels), "node": nid},
+                                float(value)))
+                except (TypeError, ValueError):
+                    continue  # one malformed sample, not the scrape
+        return out
+
+    def _shed_total(self) -> float:
+        adm = self.admission.snapshot()
+        with self._lock:
+            ha = self.ha_sheds
+        return float(adm["shed_queue"] + adm["shed_deadline"] + ha)
+
+    def _on_slo_breach(self, row: dict) -> None:
+        self.obs.event("slo.breach", objective=row["objective"],
+                       burn=row["burn_rate"], value=row["value"],
+                       target=row["target"])
+
+    def health_doc(self, timeout_s: float = 5.0) -> dict:
+        """The fleet ``health`` payload: the router's own SLO status
+        folded with every node's health answer (parallel, bounded) —
+        an unreachable node degrades the fleet, it never hangs the
+        op.  Overall status drives `qsm-tpu health`'s exit code."""
+        from ..obs import worst_status
+
+        if self.slo is None:
+            own = {"status": "ok", "slo": {"configured": False}}
+        else:
+            doc = self.slo.evaluate()
+            own = {"status": doc["status"],
+                   "slo": {"configured": True,
+                           "window_s": doc["window_s"],
+                           "window_actual_s": doc["window_actual_s"],
+                           "objectives": doc["objectives"]}}
+        fleet: Dict[str, dict] = {}
+
+        def fetch(nid: str) -> None:
+            try:
+                resp = self.links[nid].request({"op": "health"},
+                                               timeout_s)
+            except (NodeBusy, *_LINK_FAULTS) as e:
+                fleet[nid] = {"status": "unreachable",
+                              "error": f"{type(e).__name__}: {e}"[:200]}
+                return
+            fleet[nid] = ({"status": str(resp.get("status", "ok")),
+                           "slo": resp.get("slo")}
+                          if resp.get("ok") else
+                          {"status": "unreachable",
+                           "error": str(resp.get("error"))[:200]})
+
+        live = self._fan_out_nodes(fetch, timeout_s)
+        for nid in self.membership.all_ids():
+            if nid not in live and nid not in fleet:
+                fleet[nid] = {"status": "unreachable",
+                              "error": "down (membership)"}
+        overall = worst_status(
+            [own["status"]] + [n["status"] for n in fleet.values()])
+        return {"status": overall, "router": own, "fleet": fleet,
+                "role": self.ha_role, "term": self.term,
+                "uptime_s": round(time.monotonic() - self._t0, 1)}
+
     # -- observability -------------------------------------------------
     def node_stats(self, timeout_s: float = 5.0) -> Dict[str, dict]:
         """Best-effort live per-node ``stats`` blocks (down nodes get
@@ -1572,7 +1860,6 @@ class FleetRouter:
         parallel: one wedged node must cost the stats op ONE timeout,
         not one per node."""
         out: Dict[str, dict] = {}
-        routable = self.membership.routable_ids()
 
         def fetch(nid: str) -> None:
             try:
@@ -1584,19 +1871,10 @@ class FleetRouter:
             except (NodeBusy, *_LINK_FAULTS) as e:
                 out[nid] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
-        live = [nid for nid in self.membership.all_ids()
-                if nid in routable]
+        live = self._fan_out_nodes(fetch, timeout_s)
         for nid in self.membership.all_ids():
-            if nid not in routable:
+            if nid not in live:
                 out[nid] = {"error": "down (membership)"}
-        threads = [threading.Thread(target=fetch, args=(nid,),
-                                    daemon=True) for nid in live[1:]]
-        for t in threads:
-            t.start()
-        if live:
-            fetch(live[0])
-        for t in threads:
-            t.join(timeout_s + 1.0)
         return out
 
     def stats(self) -> dict:
@@ -1657,6 +1935,15 @@ class FleetRouter:
             "anti_entropy": ae,
             "fleet_nodes": self.node_stats(),
             "obs": self.obs.snapshot(),
+            # fleet-wide span collection (obs/collect.py): sweeps,
+            # events pulled, gaps and per-node cursor inventory —
+            # None unless collect_dir configured collection
+            "collect": (self.collector.snapshot()
+                        if self.collector is not None else None),
+            # the SLO plane (obs/slo.py) — None unless --slo declared
+            # objectives for this router
+            "slo": (self.slo.snapshot()
+                    if self.slo is not None else None),
             "faults": fired_snapshot(),
         }
 

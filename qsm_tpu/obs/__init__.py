@@ -32,11 +32,15 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from .collect import SpanCollector, read_span_page
 from .flight import FlightRecorder, load_dump, recent_events
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       MetricsServer, parse_exposition)
+from .slo import (HEALTH_EXIT_CODES, HEALTH_EXIT_UNREACHABLE,
+                  Objective, SloEvaluator, parse_slo, worst_status)
 from .trace import (NULL_SPAN, Span, Tracer, build_tree, load_events,
-                    new_span_id, new_trace_id, render_tree)
+                    new_span_id, new_trace_id, render_tree,
+                    trace_closure)
 
 # events that flip the flight recorder's dump trigger the moment they
 # are emitted (beyond the shed-storm window the server drives itself).
@@ -61,7 +65,13 @@ _DUMP_TRIGGERS = {"worker.shed": "worker_crash",
                   # the dump names the session's trace id even when no
                   # client ever reads the flip response
                   # (serve/server.py _session_flip)
-                  "session.flip": "session_flip"}
+                  "session.flip": "session_flip",
+                  # a configured SLO objective crossing into breach is
+                  # the operator's OWN definition of an incident — the
+                  # shed-storm heuristic promoted to a declared
+                  # objective (obs/slo.py; the evaluator emits the
+                  # event once per ok->breach transition)
+                  "slo.breach": "slo_breach"}
 
 
 class Observability:
@@ -177,9 +187,12 @@ def emit_global(name: str, trace: str = "", **attrs) -> None:
 
 
 __all__ = [
-    "Counter", "FlightRecorder", "Gauge", "Histogram",
-    "MetricsRegistry", "MetricsServer", "NULL_SPAN", "Observability",
-    "Span", "Tracer", "build_tree", "emit_global", "global_obs",
-    "load_dump", "load_events", "new_span_id", "new_trace_id",
-    "parse_exposition", "recent_events", "render_tree", "set_global",
+    "Counter", "FlightRecorder", "Gauge", "HEALTH_EXIT_CODES",
+    "HEALTH_EXIT_UNREACHABLE", "Histogram", "MetricsRegistry",
+    "MetricsServer", "NULL_SPAN", "Objective", "Observability",
+    "SloEvaluator", "Span", "SpanCollector", "Tracer", "build_tree",
+    "emit_global", "global_obs", "load_dump", "load_events",
+    "new_span_id", "new_trace_id", "parse_exposition", "parse_slo",
+    "read_span_page", "recent_events", "render_tree", "set_global",
+    "trace_closure", "worst_status",
 ]

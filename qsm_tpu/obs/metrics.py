@@ -156,6 +156,15 @@ class Histogram:
         with self._lock:
             return sum(self._counts.get(_label_key(labels), ()))
 
+    def counts(self, **labels) -> List[int]:
+        """One label set's raw bucket-count vector (bucket order =
+        ``self.bounds`` + the +Inf overflow) — what the SLO plane
+        snapshots so sliding windows read the SAME books as
+        ``/metrics`` (obs/slo.py)."""
+        with self._lock:
+            return list(self._counts.get(
+                _label_key(labels), [0] * (len(self.bounds) + 1)))
+
     def samples(self) -> Iterable[Sample]:
         with self._lock:
             counts = {k: list(v) for k, v in self._counts.items()}

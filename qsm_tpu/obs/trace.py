@@ -258,6 +258,51 @@ def load_events(path: str, trace_id: Optional[str] = None) -> List[dict]:
     return out
 
 
+def trace_closure(events: List[dict], trace_id: str) -> List[dict]:
+    """One trace's events PLUS their causal ancestors — the
+    cross-process rule behind ``qsm-tpu trace <id> --addr``: a
+    ``router.takeover`` or ``router.elect`` event carries no trace id
+    (it belongs to every request of its term), but a request served
+    under that term parents its root beneath it, so walking parent
+    edges pulls the fleet-level cause into the request's tree.  Pure
+    span/parent-edge traversal — wall clocks are never consulted, so
+    per-process clock skew cannot reorder the tree.  Deduplicates by
+    span id (a collection gap reset may have shipped an event twice);
+    returns events in their original merged-file order."""
+    by_span: Dict[str, dict] = {}
+    for ev in events:
+        sp = ev.get("span")
+        if sp and sp not in by_span:
+            by_span[sp] = ev
+    picked: List[dict] = []
+    seen: set = set()
+    stack: List[str] = []
+    for ev in events:
+        if ev.get("trace") != trace_id:
+            continue
+        sp = ev.get("span")
+        if sp in seen or by_span.get(sp) is not ev:
+            continue  # duplicate shipment of the same span event
+        seen.add(sp)
+        picked.append(ev)
+        if ev.get("parent"):
+            stack.append(ev["parent"])
+    while stack:
+        sp = stack.pop()
+        if not sp or sp in seen:
+            continue
+        seen.add(sp)
+        ev = by_span.get(sp)
+        if ev is None:
+            continue  # rotated away: the child renders as a root
+        picked.append(ev)
+        if ev.get("parent"):
+            stack.append(ev["parent"])
+    order = {id(ev): i for i, ev in enumerate(events)}
+    picked.sort(key=lambda ev: order[id(ev)])
+    return picked
+
+
 def build_tree(events: List[dict]) -> List[dict]:
     """Causal forest from one trace's events: each node is the event
     dict plus ``children`` (sorted by emit order).  An event whose

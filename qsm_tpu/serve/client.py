@@ -38,10 +38,18 @@ import time
 from typing import List, Optional, Sequence, Union
 
 from ..core.history import History
+from ..obs import new_trace_id
 from ..resilience.faults import InjectedFault, inject
 from .protocol import (LineChannel, connect, history_to_rows, send_doc)
 
 _ids = itertools.count()
+
+# ops whose retry ladder must ride ONE trace id: the client mints it
+# up front (when the caller supplied none), so a request that bounces
+# between addresses — a standby's HA shed, a death mid-request —
+# reconstructs as ONE cross-door story in the collected span log
+_TRACED_OPS = ("check", "shrink", "session.open", "session.append",
+               "session.close")
 
 # SHED reasons that mean "alive, but not the brain you want" — the
 # client hops to the next address instead of surfacing the refusal
@@ -184,6 +192,33 @@ class CheckClient:
     def stats(self) -> dict:
         return self._round_trip({"op": "stats"})
 
+    # -- fleet observability (docs/OBSERVABILITY.md "Fleet") -----------
+    def health(self) -> dict:
+        """The ``health`` op: SLO status of the server/router (and,
+        through a router, the folded per-node statuses)."""
+        return self._round_trip({"op": "health"})
+
+    def metrics(self) -> dict:
+        """The ``obs.metrics`` op: the process's live metric samples,
+        JSON-shaped (a router answers the federated set)."""
+        return self._round_trip({"op": "obs.metrics"})
+
+    def trace_events(self, trace_id: str) -> dict:
+        """The ``obs.trace`` op: one trace's events (causal closure);
+        a router answers from its collected fleet log merged with its
+        own span log — the `qsm-tpu trace <id> --addr` transport."""
+        return self._round_trip({"op": "obs.trace",
+                                 "trace": str(trace_id)})
+
+    def span_page(self, cursor: Optional[dict] = None,
+                  max_events: Optional[int] = None) -> dict:
+        """One ``obs.spans`` page of the peer's span log (cursor-paged
+        and idempotent — obs/collect.py owns the semantics)."""
+        req: dict = {"op": "obs.spans", "cursor": cursor}
+        if max_events is not None:
+            req["max_events"] = int(max_events)
+        return self._round_trip(req)
+
     def shutdown(self) -> dict:
         return self._round_trip({"op": "shutdown"})
 
@@ -243,6 +278,11 @@ class CheckClient:
         takeover window (the standby still shedding ``router_standby``
         while the lease runs out) lasts seconds, and a count bound
         would burn out in milliseconds against a dead door."""
+        if req.get("op") in _TRACED_OPS and not req.get("trace"):
+            # mint the trace CLIENT-side so every attempt of this
+            # logical request — across doors and takeover windows —
+            # shares one id (the server adopts it; _TRACED_OPS note)
+            req["trace"] = new_trace_id()
         n = len(self.addresses)
         deadline = time.monotonic() + max(1.0, self.timeout_s)
         # bounded by construction: every attempt either pauses toward

@@ -88,6 +88,38 @@ protocol, so clients point at a router address unchanged; its SHED
 responses carry the per-node health block (``fleet``) beside the
 ``pool`` block a single node would send.
 
+Fleet observability (ISSUE 15, docs/OBSERVABILITY.md "Fleet"): every
+server and router answers four more ops, none of them lease-gated —
+the observability surface must stay up mid-incident:
+
+* ``{"op": "obs.spans", "cursor": {...}|null, "max_events": N}`` —
+  one cursor-paged, bounded, IDEMPOTENT page of this process's span
+  log (obs/collect.py owns the cursor semantics: a re-scrape ships
+  zero duplicate events, a lost rotation answers an honest ``gap``).
+  The router's collection sweep scrapes node logs through this op
+  into its fleet-wide collected log.
+* ``{"op": "obs.trace", "trace": ID}`` — the trace's events under
+  causal closure (ancestors included, so a ``router.takeover`` the
+  request rode through appears in its tree).  A router answers from
+  its COLLECTED log merged with its own spans — the transport behind
+  ``qsm-tpu trace <id> --addr ROUTER``.
+* ``{"op": "obs.metrics"}`` — the process's metric samples, JSON-
+  shaped.  A router's answer is the FEDERATED set: every node's
+  samples re-labeled with ``node``, plus per-node staleness gauges
+  (a down node is a hole, never a hang).
+* ``{"op": "health"}`` — the SLO evaluation (obs/slo.py; configured
+  via ``--slo "check=250ms:p99,shed_rate<0.01"``): per-objective burn
+  rates over a sliding window of the same histograms ``/metrics``
+  serves, overall ``ok``/``degraded``/``breach``.  A router folds in
+  every node's health.  ``qsm-tpu health`` maps the status to pinned
+  exit codes (0/1/2; 3 unreachable).
+
+Check/shrink/session requests may also carry ``parent`` — the span id
+of the caller's dispatch edge.  A router stamps its ``node.dispatch``
+span there, so the node's whole request subtree pins under the router
+edge that caused it in the collected tree: cross-process causality by
+edges, never by comparing wall clocks between hosts.
+
 Router HA (fleet/lease.py): a router running under a lease stamps its
 ``term`` on every response; a NON-active router answers check/shrink
 with ``{"shed": true, "reason": "router_standby" |

@@ -215,7 +215,9 @@ class CheckServer:
                  max_sessions: int = 256,
                  session_events: int = 65_536,
                  session_states: int = 64,
-                 session_budget: int = 2_000_000):
+                 session_budget: int = 2_000_000,
+                 slo: Optional[str] = None,
+                 slo_window_s: float = 60.0):
         if engine not in ("auto", "planned"):
             raise ValueError(f"unknown serve engine {engine!r}; "
                              "one of ('auto', 'planned')")
@@ -241,8 +243,24 @@ class CheckServer:
         self._metrics_server = None
         self._m_request_s = self.obs.metrics.histogram(
             "qsm_serve_request_seconds",
-            "end-to-end request latency (admission to response)")
+            "end-to-end request latency (admission to response), "
+            "labeled by verb")
         self.obs.metrics.register_collector(self._metric_samples)
+        # SLO plane (obs/slo.py, docs/OBSERVABILITY.md "Fleet"):
+        # declared objectives evaluated over sliding windows of the
+        # SAME per-verb latency histogram and shed counters /metrics
+        # exposes; the health op reads it and an ok->breach transition
+        # emits the slo.breach flight-dump trigger
+        self.slo = None
+        if slo:
+            from ..obs import SloEvaluator, parse_slo
+
+            self.slo = SloEvaluator(
+                parse_slo(slo), latency_hist=self._m_request_s,
+                requests_fn=lambda: self.requests,
+                sheds_fn=self._shed_total, window_s=slo_window_s,
+                on_breach=self._on_slo_breach)
+            self.obs.metrics.register_collector(self.slo.metric_samples)
         self.n_workers = max(0, int(workers))
         self.pool = None
         if self.n_workers:
@@ -446,6 +464,9 @@ class CheckServer:
         # collector must go with the server or a reused registry
         # double-emits every serve series (and pins the dead server)
         self.obs.metrics.unregister_collector(self._metric_samples)
+        if self.slo is not None:
+            self.obs.metrics.unregister_collector(
+                self.slo.metric_samples)
         if self._metrics_server is not None:
             self._metrics_server.stop()
             self._metrics_server = None
@@ -600,6 +621,14 @@ class CheckServer:
         op = req.get("op", "check")
         if op == "stats":
             self._send(conn, {"ok": True, "stats": self.stats()})
+        elif op in ("obs.spans", "obs.trace", "obs.metrics", "health"):
+            try:
+                self._handle_obs(conn, op, req)
+            except OSError:
+                raise
+            except Exception as e:  # noqa: BLE001 — answer, don't die
+                self._send(conn, {"id": req.get("id"), "ok": False,
+                                  "error": f"{type(e).__name__}: {e}"})
         elif op in ("replog.digests", "replog.pull", "replog.push",
                     "replog.covers", "replog.subsumed"):
             self._handle_replog(conn, op, req)
@@ -639,6 +668,81 @@ class CheckServer:
                                 "error": f"{type(e).__name__}: {e}"})
         else:
             self._send(conn, {"ok": False, "error": f"unknown op {op!r}"})
+
+    # -- the obs collection / federation / health ops ------------------
+    def _handle_obs(self, conn: socket.socket, op: str,
+                    req: dict) -> None:
+        """The fleet-observability surface every node answers
+        (docs/OBSERVABILITY.md "Fleet"):
+
+        * ``obs.spans``   — one cursor-paged, bounded, idempotent page
+          of this process's span log (obs/collect.py owns the cursor
+          semantics) — what the router's collection sweep scrapes;
+        * ``obs.trace``   — one trace's events (causal closure) from
+          this process's own span log, for single-node debugging;
+        * ``obs.metrics`` — this process's metric samples, JSON-shaped,
+          so a router can federate them under a ``node`` label without
+          every node needing its own scrape port;
+        * ``health``      — the SLO evaluation (obs/slo.py) or plain
+          liveness when no objectives are configured.
+        """
+        if op == "health":
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              **self.health_doc()})
+            return
+        if op == "obs.metrics":
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "samples": [list(s) for s in
+                                          self.obs.metrics.collect()]})
+            return
+        if op == "obs.spans":
+            from ..obs.collect import span_page_response
+
+            self._send(conn, span_page_response(self.obs.tracer, req))
+            return
+        # obs.trace: the trace's events plus causal ancestors from the
+        # local log only (a router's handler merges its collected log)
+        from ..obs import load_events, trace_closure
+
+        path = self.obs.tracer.path
+        if path is None:
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "enabled": False, "events": []})
+            return
+        self.obs.tracer.flush()
+        trace_id = str(req.get("trace") or "")
+        events = trace_closure(load_events(path), trace_id)
+        self._send(conn, {"id": req.get("id"), "ok": True,
+                          "enabled": True, "trace": trace_id,
+                          "events": events})
+
+    def _shed_total(self) -> float:
+        adm = self.admission.snapshot()
+        return float(adm["shed_queue"] + adm["shed_deadline"])
+
+    def _on_slo_breach(self, row: dict) -> None:
+        # the configured-objective incident: one event per ok->breach
+        # transition; `slo.breach` is a flight-dump trigger
+        self.obs.event("slo.breach", objective=row["objective"],
+                       burn=row["burn_rate"], value=row["value"],
+                       target=row["target"])
+
+    def health_doc(self) -> dict:
+        """The ``health`` op payload: per-objective burn rates and an
+        overall status (obs/slo.py), or plain liveness when no SLO is
+        configured — the status maps to `qsm-tpu health`'s pinned exit
+        codes either way."""
+        if self.slo is None:
+            return {"status": "ok",
+                    "slo": {"configured": False},
+                    "uptime_s": round(time.monotonic() - self._t0, 1)}
+        doc = self.slo.evaluate()
+        return {"status": doc["status"],
+                "slo": {"configured": True,
+                        "window_s": doc["window_s"],
+                        "window_actual_s": doc["window_actual_s"],
+                        "objectives": doc["objectives"]},
+                "uptime_s": round(time.monotonic() - self._t0, 1)}
 
     # -- the replog anti-entropy ops (fleet/replog.py) -----------------
     def _handle_replog(self, conn: socket.socket, op: str,
@@ -783,12 +887,17 @@ class CheckServer:
         self.requests += 1
         # the request-scoped trace id: minted HERE at admission (or
         # adopted from the client), propagated through every stage and
-        # carried by every response — docs/OBSERVABILITY.md
+        # carried by every response — docs/OBSERVABILITY.md.  A router
+        # sub-request also carries `parent` (its node.dispatch span),
+        # so in the COLLECTED fleet log this node's whole subtree pins
+        # under the router edge that caused it — causality by edge,
+        # never by cross-process wall clocks.
         trace = str(req.get("trace") or "") or new_trace_id()
         root = ""
         if self.obs.on:
             root = new_span_id()
             self.obs.tracer.emit("request", trace=trace, span=root,
+                                 parent=str(req.get("parent") or ""),
                                  model=model, lanes=len(hists),
                                  witness=want_witness)
 
@@ -956,10 +1065,12 @@ class CheckServer:
         self._respond(conn, doc, trace, root, t_req)
 
     def _respond(self, conn, doc: dict, trace: str, root: str,
-                 t_req: float, status: str = "ok") -> None:
+                 t_req: float, status: str = "ok",
+                 verb: str = "check") -> None:
         """The check path's ONE terminal: closes the request's causal
         tree with a ``response`` event and feeds the request-latency
-        histogram, then sends."""
+        histogram (labeled by verb — the SLO plane's sliding windows
+        read the same series), then sends."""
         dt = time.perf_counter() - t_req
         if self.obs.on:
             self.obs.tracer.emit(
@@ -968,7 +1079,7 @@ class CheckServer:
                 shed=bool(doc.get("shed")),
                 violations=doc.get("violations"),
                 cached=sum(bool(c) for c in doc.get("cached", ())))
-        self._m_request_s.observe(dt)
+        self._m_request_s.observe(dt, verb=verb)
         self._send(conn, doc)
 
     # -- P-compositional split lanes (ops/pcomp.py) --------------------
@@ -1083,6 +1194,7 @@ class CheckServer:
         if self.obs.on:
             root = new_span_id()
             self.obs.tracer.emit("request", trace=trace, span=root,
+                                 parent=str(req.get("parent") or ""),
                                  op=op, session=req.get("session"))
         self.requests += 1
         if op == "session.open":
@@ -1106,7 +1218,7 @@ class CheckServer:
         if not self.admission.try_admit(1):
             self._respond(conn, {**self._shed(req, "queue full", trace,
                                               root), "session": sid},
-                          trace, root, t_req)
+                          trace, root, t_req, verb='session')
             return
         try:
             deadline = self.admission.deadline_for(req.get("deadline_s"))
@@ -1120,14 +1232,15 @@ class CheckServer:
         except SessionLimit as e:
             self.admission.release(1)
             self._respond(conn, {**self._shed(req, str(e), trace, root),
-                                 "session": sid}, trace, root, t_req)
+                                 "session": sid}, trace, root,
+                          t_req, verb='session')
             return
         except Exception:
             self.admission.release(1)
             raise
         self.admission.release(1)
         doc["seconds"] = round(time.perf_counter() - t_req, 4)
-        self._respond(conn, doc, trace, root, t_req)
+        self._respond(conn, doc, trace, root, t_req, verb='session')
 
     def _session_open(self, conn, req: dict, trace: str, root: str,
                       t_req: float) -> None:
@@ -1146,7 +1259,8 @@ class CheckServer:
         entry = self._engine_for(model, spec_kwargs)
         if not self.admission.try_admit(1):
             self._respond(conn, self._shed(req, "queue full", trace,
-                                           root), trace, root, t_req)
+                                           root), trace, root,
+                          t_req, verb='session')
             return
         try:
             sid = req.get("session")
@@ -1157,7 +1271,7 @@ class CheckServer:
             except SessionLimit as e:
                 self._respond(conn, self._shed(req, str(e), trace,
                                                root), trace, root,
-                              t_req)
+                              t_req, verb='session')
                 return
             with s.lock:
                 s.model, s.spec_kwargs = model, spec_kwargs
@@ -1170,7 +1284,7 @@ class CheckServer:
                 "per_key": s.proj is not None,
                 "verdict": VERDICT_NAMES[verdict], "trace": trace,
                 "seconds": round(time.perf_counter() - t_req, 4),
-            }, trace, root, t_req)
+            }, trace, root, t_req, verb='session')
         finally:
             self.admission.release(1)
 
@@ -1335,6 +1449,7 @@ class CheckServer:
         if self.obs.on:
             root = new_span_id()
             self.obs.tracer.emit("request", trace=trace, span=root,
+                                 parent=str(req.get("parent") or ""),
                                  model=model, op="shrink", ops=len(h))
         entry = self._engine_for(model, spec_kwargs)
         spec_key = self._spec_key(model, spec_kwargs)
@@ -1355,18 +1470,19 @@ class CheckServer:
                 # a banked certificate (O(n²) witness payload) must not
                 # inflate a duplicate answer that never asked for one
                 doc.pop("certificate", None)
-            self._respond(conn, doc, trace, root, t_req)
+            self._respond(conn, doc, trace, root, t_req, verb='shrink')
             return
         if not self.admission.try_admit(1):
             self._respond(conn, self._shed(req, "queue full", trace,
-                                           root), trace, root, t_req)
+                                           root), trace, root,
+                          t_req, verb='shrink')
             return
         try:
             if time.monotonic() >= deadline:
                 self.admission.shed_late()
                 self._respond(conn, self._shed(req, "deadline", trace,
                                                root), trace, root,
-                              t_req)
+                              t_req, verb='shrink')
                 return
             self.obs.event("admission.admit", trace=trace, parent=root,
                            lanes=1)
@@ -1426,7 +1542,7 @@ class CheckServer:
                     while len(self._shrink_bank) > self.shrink_bank_entries:
                         self._shrink_bank.popitem(last=False)
             doc["seconds"] = round(time.perf_counter() - t_req, 4)
-            self._respond(conn, doc, trace, root, t_req)
+            self._respond(conn, doc, trace, root, t_req, verb='shrink')
         finally:
             self.admission.release(1)
 
@@ -1726,6 +1842,10 @@ class CheckServer:
             # trace/flight accounting (qsm_tpu/obs): span events
             # emitted, flight-ring occupancy, dumps fired + last path
             "obs": self.obs.snapshot(),
+            # the SLO plane (obs/slo.py): declared objectives + breach
+            # count — None unless --slo configured objectives
+            "slo": (self.slo.snapshot()
+                    if self.slo is not None else None),
             # fault-plane hits in THIS process (resilience/faults.py) —
             # zeros/empty unless someone is fault-drilling the server
             "faults": fired_snapshot(),

@@ -487,7 +487,8 @@ def cmd_serve(args) -> int:
         node_id=args.node_id, replog_dir=args.replog_dir,
         replog_seal_rows=args.replog_seal_rows,
         peers=peers or None, gossip_s=args.gossip_s,
-        gossip_fanout=args.gossip_fanout)
+        gossip_fanout=args.gossip_fanout,
+        slo=args.slo, slo_window_s=args.slo_window)
     warm = [m.strip() for m in args.warm.split(",")] if args.warm else []
     warm = [m for m in warm if m]
     unknown = sorted(set(warm) - set(MODELS))
@@ -560,6 +561,12 @@ def cmd_fleet(args) -> int:
                 cmd += ["--workers", str(args.workers)]
             if args.warm:
                 cmd += ["--warm", args.warm]
+            if args.collect_dir:
+                # fleet-wide collection needs node span logs to scrape:
+                # each spawned node traces beside its replog (fronted
+                # --addrs nodes bring their own --trace-log)
+                cmd += ["--trace-log",
+                        os.path.join(replog_root, f"n{i}_trace.jsonl")]
             procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                           text=True, env=env))
         for i, proc in enumerate(procs):
@@ -608,7 +615,9 @@ def cmd_fleet(args) -> int:
         lease_path=args.lease_path,
         lease_ttl_s=args.lease_ttl_s,
         trace_log=args.trace_log, flight_dir=args.flight_dir,
-        metrics_port=args.metrics_port)
+        metrics_port=args.metrics_port,
+        collect_dir=args.collect_dir, collect_s=args.collect_s,
+        slo=args.slo, slo_window_s=args.slo_window)
     router.start()
     try:
         print(json.dumps({"fleet": router.address,
@@ -621,6 +630,8 @@ def cmd_fleet(args) -> int:
                           "anti_entropy_s": args.anti_entropy_s,
                           "gossip_s": args.gossip_s,
                           "trace_log": args.trace_log,
+                          "collect_dir": args.collect_dir,
+                          "slo": args.slo,
                           "flight_dir": args.flight_dir}), flush=True)
         router.wait()
     except KeyboardInterrupt:
@@ -919,29 +930,143 @@ def cmd_monitor(args) -> int:
     return 0 if verdict == "LINEARIZABLE" else 2
 
 
+def _trace_fetch(args, client=None):
+    """One fetch of the trace's events: the collected+own fleet view
+    over the wire (``--addr``, the ``obs.trace`` op — each round-trip
+    bounded by the ``fleet-probe`` policy preset; --follow reuses one
+    ``client`` across polls instead of redialing) or the local span
+    log (``--log``, causal closure included so a fleet log read
+    locally renders the same tree)."""
+    from ..obs import load_events, trace_closure
+
+    if args.addr:
+        from ..serve.client import CheckClient
+
+        own = client is None
+        if own:
+            client = CheckClient(args.addr,
+                                 timeout_s=_trace_poll_timeout())
+        try:
+            res = client.trace_events(args.trace_id)
+        finally:
+            if own:
+                client.close()
+        if not res.get("ok"):
+            raise SystemExit(f"obs.trace refused: "
+                             f"{res.get('error') or res}")
+        return res.get("events") or []
+    return trace_closure(load_events(args.log), args.trace_id)
+
+
+def _trace_poll_timeout() -> float:
+    from ..resilience.policy import preset
+
+    return preset("fleet-probe").timeout_s or 5.0
+
+
 def cmd_trace(args) -> int:
     """Reconstruct ONE request's causal tree from a span log
     (qsm_tpu/obs, docs/OBSERVABILITY.md): admission, every micro-batch
     (flush reason + worker id), pcomp sub-lanes, the recombine, shrink
     frontier rounds, and the cache bank — as an indented tree (default)
-    or the raw event list (``--json``).  Exit 0 when events were found,
-    1 when the trace id has none in the log."""
-    from ..obs import build_tree, load_events, render_tree
+    or the raw event list (``--json``).  With ``--addr ROUTER`` the
+    events come from the router's COLLECTED fleet log merged with its
+    own spans (the ``obs.trace`` op), so the tree spans client →
+    router → nodes → workers, route hops and HA takeovers included.
+    ``--follow`` keeps polling and prints each NEW event of the trace
+    as it lands (bounded poll; stops after ``--max-idle`` quiet
+    seconds).  Exit 0 when events were found, 1 when the trace id has
+    none in the log."""
+    from ..obs import build_tree, render_tree
 
-    events = load_events(args.log, trace_id=args.trace_id)
-    if args.json:
+    if not args.addr and not args.log:
+        raise SystemExit("trace needs --log PATH or --addr ADDR")
+    # in follow mode, ONE client serves the initial fetch and every
+    # poll (a fresh dial per fetch would cost a connection each)
+    client = None
+    if args.addr and args.follow:
+        from ..serve.client import CheckClient
+
+        client = CheckClient(args.addr, timeout_s=_trace_poll_timeout())
+    events = _trace_fetch(args, client=client)
+    source = args.addr or args.log
+    if args.json and not args.follow:
         print(json.dumps(events))
-    else:
-        if events:
-            print(f"trace {args.trace_id} ({len(events)} event(s), "
-                  f"log: {args.log})")
-            print(render_tree(build_tree(events)))
+    elif args.json:
+        # follow mode streams JSONL: the initial events first, then
+        # each new one as it lands — a consumer always sees the FULL
+        # trace on one stream, never just the post-start tail
+        for e in events:
+            print(json.dumps(e), flush=True)
+    elif events:
+        print(f"trace {args.trace_id} ({len(events)} event(s), "
+              f"source: {source})")
+        print(render_tree(build_tree(events)))
+    if args.follow:
+        # live mode: the monitor-session debugging loop — poll, render
+        # only what is NEW (dedup by span id), stop on a quiet window
+        seen = {e.get("span") for e in events}
+        idle_since = time.monotonic()
+        try:
+            while time.monotonic() - idle_since < args.max_idle:
+                time.sleep(max(0.1, args.interval))
+                fresh = [e for e in _trace_fetch(args, client=client)
+                         if e.get("span") not in seen]
+                if not fresh:
+                    continue
+                idle_since = time.monotonic()
+                for e in fresh:
+                    seen.add(e.get("span"))
+                    events.append(e)
+                    if args.json:
+                        print(json.dumps(e), flush=True)
+                    else:
+                        at = " ".join(
+                            f"{k}={v}" for k, v in
+                            (e.get("attrs") or {}).items())
+                        ms = (f" {e['ms']}ms"
+                              if e.get("ms") is not None else "")
+                        print(f"+ {e.get('name')}{ms}"
+                              + (f" [{at}]" if at else ""), flush=True)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            if client is not None:
+                client.close()
     if not events:
-        print(f"no events for trace {args.trace_id!r} in {args.log} "
+        print(f"no events for trace {args.trace_id!r} in {source} "
               "(is the server running with --trace-log, and has the "
               "log rotated twice since?)", file=sys.stderr)
         return 1
     return 0
+
+
+def cmd_health(args) -> int:
+    """Ask a running server or fleet router for its SLO health (the
+    ``health`` op, obs/slo.py).  Pinned exit codes: 0 ok, 1 degraded,
+    2 breach, 3 unreachable/error — scriptable as a probe."""
+    from ..obs import HEALTH_EXIT_CODES, HEALTH_EXIT_UNREACHABLE
+    from ..serve.client import CheckClient
+
+    try:
+        client = CheckClient(args.addr, timeout_s=args.timeout)
+    except (OSError, ConnectionError) as e:
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: "
+                                                f"{e}"}))
+        return HEALTH_EXIT_UNREACHABLE
+    try:
+        res = client.health()
+    except (OSError, ConnectionError) as e:
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: "
+                                                f"{e}"}))
+        return HEALTH_EXIT_UNREACHABLE
+    finally:
+        client.close()
+    print(json.dumps(res))
+    if not res.get("ok"):
+        return HEALTH_EXIT_UNREACHABLE
+    return HEALTH_EXIT_CODES.get(str(res.get("status")),
+                                 HEALTH_EXIT_UNREACHABLE)
 
 
 def _render_stats_watch(doc: dict) -> str:
@@ -1858,6 +1983,15 @@ def main(argv=None) -> int:
                         "drives it)")
     p.add_argument("--gossip-fanout", type=int, default=2,
                    help="random peers contacted per gossip beat")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="declare SLO objectives evaluated over a "
+                        "sliding window of the live latency/shed "
+                        "series (obs/slo.py), e.g. "
+                        "'check=250ms:p99,shed_rate<0.01' — exposed "
+                        "as burn-rate gauges, the `health` op and the "
+                        "slo.breach flight-dump trigger")
+    p.add_argument("--slo-window", type=float, default=60.0,
+                   help="SLO sliding-window seconds")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -1923,21 +2057,68 @@ def main(argv=None) -> int:
                    help="wire spawned/fronted nodes for node-to-node "
                         "gossip anti-entropy at this beat (0 = off): "
                         "replication then survives every router dying")
+    p.add_argument("--collect-dir", default=None, metavar="DIR",
+                   help="fleet-wide span collection (obs/collect.py): "
+                        "the router's beat scrapes every node's span "
+                        "log (obs.spans, cursor-paged + idempotent) "
+                        "into DIR/collected.jsonl — `qsm-tpu trace "
+                        "<id> --addr ROUTER` then renders the full "
+                        "cross-process causal tree; per-node cursors "
+                        "persist so restarts re-ship nothing; spawned "
+                        "nodes get --trace-log automatically")
+    p.add_argument("--collect-s", type=float, default=1.0,
+                   help="span-collection sweep interval seconds")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="router SLO objectives (serve --slo grammar) "
+                        "over route latency + sheds; `qsm-tpu health "
+                        "--addr ROUTER` folds every node's health in")
+    p.add_argument("--slo-window", type=float, default=60.0,
+                   help="SLO sliding-window seconds")
     p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser(
         "trace",
         help="reconstruct one request's causal tree from a span log "
-             "(serve --trace-log; docs/OBSERVABILITY.md)")
+             "(serve --trace-log) or a fleet router's collected log "
+             "(--addr; docs/OBSERVABILITY.md)")
     p.add_argument("trace_id",
                    help="the trace id a check/shrink/SHED response "
                         "carried in its 'trace' field")
-    p.add_argument("--log", required=True,
-                   help="the server's --trace-log path (its .1 "
-                        "rotation predecessor is read too)")
+    p.add_argument("--log", default=None,
+                   help="a local span-log path (its .1 rotation "
+                        "predecessor is read too)")
+    p.add_argument("--addr", default=None,
+                   help="ask a running server/router for the trace "
+                        "(obs.trace op); a router answers from its "
+                        "COLLECTED fleet log, so the tree spans "
+                        "client -> router -> nodes -> workers with "
+                        "route hops and HA takeovers (a,b = "
+                        "multi-address failover)")
+    p.add_argument("--follow", action="store_true",
+                   help="live mode: keep polling and print each NEW "
+                        "event of the trace as it lands (round-trips "
+                        "bounded by the fleet-probe policy preset; "
+                        "stops after --max-idle quiet seconds)")
+    p.add_argument("--interval", type=float, default=0.5,
+                   help="--follow poll interval seconds")
+    p.add_argument("--max-idle", type=float, default=30.0,
+                   help="--follow: stop after this many seconds "
+                        "without a new event")
     p.add_argument("--json", action="store_true",
                    help="print the raw event list instead of the tree")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "health",
+        help="SLO health of a running server or fleet router (the "
+             "health op; exit 0 ok / 1 degraded / 2 breach / 3 "
+             "unreachable)")
+    p.add_argument("--addr", required=True,
+                   help="server or router address (a,b = multi-"
+                        "address failover)")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="client-side response bound")
+    p.set_defaults(fn=cmd_health)
 
     p = sub.add_parser(
         "submit",

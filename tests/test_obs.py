@@ -428,7 +428,10 @@ def test_metrics_endpoint_reconciles_with_stats(tmp_path):
     assert cache["hits"] > 0                # the second pass hit
     assert vals["qsm_obs_span_events_total"] == \
         st["obs"]["tracing"]["events"] > 0
-    assert vals["qsm_serve_request_seconds_count"] == st["requests"]
+    # the request-latency histogram is labeled by verb (the SLO plane
+    # reads per-verb windows); this run was check traffic only
+    assert vals['qsm_serve_request_seconds_count{verb="check"}'] == \
+        st["requests"]
 
 
 def test_pool_dispatch_histogram_and_worker_metrics(tmp_path):

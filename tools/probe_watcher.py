@@ -80,7 +80,7 @@ CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 # interprocedural race analyzer), independent of the window artifacts'
 # ROUND_TAG — renaming those retires banked measurements, renaming this
 # just says which rule set produced the findings.
-LINT_ROUND = "r14"  # family (k) QSM-MON-UNBOUNDED (monitor plane) — r14
+LINT_ROUND = "r15"  # family (i) scan set grew fleet/monitor/ingest — r15
 LINT_ARTIFACT = os.path.join(REPO, f"LINT_{LINT_ROUND}.json")
 
 # Committed archive of the P-compositionality bench (tools/
@@ -111,11 +111,13 @@ _SHRINK_STATE: dict = {"attempted": False}
 # absent / tracing off / tracing on — refreshed off-window on
 # CellJournal --resume rails so windows archive a trace/metrics cost
 # snapshot beside the BENCH/LINT artifacts.  Tracks its own round tag
-# (the trace plane landed in r11).
-OBS_ROUND = "r11"
+# (the trace plane landed in r11; fleet collection/federation cells
+# joined in r15).
+OBS_ROUND = "r15"
 OBS_ARTIFACT = os.path.join(REPO, f"BENCH_OBS_{OBS_ROUND}.json")
-# full scan = no_obs + tracing_off + tracing_on + summary
-OBS_MIN_ROWS = 4
+# full scan = no_obs + tracing_off + tracing_on + 2 fleet cells +
+# federation_scrape + summary
+OBS_MIN_ROWS = 7
 _OBS_STATE: dict = {"attempted": False}
 
 # Committed archive of the fleet soak (tools/bench_fleet.py): HOST-ONLY
