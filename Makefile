@@ -1,17 +1,19 @@
 # qsm_tpu CI/tooling entry points.
 #
 # `lint-gate` is the static-analysis gate: it runs every registered
-# qsmlint pass family (a–k, docs/ANALYSIS.md) over the full tree,
-# archives the JSON findings document to LINT_r15.json (the artifact
+# qsmlint pass family (a–l, docs/ANALYSIS.md) over the full tree,
+# archives the JSON findings document to LINT_r16.json (the artifact
 # probe_watcher also refreshes before every window seize) and FAILS
-# (exit 1) on any non-whitelisted error-severity finding.  The on-disk
+# (exit 1) on any non-whitelisted error-severity finding — including
+# QSM-PROTO-DRIFT when the committed PROTOCOL.json no longer matches a
+# fresh extraction (`make protocol` regenerates it).  The on-disk
 # result cache (.qsmlint-cache.json) keeps a warm full-tree run in the
 # low seconds; CI lanes that want diff-scoped speed use `lint-changed`.
 
 PYTHON ?= python
 # keep in lockstep with tools/probe_watcher.py LINT_ROUND (the watcher
 # archives the same document before every window seize)
-LINT_ARTIFACT ?= LINT_r15.json
+LINT_ARTIFACT ?= LINT_r16.json
 
 # P-compositionality bench (tools/bench_pcomp.py): host-only — no TPU
 # window needed — on CellJournal --resume rails; refreshes the
@@ -49,11 +51,18 @@ FLEET_ARTIFACT ?= BENCH_FLEET_r13.json
 # parity soak at zero wrong verdicts; docs/MONITOR.md)
 MONITOR_ARTIFACT ?= BENCH_MONITOR_r14.json
 
-.PHONY: lint-gate lint-changed lint-sarif test bench-pcomp \
+.PHONY: lint-gate lint-changed lint-sarif protocol test bench-pcomp \
 	bench-shrink bench-obs bench-fleet bench-monitor bench-report
 
 lint-gate:
 	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT)
+
+# regenerate the committed wire-contract artifact (PROTOCOL.json +
+# docs/PROTOCOL.md) from a fresh static extraction; lint family (l)
+# fails the gate (QSM-PROTO-DRIFT) whenever a protocol edit lands
+# without re-running this
+protocol:
+	$(PYTHON) -m qsm_tpu.analysis.protocol_model
 
 lint-changed:
 	$(PYTHON) -m qsm_tpu lint --changed $(or $(REF),HEAD)

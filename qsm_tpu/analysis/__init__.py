@@ -25,7 +25,13 @@ constructed —
 * race (``race_passes`` on the ``callgraph`` whole-program substrate):
   interprocedural lock/thread hazards across serve + resilience +
   tools — lock-order deadlock cycles, unguarded shared writes, thread
-  lifecycle without stop/join, leaked fds/sockets.
+  lifecycle without stop/join, leaked fds/sockets;
+* protocol (``protocol_model`` + ``protocol_passes``, the second
+  whole-program family): the wire contract extracted as a committed
+  artifact (``PROTOCOL.json``/``docs/PROTOCOL.md``) and enforced —
+  unhandled or caller-less ops, request/response field drift,
+  ``_send``-bypassing egress, non-idempotent ops on retry paths, SHED
+  docs carrying verdict keys, artifact drift.
 
 Families are registered declaratively in ``engine.FAMILIES`` (id,
 scan set, runner); ``--family g`` selects by id, ``--changed`` scopes
@@ -42,10 +48,10 @@ from .findings import (ERROR, INFO, WARNING, Finding, Whitelist,
                        render_json, render_sarif, render_text,
                        sort_findings, split_whitelisted)
 from .engine import (DEFAULT_OPS_FILES, DEFAULT_POOL_FILES,
-                     DEFAULT_RACE_FILES, DEFAULT_RESILIENCE_FILES,
-                     DEFAULT_SCHED_FILES, DEFAULT_SERVE_FILES, FAMILIES,
-                     Family, LintReport, default_whitelist_path,
-                     run_lint)
+                     DEFAULT_PROTOCOL_FILES, DEFAULT_RACE_FILES,
+                     DEFAULT_RESILIENCE_FILES, DEFAULT_SCHED_FILES,
+                     DEFAULT_SERVE_FILES, FAMILIES, Family, LintReport,
+                     default_whitelist_path, run_lint)
 
 __all__ = [
     "ERROR", "WARNING", "INFO", "Finding", "Whitelist", "LintReport",
@@ -55,4 +61,5 @@ __all__ = [
     "DEFAULT_OPS_FILES", "DEFAULT_SCHED_FILES",
     "DEFAULT_RESILIENCE_FILES", "DEFAULT_SERVE_FILES",
     "DEFAULT_POOL_FILES", "DEFAULT_RACE_FILES",
+    "DEFAULT_PROTOCOL_FILES",
 ]

@@ -238,6 +238,7 @@ class Project:
         # none exist in the gated tree today)
         ci = self.classes.setdefault(
             node.name, ClassInfo(node.name, rel, node.lineno))
+        init_ids = self._init_node_ids(node)
         for sub in ast.walk(node):
             if isinstance(sub, ast.Call) and is_bounded_join(sub):
                 ci.has_bounded_join = True
@@ -246,7 +247,7 @@ class Project:
             targets = (sub.targets if isinstance(sub, ast.Assign)
                        else [sub.target])
             value = sub.value
-            in_init = self._enclosing_def(node, sub) == "__init__"
+            in_init = id(sub) in init_ids
             for tgt in targets:
                 chain = attr_chain(tgt)
                 if len(chain) != 2 or chain[0] != "self":
@@ -270,14 +271,16 @@ class Project:
                         ci.attr_types.setdefault(attr, t)
 
     @staticmethod
-    def _enclosing_def(cls_node: ast.ClassDef, stmt: ast.AST
-                       ) -> Optional[str]:
+    def _init_node_ids(cls_node: ast.ClassDef) -> Set[int]:
+        """Node ids inside the class's ``__init__`` bodies — one walk
+        per class, not one per assignment (the old per-statement
+        enclosing-def scan was quadratic in class size)."""
+        ids: Set[int] = set()
         for fn in cls_node.body:
-            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for sub in ast.walk(fn):
-                    if sub is stmt:
-                        return fn.name
-        return None
+            if (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and fn.name == "__init__"):
+                ids.update(id(sub) for sub in ast.walk(fn))
+        return ids
 
     # -- id resolution --------------------------------------------------
     def _attr_owner(self, attr: str, module: str, *,

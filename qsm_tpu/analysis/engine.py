@@ -169,6 +169,25 @@ DEFAULT_OBS_FILES = (
     "tools/bench_obs.py", "tools/bench_fleet.py",
     "tools/bench_monitor.py")
 
+# the wire-contract scan set (family l): the contract source, every
+# module that dispatches or sends protocol ops, the helpers whose
+# return docs become responses, and the CLI consumer paths.  The
+# worker pipe (serve/pool.py + serve/worker.py + frames.py framing) is
+# a DIFFERENT plane — supervisor⇄worker ops like ``warm``/``ping``/
+# ``exit`` are deliberately outside the socket contract.
+# ``PROTOCOL.json`` itself is listed so ``--changed`` re-runs the
+# drift gate when only the committed artifact moved; the extractor
+# skips non-``.py`` entries.
+DEFAULT_PROTOCOL_FILES = (
+    "qsm_tpu/serve/protocol.py", "qsm_tpu/serve/server.py",
+    "qsm_tpu/serve/client.py", "qsm_tpu/serve/admission.py",
+    "qsm_tpu/serve/frames.py",
+    "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/gossip.py",
+    "qsm_tpu/fleet/membership.py",
+    "qsm_tpu/obs/collect.py", "qsm_tpu/monitor/session.py",
+    "qsm_tpu/utils/cli.py",
+    "PROTOCOL.json")
+
 
 def default_whitelist_path() -> str:
     return os.path.join(REPO_ROOT, ".qsmlint")
@@ -187,6 +206,9 @@ class _LintRun:
         self.retrace = retrace
         self.seed = seed
         self._specs: Optional[List[tuple]] = None
+        # family (l) stashes its contract summary here so the report
+        # can carry a ``protocol`` block without a second extraction
+        self.protocol_summary: Optional[dict] = None
 
     @property
     def specs(self) -> List[tuple]:
@@ -350,6 +372,20 @@ def _per_file_monitor(path: str, root: str) -> List[Finding]:
     return check_monitor_file(path, root=root)
 
 
+def _run_protocol(ctx: _LintRun, files: List[str]) -> List[Finding]:
+    # one extraction serves both the conformance passes and the
+    # report's ``protocol`` summary block (bench_report trends it);
+    # not cacheable so the summary is present on every run and the
+    # drift verdict always reflects the committed artifact
+    from . import protocol_passes
+    from .protocol_model import ProtocolModel
+
+    model = ProtocolModel([p for p in files if p.endswith(".py")],
+                          root=REPO_ROOT)
+    ctx.protocol_summary = model.summary()
+    return protocol_passes.check_model(model, root=REPO_ROOT)
+
+
 FAMILIES: Dict[str, Family] = {f.fid: f for f in (
     Family(fid="a", key="spec",
            title="spec soundness (parity, domains, bounds, dtypes, "
@@ -425,6 +461,18 @@ FAMILIES: Dict[str, Family] = {f.fid: f for f in (
            files=DEFAULT_MONITOR_FILES, per_file=_per_file_monitor,
            triggers=("qsm_tpu/analysis/monitor_passes.py",
                      "qsm_tpu/analysis/astutil.py")),
+    Family(fid="l", key="protocol",
+           title="wire-contract conformance (unhandled ops, field "
+                 "drift, egress stamping, retry idempotency, SHED "
+                 "purity)",
+           files=DEFAULT_PROTOCOL_FILES,
+           whole=_run_protocol, cacheable=False,
+           triggers=("qsm_tpu/serve/", "qsm_tpu/fleet/",
+                     "qsm_tpu/analysis/protocol_model.py",
+                     "qsm_tpu/analysis/protocol_passes.py",
+                     "qsm_tpu/analysis/callgraph.py",
+                     "qsm_tpu/analysis/astutil.py",
+                     "PROTOCOL.json")),
 )}
 
 
@@ -439,6 +487,7 @@ class LintReport:
     families: List[str] = dataclasses.field(default_factory=list)
     cache: Optional[dict] = None     # {path, hits, misses}
     changed: Optional[dict] = None   # {ref, files} when --changed ran
+    protocol: Optional[dict] = None  # family (l) contract summary
 
     @property
     def errors(self) -> List[Finding]:
@@ -460,6 +509,8 @@ class LintReport:
             meta["cache"] = self.cache
         if self.changed is not None:
             meta["changed"] = self.changed
+        if self.protocol is not None:
+            meta["protocol"] = self.protocol
         return meta
 
     def to_json(self) -> str:
@@ -560,7 +611,8 @@ def run_lint(models: Optional[Sequence[str]] = None,
                       whitelist_path=wl.path if wl else None,
                       families=[f.fid for f in fams],
                       cache=(lint_cache.stats() if lint_cache else None),
-                      changed=changed_meta)
+                      changed=changed_meta,
+                      protocol=ctx.protocol_summary)
 
 
 def _run_family(fam: Family, ctx: _LintRun,

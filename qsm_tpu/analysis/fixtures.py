@@ -704,3 +704,195 @@ class BoundedSessionBufferStub:
 
     def commit_prefix(self, cut):
         self.window = self.window[cut:]           # decided-prefix evict
+
+
+# --- family (l): protocol-conformance fixtures ---------------------------
+#
+# A miniature wire sub-program per rule: an egress class (``_send`` +
+# ``_handle``) declaring its op vocabulary as class attrs, plus the
+# caller paths that exercise it.  The extractor treats each class pair
+# as its own protocol; none of these names collide with the live
+# serve/protocol.py vocabulary and nothing here ever runs.
+
+
+class MiswiredProtocolStub:
+    """Seeded bugs for QSM-PROTO-UNHANDLED + QSM-PROTO-FIELDS: the
+    class declares ``mis.ghost`` but only dispatches ``mis.ping``, and
+    its ``mis.ping`` response writes ``echo`` while the client below
+    reads ``echo_payload`` — the exact drift class a hand-synced
+    protocol accumulates."""
+
+    OPS = ("mis.ping", "mis.ghost")
+
+    def _send(self, ch, doc):
+        doc["node"] = "stub"
+        ch.write(doc)
+
+    def _handle(self, ch, req):
+        op = req.get("op", "mis.ping")
+        if op == "mis.ping":
+            self._send(ch, {"id": req.get("id"), "ok": True,
+                            "echo": req.get("payload")})
+
+
+class MiswiredProtocolClientStub:
+    """The caller half of the miswired pair: sends the undispatched
+    ``mis.ghost`` (QSM-PROTO-UNHANDLED at the send site) and reads the
+    never-written ``echo_payload`` key (QSM-PROTO-FIELDS)."""
+
+    def __init__(self, link):
+        self.link = link
+
+    def ping(self, text):
+        resp = self.link.request({"op": "mis.ping", "payload": text},
+                                 5.0)
+        return resp.get("echo_payload")      # <-- bug: never written
+
+    def ghost(self):
+        return self.link.request({"op": "mis.ghost"}, 5.0)  # <-- bug
+
+
+class WiredProtocolStub:
+    """Sanctioned twin: every declared op dispatched, every response
+    key the client reads written by the handler — must stay CLEAN
+    under QSM-PROTO-UNHANDLED and QSM-PROTO-FIELDS."""
+
+    OPS = ("wired.ping",)
+
+    def _send(self, ch, doc):
+        doc["node"] = "stub"
+        ch.write(doc)
+
+    def _handle(self, ch, req):
+        op = req.get("op", "wired.ping")
+        if op == "wired.ping":
+            self._send(ch, {"id": req.get("id"), "ok": True,
+                            "echo": req.get("payload")})
+
+
+class WiredProtocolClientStub:
+    """Caller half of the clean twin: reads exactly what the handler
+    writes."""
+
+    def __init__(self, link):
+        self.link = link
+
+    def ping(self, text):
+        resp = self.link.request({"op": "wired.ping",
+                                  "payload": text}, 5.0)
+        return resp.get("echo")
+
+
+class UnstampedEgressStub:
+    """Seeded bug for QSM-PROTO-EGRESS: an egress class (it has the
+    one ``_send``) whose handler answers through a raw ``send_doc``
+    instead — the response reaches the wire without the node stamp
+    every consumer correlates on."""
+
+    OPS = ("egress.ping",)
+
+    def _send(self, ch, doc):
+        doc["node"] = "stub"
+        ch.write(doc)
+
+    def _handle(self, ch, req):
+        from ..serve.protocol import send_doc
+
+        op = req.get("op", "egress.ping")
+        if op == "egress.ping":
+            doc = {"id": req.get("id"), "ok": True}
+            send_doc(ch, doc)                # <-- bug: bypasses _send
+
+
+class UnstampedEgressCallerStub:
+    """Caller keeping ``egress.ping`` reachable (the coverage checks
+    must not mask the egress bug with an unrelated finding)."""
+
+    def __init__(self, link):
+        self.link = link
+
+    def ping(self):
+        return self.link.request({"op": "egress.ping"}, 5.0)
+
+
+class StampedEgressStub:
+    """Sanctioned twin: same dispatch shape, response routed through
+    the class's ``_send`` — must stay CLEAN under QSM-PROTO-EGRESS."""
+
+    OPS = ("egress.pong",)
+
+    def _send(self, ch, doc):
+        doc["node"] = "stub"
+        ch.write(doc)
+
+    def _handle(self, ch, req):
+        op = req.get("op", "egress.pong")
+        if op == "egress.pong":
+            self._send(ch, {"id": req.get("id"), "ok": True})
+
+
+class StampedEgressCallerStub:
+    """Caller keeping ``egress.pong`` reachable."""
+
+    def __init__(self, link):
+        self.link = link
+
+    def pong(self):
+        return self.link.request({"op": "egress.pong"}, 5.0)
+
+
+class RetryProtocolServerStub:
+    """The server half of the retry fixtures: dispatches a mutating
+    ``retry.reset`` and a read-only ``retry.get``, declaring only the
+    latter replay-safe — exactly the split QSM-PROTO-RETRY-IDEMPOTENT
+    checks call paths against."""
+
+    OPS = ("retry.reset", "retry.get")
+    IDEMPOTENT_OPS = ("retry.get",)
+
+    def _send(self, ch, doc):
+        doc["node"] = "stub"
+        ch.write(doc)
+
+    def _handle(self, ch, req):
+        op = req.get("op", "retry.get")
+        if op == "retry.reset":
+            self._send(ch, {"id": req.get("id"), "ok": True})
+        elif op == "retry.get":
+            self._send(ch, {"id": req.get("id"), "ok": True})
+
+
+class RetriedMutationClientStub:
+    """Seeded bug for QSM-PROTO-RETRY-IDEMPOTENT: re-sends the
+    mutating ``retry.reset`` across attempts in an except-continue
+    failover loop — a dropped ACK replays the mutation."""
+
+    def __init__(self, link):
+        self.link = link
+
+    def reset(self):
+        req = {"op": "retry.reset"}
+        for _ in range(3):
+            try:
+                return self.link.ask(req, 5.0)  # <-- bug: retried
+            except OSError:
+                continue
+        return None
+
+
+class IdempotentRetryClientStub:
+    """Sanctioned twin: the same failover loop re-sends only the
+    declared-idempotent ``retry.get`` — must stay CLEAN under
+    QSM-PROTO-RETRY-IDEMPOTENT."""
+
+    def __init__(self, link):
+        self.link = link
+
+    def get(self):
+        req = {"op": "retry.get"}
+        for _ in range(3):
+            try:
+                return self.link.ask(req, 5.0)
+            except OSError:
+                continue
+        return None

@@ -52,7 +52,10 @@ class FlightRecorder:
         # discipline): the threshold is the only length that matters
         self._sheds: Deque[float] = deque(
             maxlen=max(self.storm_threshold * 4, 64))
-        self._last_dump = 0.0
+        # None, not 0.0: ``time.monotonic()`` has an arbitrary epoch
+        # (boot time on Linux), so a 0.0 sentinel wrongly suppresses
+        # the FIRST dump whenever uptime < min_interval_s
+        self._last_dump: Optional[float] = None
         self.recorded = 0
         self.dumps = 0
         self.dumps_suppressed = 0
@@ -102,7 +105,8 @@ class FlightRecorder:
         crash-loop bound) or the directory is unwritable."""
         now = time.monotonic()
         with self._lock:
-            if not force and now - self._last_dump < self.min_interval_s:
+            if (not force and self._last_dump is not None
+                    and now - self._last_dump < self.min_interval_s):
                 self.dumps_suppressed += 1
                 return None
             self._last_dump = now

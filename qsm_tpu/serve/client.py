@@ -220,7 +220,15 @@ class CheckClient:
         return self._round_trip(req)
 
     def shutdown(self) -> dict:
-        return self._round_trip({"op": "shutdown"})
+        # Deliberately single-attempt: ``shutdown`` is the one op the
+        # contract excludes from IDEMPOTENT_OPS (serve/protocol.py) —
+        # a failover re-send after a mid-flight drop could stop a
+        # *different* process than the one that already acked.  A
+        # dropped reply after the server acted is indistinguishable
+        # from a dropped request, so the caller sees the error rather
+        # than the client silently escalating it fleet-wide.
+        # (QSM-PROTO-RETRY-IDEMPOTENT pins this shape.)
+        return self._ask_once({"op": "shutdown"})
 
     def close(self) -> None:
         if self._sock is None:

@@ -144,6 +144,55 @@ from ..core.history import History
 # utils/cli.py imports this tuple for every subcommand's output
 VERDICT_NAMES = ("VIOLATION", "LINEARIZABLE", "BUDGET_EXCEEDED")
 
+# ---------------------------------------------------------------------
+# The machine-readable wire contract (docs/PROTOCOL.md).  The protocol
+# analyzer (analysis/protocol_model.py, lint family l) parses these
+# tuples straight from this file's AST — keep them literal: no
+# comprehensions, no computed elements.  Every op a client or router
+# path sends and every op a ``_handle`` dispatches must appear in
+# ``OPS``; the lint gate fails otherwise (QSM-PROTO-UNHANDLED).
+OPS = (
+    "check", "shrink", "stats", "shutdown",
+    "session.open", "session.append", "session.close",
+    "replog.digests", "replog.pull", "replog.push",
+    "replog.covers", "replog.subsumed",
+    "gossip.peers",
+    "obs.spans", "obs.trace", "obs.metrics", "health",
+)
+
+# Ops that MAY legally sit on a retrying call path (CheckClient
+# failover, NodeLink fresh-socket retry, router re-dispatch).  Every
+# entry's replay-safety argument, in one place:
+#   check/shrink      — verdicts bank by history fingerprint; a replay
+#                       answers from the bank (docs/SERVE.md)
+#   session.*         — open resumes by session id; append carries
+#                       ``seq`` so the server applies only unseen
+#                       events; close is a no-op on a closed session
+#   replog.*/gossip.* — anti-entropy reads + set-union writes
+#   stats/obs.*/health— read-only snapshots (spans is cursor-paged)
+# ``shutdown`` is deliberately ABSENT: re-sending it after a mid-flight
+# failover could stop a *different* process than the one addressed, so
+# the client sends it on a single non-retrying attempt
+# (QSM-PROTO-RETRY-IDEMPOTENT pins this).
+IDEMPOTENT_OPS = (
+    "check", "shrink", "stats",
+    "session.open", "session.append", "session.close",
+    "replog.digests", "replog.pull", "replog.push",
+    "replog.covers", "replog.subsumed",
+    "gossip.peers",
+    "obs.spans", "obs.trace", "obs.metrics", "health",
+)
+
+# Envelope keys: request keys any sender may attach / response keys
+# any reply may carry, regardless of op.  ``node``/``term`` are
+# stamped by the ONE ``_send`` egress (never by handlers); ``trace``/
+# ``flight`` ride on admission and tracing.  The field-conformance
+# pass (QSM-PROTO-FIELDS) exempts these from per-op sender/handler
+# matching.
+REQUEST_ENVELOPE = ("op", "id", "trace", "parent", "deadline_s")
+RESPONSE_ENVELOPE = ("ok", "id", "error", "node", "term", "trace",
+                     "flight", "shed", "reason", "router")
+
 # recv granularity and the poll slice used while honoring deadlines /
 # shutdown flags (a LineChannel read is bounded by BOTH)
 _RECV_BYTES = 65536

@@ -318,6 +318,7 @@ class CheckServer:
         self._engine_builds: Dict[str, threading.Lock] = {}
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
+        self._stopped = threading.Event()
         self._threads: List[threading.Thread] = []
         self._t0 = time.monotonic()
         self.requests = 0
@@ -430,6 +431,14 @@ class CheckServer:
         # must fire exactly once or every clean exit banks duplicates
         first_stop = not self._stop.is_set()
         self._stop.set()
+        if not first_stop:
+            # the first stop() often runs on a daemon handler thread
+            # (the shutdown op); returning before it finishes lets the
+            # CLI's finally-stop exit the process and kill that thread
+            # MID-flight-dump — a torn FLIGHT .tmp.  Teardown below is
+            # bounded (joins/waits all carry timeouts), so this is too.
+            self._stopped.wait(15.0)
+            return
         # order matters: the batcher drains FIRST (in-flight batches
         # still need the pool), THEN the pool tears down its worker
         # processes deterministically (exit frame → terminate → bounded
@@ -473,6 +482,7 @@ class CheckServer:
         if global_obs() is self.obs:
             set_global(None)
         self.obs.close()
+        self._stopped.set()
 
     def wait(self, timeout_s: Optional[float] = None) -> bool:
         """Block until the server stops (shutdown request / stop());

@@ -20,6 +20,7 @@ from qsm_tpu.analysis.engine import (DEFAULT_FLEET_FILES,
                                      DEFAULT_OBS_FILES,
                                      DEFAULT_OPS_FILES,
                                      DEFAULT_POOL_FILES,
+                                     DEFAULT_PROTOCOL_FILES,
                                      DEFAULT_RACE_FILES,
                                      DEFAULT_RESILIENCE_FILES,
                                      DEFAULT_SCHED_FILES,
@@ -82,9 +83,13 @@ def test_in_tree_corpus_is_clean(report):
     # monitor bench driver (ISSUE 14)
     assert len(DEFAULT_MONITOR_FILES) == 7
     assert "monitor" in report.passes
-    # a–k all registered and all ran in the default lane
-    assert sorted(FAMILIES) == list("abcdefghijk")
-    assert report.families == list("abcdefghijk")
+    # the wire-contract family (l): the socket-protocol planes plus the
+    # committed PROTOCOL.json artifact (ISSUE 16)
+    assert len(DEFAULT_PROTOCOL_FILES) == 12
+    assert "protocol" in report.passes
+    # a–l all registered and all ran in the default lane
+    assert sorted(FAMILIES) == list("abcdefghijkl")
+    assert report.families == list("abcdefghijkl")
     assert report.ok, "\n".join(
         f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
 
@@ -326,6 +331,121 @@ def test_monitor_live_tree_is_clean():
         findings += check_monitor_file(os.path.join(REPO_ROOT, rel),
                                        root=REPO_ROOT)
     assert findings == []
+
+
+def test_protocol_fixture_matrix():
+    """The protocol pass's bulb check (family l, ISSUE 16): the
+    miswired pair fires QSM-PROTO-UNHANDLED (undispatched ``mis.ghost``
+    at the send site AND as a declared-but-handlerless op) and
+    QSM-PROTO-FIELDS (the never-written ``echo_payload`` read); the
+    ``send_doc``-bypassing handler fires QSM-PROTO-EGRESS; the
+    except-continue loop re-sending the mutating ``retry.reset`` fires
+    QSM-PROTO-RETRY-IDEMPOTENT.  The sanctioned twins (wired pair,
+    ``_send``-routed handler, retried-but-idempotent ``retry.get``)
+    stay clean."""
+    from qsm_tpu.analysis.protocol_passes import check_protocol_project
+
+    findings = check_protocol_project([fixtures.__file__])
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule_id, []).append(f)
+    unhandled = by_rule.pop("QSM-PROTO-UNHANDLED")
+    assert len(unhandled) == 2
+    assert {f.severity for f in unhandled} == {ERROR}
+    assert any("MiswiredProtocolClientStub.ghost" in f.location
+               for f in unhandled)
+    assert all("mis.ghost" in f.message for f in unhandled)
+    fields = by_rule.pop("QSM-PROTO-FIELDS")
+    assert len(fields) == 1 and fields[0].severity == ERROR
+    assert "MiswiredProtocolClientStub.ping" in fields[0].location
+    assert "echo_payload" in fields[0].message
+    egress = by_rule.pop("QSM-PROTO-EGRESS")
+    assert len(egress) == 1 and egress[0].severity == ERROR
+    assert "UnstampedEgressStub._handle" in egress[0].location
+    retry = by_rule.pop("QSM-PROTO-RETRY-IDEMPOTENT")
+    assert len(retry) == 1 and retry[0].severity == ERROR
+    assert "RetriedMutationClientStub.reset" in retry[0].location
+    assert "retry.reset" in retry[0].message
+    assert not by_rule  # nothing else fires on the fixture module
+    clean = ("WiredProtocol", "StampedEgress", "IdempotentRetry")
+    assert not any(c in f.location for c in clean for f in findings)
+
+
+def test_protocol_live_tree_is_clean():
+    """The socket planes keep the contract their pass gates: every op
+    dispatched and called, responses through the one ``_send``, retried
+    ops all declared idempotent, the committed PROTOCOL.json current."""
+    import os
+
+    from qsm_tpu.analysis.engine import REPO_ROOT
+    from qsm_tpu.analysis.protocol_passes import check_protocol_project
+
+    paths = [os.path.join(REPO_ROOT, rel)
+             for rel in DEFAULT_PROTOCOL_FILES]
+    assert check_protocol_project(paths, root=REPO_ROOT) == []
+
+
+def test_protocol_json_is_deterministic_and_covering():
+    """The contract artifact is byte-stable (sorted keys, no
+    timestamps — two extractions, one with the file list reversed,
+    render identically) and total: every op declared in
+    serve/protocol.py appears with at least one handler and one
+    caller."""
+    import os
+
+    from qsm_tpu.analysis.engine import REPO_ROOT
+    from qsm_tpu.analysis.protocol_model import (ProtocolModel,
+                                                 render_protocol_json)
+    from qsm_tpu.serve.protocol import OPS
+
+    paths = [os.path.join(REPO_ROOT, rel)
+             for rel in DEFAULT_PROTOCOL_FILES if rel.endswith(".py")]
+    one = render_protocol_json(ProtocolModel(paths, root=REPO_ROOT))
+    two = render_protocol_json(
+        ProtocolModel(list(reversed(paths)), root=REPO_ROOT))
+    assert one == two
+    doc = json.loads(one)
+    assert sorted(doc["ops"]) == sorted(OPS)
+    for op in OPS:
+        assert doc["ops"][op]["handlers"], f"{op}: no handler"
+        assert doc["ops"][op]["callers"], f"{op}: no caller"
+
+
+def test_protocol_drift_gate(tmp_path):
+    """The pre-refactor safety net: a protocol edit that does not
+    regenerate PROTOCOL.json fails the gate (QSM-PROTO-DRIFT), and the
+    committed artifact matches a fresh extraction today."""
+    import os
+
+    from qsm_tpu.analysis.engine import REPO_ROOT
+    from qsm_tpu.analysis.protocol_passes import check_protocol_project
+
+    paths = [os.path.join(REPO_ROOT, rel)
+             for rel in DEFAULT_PROTOCOL_FILES]
+    committed = os.path.join(REPO_ROOT, "PROTOCOL.json")
+    stale = tmp_path / "PROTOCOL.json"
+    stale.write_text(open(committed).read().replace(
+        '"artifact": "PROTOCOL"', '"artifact": "STALE"'))
+    findings = check_protocol_project(paths, root=REPO_ROOT,
+                                      protocol_path=str(stale))
+    assert [f.rule_id for f in findings] == ["QSM-PROTO-DRIFT"]
+    assert findings[0].severity == ERROR
+    # and the real committed artifact is current (== fresh extraction)
+    assert check_protocol_project(paths, root=REPO_ROOT,
+                                  protocol_path=committed) == []
+
+
+def test_lint_report_carries_protocol_summary(report):
+    """``qsm-tpu lint --json`` exposes the contract trend block —
+    bench_report.py rows key off these counts."""
+    assert report.protocol is not None
+    assert report.protocol["ops"] == 17
+    assert report.protocol["handled_ops"] == report.protocol["ops"]
+    assert report.protocol["called_ops"] == report.protocol["ops"]
+    # shutdown is the one deliberately non-idempotent op, and it must
+    # never appear on a retrying path
+    assert report.protocol["idempotent_ops"] == 16
+    assert "shutdown" not in report.protocol["retried_ops"]
 
 
 def test_unreaped_worker_pool_is_caught():
@@ -775,6 +895,13 @@ def test_sarif_golden_file():
                 " -> WorkerHandle.lock: two threads interleaving these "
                 "paths deadlock",
                 "pick ONE acquisition order for these locks"),
+        Finding("error", "QSM-PROTO-RETRY-IDEMPOTENT",
+                "qsm_tpu/serve/client.py:CheckClient.shutdown:223",
+                "op 'shutdown' rides a retrying call path (via "
+                "CheckClient._round_trip) but is not in the declared "
+                "idempotent set",
+                "make the op replay-safe and add it to IDEMPOTENT_OPS "
+                "in serve/protocol.py, or move it off the retry path"),
         Finding("warning", "QSM-DET-TIME", "qsm_tpu/sched/pool.py:123",
                 "wall-clock read in the scheduler plane"),
         Finding("info", "QSM-SPEC-PARITY", "model:kv",
@@ -787,7 +914,7 @@ def test_sarif_golden_file():
                 "bound it or whitelist with a reviewed note"),
     ]
     rendered = render_sarif(findings, whitelisted,
-                            meta={"version": "r07"}) + "\n"
+                            meta={"version": "r16"}) + "\n"
     import os
 
     golden = os.path.join(os.path.dirname(__file__), "data",
@@ -801,8 +928,9 @@ def test_sarif_golden_file():
     # file findings carry uri+line; model findings a bare uri; the
     # whitelisted one is suppressed, not dropped
     results = run["results"]
-    assert results[0]["locations"][0]["physicalLocation"]["region"][
-        "startLine"] == 340
+    lines = [r["locations"][0]["physicalLocation"].get(
+        "region", {}).get("startLine") for r in results]
+    assert 340 in lines and 223 in lines
     assert [r for r in results if r.get("suppressions")]
 
 

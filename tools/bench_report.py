@@ -14,7 +14,11 @@ table, without pretending they are comparable beyond what they say:
   actually contains (a priority-ordered key sweep over every row —
   throughput rates, gate ratios, reduction factors);
 * rates are never cross-normalized: a ``serve_c4`` h/s and a device
-  ``h/s`` remain labeled by their cell of origin.
+  ``h/s`` remain labeled by their cell of origin;
+* the lint-gate artifact (``LINT_rNN.json``) rides along too: its
+  nested ``protocol`` summary block is flattened into ``protocol_*``
+  facts, so the wire-contract trend (op count, handler coverage,
+  idempotent-set size) is trendable next to the perf rounds.
 
 Output: ``BENCH_REPORT.md`` (the human table, newest round first) and
 ``BENCH_REPORT.json`` (the structured form), both written atomically
@@ -45,6 +49,11 @@ _HEADLINE_KEYS = (
     "nodes_ratio", "ratio_n3_vs_n1", "speedup", "ratio", "mean_ratio",
     "tracing_off_overhead_pct", "tracing_on_overhead_pct",
     "value", "p50_ms", "p99_ms",
+    # the LINT artifact's wire-contract trend (flattened from its
+    # nested ``protocol`` block): op vocabulary size, handler/caller
+    # coverage, declared-idempotent count
+    "protocol_ops", "protocol_handled_ops", "protocol_called_ops",
+    "protocol_idempotent_ops", "protocol_send_sites",
 )
 _GATE_KEYS = ("gate_ok", "all_verified", "wrong_verdicts",
               "wrong_verdicts_total", "rolling_restart_zero_lost")
@@ -63,7 +72,17 @@ def _parse_file(path: str) -> Tuple[Optional[dict], List[dict]]:
         return None, []
     try:
         doc = json.loads(text)
-        return None, [doc] if isinstance(doc, dict) else []
+        if isinstance(doc, dict):
+            proto = doc.get("protocol")
+            if isinstance(proto, dict):
+                # lift the lint document's nested contract summary
+                # into scalar ``protocol_*`` row keys the headline
+                # sweep can see
+                for k, v in proto.items():
+                    if isinstance(v, (int, float)):
+                        doc.setdefault(f"protocol_{k}", v)
+            return None, [doc]
+        return None, []
     except ValueError:
         pass
     rows: List[dict] = []
@@ -155,15 +174,17 @@ def render_markdown(entries: List[dict]) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--glob", default=os.path.join(REPO, "BENCH_*.json"),
-                    help="artifact glob (default: repo-root "
-                         "BENCH_*.json)")
+    ap.add_argument("--glob", action="append", default=None,
+                    help="artifact glob, repeatable (default: "
+                         "repo-root BENCH_*.json + LINT_*.json)")
     ap.add_argument("--md", default=os.path.join(REPO, "BENCH_REPORT.md"))
     ap.add_argument("--json", dest="json_out",
                     default=os.path.join(REPO, "BENCH_REPORT.json"))
     args = ap.parse_args(argv)
-    paths = [p for p in glob.glob(args.glob)
-             if not p.endswith(("BENCH_REPORT.json",))]
+    globs = args.glob or [os.path.join(REPO, "BENCH_*.json"),
+                          os.path.join(REPO, "LINT_*.json")]
+    paths = sorted({p for g in globs for p in glob.glob(g)
+                    if not p.endswith(("BENCH_REPORT.json",))})
     entries = build_report(paths)
     from qsm_tpu.resilience.checkpoint import (atomic_write_json,
                                                atomic_write_text)
@@ -171,7 +192,8 @@ def main(argv=None) -> int:
     atomic_write_text(args.md, render_markdown(entries))
     atomic_write_json(args.json_out,
                       {"artifact": "BENCH_REPORT", "version": 1,
-                       "source_glob": os.path.basename(args.glob),
+                       "source_globs": sorted(os.path.basename(g)
+                                              for g in globs),
                        "artifacts": entries}, indent=1)
     print(f"{len(entries)} artifact(s) -> {args.md} + {args.json_out}")
     return 0

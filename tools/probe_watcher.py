@@ -80,7 +80,7 @@ CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 # interprocedural race analyzer), independent of the window artifacts'
 # ROUND_TAG — renaming those retires banked measurements, renaming this
 # just says which rule set produced the findings.
-LINT_ROUND = "r15"  # family (i) scan set grew fleet/monitor/ingest — r15
+LINT_ROUND = "r16"  # family (l): wire-contract conformance — r16
 LINT_ARTIFACT = os.path.join(REPO, f"LINT_{LINT_ROUND}.json")
 
 # Committed archive of the P-compositionality bench (tools/
@@ -163,8 +163,12 @@ def _lint_fingerprint() -> str:
     whitelist, and must clear a cached refusal just like a code fix).
     Uncommitted edits count — git state would not."""
     latest, count = 0.0, 0
+    # PROTOCOL.json is a lint INPUT too: family (l)'s drift check
+    # compares the committed contract against a fresh extraction, so
+    # regenerating it must clear a cached drift refusal
     paths = [os.path.join(REPO, ".qsmlint"),
-             os.path.join(REPO, "bench.py")]
+             os.path.join(REPO, "bench.py"),
+             os.path.join(REPO, "PROTOCOL.json")]
     # tools/ is part of the scanned corpus too (families d–g read the
     # bench drivers and this watcher): edits there must re-lint
     for sub in ("qsm_tpu", "tools"):
