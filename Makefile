@@ -1,8 +1,8 @@
 # qsm_tpu CI/tooling entry points.
 #
 # `lint-gate` is the static-analysis gate: it runs every registered
-# qsmlint pass family (a–l, docs/ANALYSIS.md) over the full tree,
-# archives the JSON findings document to LINT_r16.json (the artifact
+# qsmlint pass family (a–m, docs/ANALYSIS.md) over the full tree,
+# archives the JSON findings document to LINT_r17.json (the artifact
 # probe_watcher also refreshes before every window seize) and FAILS
 # (exit 1) on any non-whitelisted error-severity finding — including
 # QSM-PROTO-DRIFT when the committed PROTOCOL.json no longer matches a
@@ -13,7 +13,7 @@
 PYTHON ?= python
 # keep in lockstep with tools/probe_watcher.py LINT_ROUND (the watcher
 # archives the same document before every window seize)
-LINT_ARTIFACT ?= LINT_r16.json
+LINT_ARTIFACT ?= LINT_r17.json
 
 # P-compositionality bench (tools/bench_pcomp.py): host-only — no TPU
 # window needed — on CellJournal --resume rails; refreshes the
@@ -51,8 +51,18 @@ FLEET_ARTIFACT ?= BENCH_FLEET_r13.json
 # parity soak at zero wrong verdicts; docs/MONITOR.md)
 MONITOR_ARTIFACT ?= BENCH_MONITOR_r14.json
 
+# Generation bench (tools/bench_gen.py): host-only, CellJournal
+# --resume rails; refreshes the committed BENCH_GEN artifact (steered
+# vs unsteered fuzzing at matched engine-call budget — ≥3× flips or
+# nodes/history on ≥2 families — every flip re-found by a fresh memo
+# oracle, witnesses replayed via verify_witness, and the 2-node
+# closed-loop soak at zero wrong verdicts with SLO health exit 0;
+# docs/GENERATION.md)
+GEN_ARTIFACT ?= BENCH_GEN_r17.json
+
 .PHONY: lint-gate lint-changed lint-sarif protocol test bench-pcomp \
-	bench-shrink bench-obs bench-fleet bench-monitor bench-report
+	bench-shrink bench-obs bench-fleet bench-monitor bench-gen \
+	bench-report
 
 lint-gate:
 	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT)
@@ -90,6 +100,10 @@ bench-fleet:
 bench-monitor:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_monitor.py \
 		--out $(MONITOR_ARTIFACT) --resume
+
+bench-gen:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_gen.py \
+		--out $(GEN_ARTIFACT) --resume
 
 # Aggregate every committed BENCH_*.json into one per-round trend
 # table (BENCH_REPORT.md + BENCH_REPORT.json, atomic + deterministic)

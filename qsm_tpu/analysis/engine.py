@@ -169,6 +169,14 @@ DEFAULT_OBS_FILES = (
     "tools/bench_obs.py", "tools/bench_fleet.py",
     "tools/bench_monitor.py")
 
+# the generation-plane modules the campaign-bound pass covers (family
+# m): the fuzzer core + steering loop + fleet shim, and the gen bench
+# driver (ISSUE 17)
+DEFAULT_GEN_FILES = (
+    "qsm_tpu/gen/core.py", "qsm_tpu/gen/profile.py",
+    "qsm_tpu/gen/steer.py", "qsm_tpu/gen/fleet.py",
+    "tools/bench_gen.py")
+
 # the wire-contract scan set (family l): the contract source, every
 # module that dispatches or sends protocol ops, the helpers whose
 # return docs become responses, and the CLI consumer paths.  The
@@ -372,6 +380,12 @@ def _per_file_monitor(path: str, root: str) -> List[Finding]:
     return check_monitor_file(path, root=root)
 
 
+def _per_file_gen(path: str, root: str) -> List[Finding]:
+    from .gen_passes import check_gen_file
+
+    return check_gen_file(path, root=root)
+
+
 def _run_protocol(ctx: _LintRun, files: List[str]) -> List[Finding]:
     # one extraction serves both the conformance passes and the
     # report's ``protocol`` summary block (bench_report trends it);
@@ -473,6 +487,14 @@ FAMILIES: Dict[str, Family] = {f.fid: f for f in (
                      "qsm_tpu/analysis/callgraph.py",
                      "qsm_tpu/analysis/astutil.py",
                      "PROTOCOL.json")),
+    Family(fid="m", key="gen",
+           title="generation-campaign bounds (capacity-evicted seed "
+                 "pools, tail-windowed flip logs)",
+           files=DEFAULT_GEN_FILES, per_file=_per_file_gen,
+           triggers=("qsm_tpu/analysis/gen_passes.py",
+                     # family m's scan shares family k's class scan
+                     "qsm_tpu/analysis/monitor_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
 )}
 
 

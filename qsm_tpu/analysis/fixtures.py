@@ -706,6 +706,51 @@ class BoundedSessionBufferStub:
         self.window = self.window[cut:]           # decided-prefix evict
 
 
+class UnboundedSeedPoolStub:
+    """Seeded bug for the generation passes (family m): a fuzzing
+    campaign accumulator — a seed corpus AND a kept-flips log — grown
+    once per round with NO cap comparison and NO eviction anywhere in
+    the class (QSM-GEN-UNBOUNDED — an open-ended soak accumulates it
+    until the driving host OOMs).  Never executed; tests point the gen
+    AST pass at this file and assert the rule fires for exactly this
+    class."""
+
+    def __init__(self):
+        self.seeds = []
+        self.flips = []
+
+    def keep(self, entry, violated):
+        self.seeds.append(entry)         # <-- bug: corpus never evicts
+        if violated:
+            self.flips.append(entry)     # <-- bug: flip log unbounded
+
+    def best(self):
+        return max(self.seeds, default=None)
+
+
+class BoundedSeedPoolStub:
+    """The sanctioned twins the gen pass must NOT flag: a
+    capacity-evicted corpus (the steer.py ``SeedPool.add`` shape) and a
+    flip log pruned to a tail window by reassignment (the kept-flips
+    shape) — must stay CLEAN under QSM-GEN-UNBOUNDED."""
+
+    CAP = 16
+    FLIP_KEEP = 64
+
+    def __init__(self):
+        self.seeds = []
+        self.flips = []
+
+    def keep(self, entry, violated):
+        self.seeds.append(entry)
+        self.seeds.sort()
+        while len(self.seeds) > self.CAP:         # explicit cap
+            self.seeds.pop()                      # worst-scored evict
+        if violated:
+            self.flips.append(entry)
+            self.flips = self.flips[-self.FLIP_KEEP:]   # tail window
+
+
 # --- family (l): protocol-conformance fixtures ---------------------------
 #
 # A miniature wire sub-program per rule: an egress class (``_send`` +

@@ -1,0 +1,31 @@
+"""The coverage-guided workload generation plane (docs/GENERATION.md).
+
+Everything upstream of this package answers "is this history
+linearizable?"; this package manufactures the histories worth asking
+about.  Three layers, innermost first:
+
+* :mod:`.core` — batched command-sequence generation: a seeded raw-draw
+  table (pure-Python stream, or per-lane ``jax.random`` key splits under
+  ``vmap``) assembled host-side into well-formed concurrent
+  :class:`~qsm_tpu.core.history.History` batches, parameterized by a
+  :class:`.GenProfile` and sized to the planner's compile buckets.
+* :mod:`.steer` — the feedback loop: a BOUNDED seed pool of profiles
+  mutated and scored by what the check plane already measures — search
+  nodes per history (``SearchStats``), verdict flips, corpus shape
+  (``profile_corpus``) — with ``atomic_write_json`` checkpoints.
+* :mod:`.fleet` — the closed loop: ``qsm-tpu fuzz --addr`` soaks a live
+  fleet with generated check requests and monitor sessions, every
+  returned verdict re-proved against a fresh memo oracle.
+
+Soundness note: generation STEERS, it never judges.  A generated corpus
+feeds the same check plane as any other workload; no counter or score in
+this package contributes to a verdict (the ``gen_*`` counters in
+search/stats.py are additive bookkeeping only).
+"""
+
+from .core import generate_batch, generate_history
+from .profile import GenProfile
+from .steer import SeedPool, SteeringLoop
+
+__all__ = ["GenProfile", "SeedPool", "SteeringLoop", "generate_batch",
+           "generate_history"]

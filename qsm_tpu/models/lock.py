@@ -1,0 +1,174 @@
+"""Counting semaphore (mutex at ``permits=1``) — the lock family the
+generation plane stresses (ISSUE 17; ROADMAP item 3).
+
+The model state is the number of permits currently AVAILABLE — scalar
+with bound ``permits + 1`` — so the family rides the domain-table fast
+paths like set/rangeset.  What makes it worth having next to them is
+the bug shape: the racy implementation's ``try_acquire`` is the
+check-then-act race the whole analysis plane revolves around — a load
+of the permit count and a decrement in separate round trips, the exact
+interprocedural pattern the race-lint fixtures seed
+(``analysis/fixtures.py`` check-then-act stubs, family g) and the
+QSM-RACE passes hunt statically.  Here the SAME pattern is caught
+*dynamically*: two concurrent acquires of the last permit both observe
+1 and both report success, and no linearization order admits two
+acquires from one available permit.  The fixture stubs and this SUT
+cross-check each other — one pins the analyzer, one pins the checker.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.spec import CmdSig, Spec
+from ..sched.scheduler import Recv, Scheduler, Send
+
+ACQUIRE = 0
+RELEASE = 1
+AVAILABLE = 2
+
+
+class SemaphoreSpec(Spec):
+    """Counting semaphore over ``permits`` permits.
+
+    ACQUIRE responds 1 and takes a permit iff one is available, else 0
+    (a non-blocking try-acquire: blocking would make every history with
+    contention pending-only).  RELEASE responds 1 and returns a permit
+    iff one is held, else 0 (over-release refused, so the count stays
+    in domain).  AVAILABLE responds the current count; never mutates.
+    """
+
+    name = "semaphore"
+    STATE_DIM = 1
+
+    def __init__(self, permits: int = 2):
+        if not 1 <= permits <= 8:
+            raise ValueError(f"permits must be in [1, 8], got {permits}")
+        self.permits = permits
+        self.CMDS = (
+            CmdSig("acquire", n_args=1, n_resps=2),
+            CmdSig("release", n_args=1, n_resps=2),
+            CmdSig("available", n_args=1, n_resps=permits + 1),
+        )
+
+    def initial_state(self) -> np.ndarray:
+        return np.full(1, self.permits, np.int32)
+
+    def scalar_state_bound(self, n_ops):
+        return self.permits + 1  # available count stays in [0, permits]
+
+    def spec_kwargs(self):
+        return {"permits": self.permits}
+
+    def step_py(self, state, cmd, arg, resp):
+        avail = state[0]
+        if cmd == ACQUIRE:
+            if avail > 0:
+                return [avail - 1], resp == 1
+            return [avail], resp == 0
+        if cmd == RELEASE:
+            if avail < self.permits:
+                return [avail + 1], resp == 1
+            return [avail], resp == 0
+        return [avail], resp == avail
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        avail = state[0]
+        can_take = avail > 0
+        can_give = avail < self.permits
+        ok = jnp.where(
+            cmd == ACQUIRE, resp == can_take.astype(resp.dtype),
+            jnp.where(cmd == RELEASE, resp == can_give.astype(resp.dtype),
+                      resp == avail))
+        new_avail = jnp.where(
+            cmd == ACQUIRE, jnp.where(can_take, avail - 1, avail),
+            jnp.where(cmd == RELEASE,
+                      jnp.where(can_give, avail + 1, avail), avail))
+        return jnp.stack([new_avail.astype(state.dtype)]), ok
+
+
+# ---------------------------------------------------------------------------
+# SUT implementations
+# ---------------------------------------------------------------------------
+
+def _sem_server(store: dict, permits: int):
+    """Server applying acquire/release/available atomically per message;
+    also answers the racy SUT's load/decrement protocol."""
+    while True:
+        msg = yield Recv()
+        kind = msg.payload
+        if kind == "acquire":
+            if store["avail"] > 0:
+                store["avail"] -= 1
+                yield Send(msg.src, 1)
+            else:
+                yield Send(msg.src, 0)
+        elif kind == "release":
+            if store["avail"] < permits:
+                store["avail"] += 1
+                yield Send(msg.src, 1)
+            else:
+                yield Send(msg.src, 0)
+        elif kind == "available":
+            yield Send(msg.src, store["avail"])
+        elif kind == "take":
+            # unconditional decrement — the racy client's second half.
+            # Clamped at 0 so later ``available`` replies stay in the
+            # spec's response domain (resp -1 is the history encoding's
+            # pending sentinel); the violation lives in the two resp-1
+            # acquires of one permit, not in a negative count.
+            store["avail"] = max(0, store["avail"] - 1)
+            yield Send(msg.src, 0)
+
+
+class AtomicSemaphoreSUT:
+    """Correct: acquire is one atomically-applied server message.
+    Expected to PASS prop_concurrent."""
+
+    def __init__(self, spec: SemaphoreSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {"avail": self.spec.permits}
+        sched.spawn("server", _sem_server(self.store, self.spec.permits),
+                    daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        kind = ("acquire", "release", "available")[cmd]
+        yield Send("server", kind)
+        msg = yield Recv()
+        return msg.payload
+
+
+class RacyCheckThenActSemaphoreSUT:
+    """Racy: acquire loads the available count and decrements in
+    SEPARATE round trips — the check-then-act shape the race-lint
+    fixtures seed statically.  Two concurrent acquires of the last
+    permit both observe 1 and both claim it (resp 1); the model says
+    the second linearized acquire must respond 0.  Expected to FAIL."""
+
+    def __init__(self, spec: SemaphoreSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {"avail": self.spec.permits}
+        sched.spawn("server", _sem_server(self.store, self.spec.permits),
+                    daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        if cmd != ACQUIRE:
+            kind = ("acquire", "release", "available")[cmd]
+            yield Send("server", kind)
+            msg = yield Recv()
+            return msg.payload
+        yield Send("server", "available")
+        msg = yield Recv()
+        if msg.payload <= 0:
+            return 0
+        # non-atomic: the availability check happened in a separate
+        # round trip; another pid's take can land before this one does
+        yield Send("server", "take")
+        yield Recv()
+        return 1

@@ -1,0 +1,173 @@
+"""Range-query set — the set/map family the generation plane stresses
+(ISSUE 17; ROADMAP item 3).
+
+``RangeSetSpec`` extends the bitmask-set shape (models/set.py) with an
+order-statistics RANGE op: ``count_below(k)`` answers how many members
+are strictly below ``k``.  The response is a function of MANY keys at
+once, which is exactly what kv/cas histories cannot express — and what
+makes the racy implementation's bug shape new: a range scan that reads
+per-key membership in separate round trips observes a *snapshot no
+linearization point produces* when adds/removes land mid-scan.
+
+State stays one membership bitmask (scalar, bound ``2**n_keys``), so
+the family rides every fast path at once — the compiled domain step
+table, the native C++ table kernel, and the device kernel's per-history
+step-table gather — while its histories are adversarial for the search
+(a count response constrains the whole mask, not one bit).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.spec import CmdSig, Spec
+from ..sched.scheduler import Recv, Scheduler, Send
+
+ADD = 0
+REMOVE = 1
+CONTAINS = 2
+COUNT_BELOW = 3
+
+
+class RangeSetSpec(Spec):
+    """Set over keys [0, n_keys) with an order-statistics range query.
+
+    ADD(k) responds 1 iff k was absent (and inserts it), else 0.
+    REMOVE(k) responds 1 iff k was present (and removes it), else 0.
+    CONTAINS(k) responds the membership bit; never mutates.
+    COUNT_BELOW(k) responds ``popcount(mask & ((1 << k) - 1))`` — the
+    number of members strictly below k; never mutates.  ``k`` ranges
+    over [0, n_keys]: ``COUNT_BELOW(n_keys)`` is the full cardinality.
+    """
+
+    name = "rangeset"
+    STATE_DIM = 1
+
+    def __init__(self, n_keys: int = 4):
+        if not 1 <= n_keys <= 16:
+            raise ValueError(f"n_keys must be in [1, 16], got {n_keys}")
+        self.n_keys = n_keys
+        self.CMDS = (
+            CmdSig("add", n_args=n_keys, n_resps=2),
+            CmdSig("remove", n_args=n_keys, n_resps=2),
+            CmdSig("contains", n_args=n_keys, n_resps=2),
+            # arg domain includes n_keys (count the whole set); response
+            # domain is a COUNT in [0, n_keys]
+            CmdSig("count_below", n_args=n_keys + 1, n_resps=n_keys + 1),
+        )
+
+    def initial_state(self) -> np.ndarray:
+        return np.zeros(1, np.int32)
+
+    def scalar_state_bound(self, n_ops):
+        return 1 << self.n_keys  # state is always a membership mask
+
+    def spec_kwargs(self):
+        return {"n_keys": self.n_keys}
+
+    def step_py(self, state, cmd, arg, resp):
+        mask = state[0]
+        if cmd == COUNT_BELOW:
+            below = int(mask) & ((1 << arg) - 1)
+            return [mask], resp == bin(below).count("1")
+        present = (mask >> arg) & 1
+        if cmd == ADD:
+            return [mask | (1 << arg)], resp == 1 - present
+        if cmd == REMOVE:
+            return [mask & ~(1 << arg)], resp == present
+        return [mask], resp == present
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        mask = state[0]
+        bit = jnp.int32(1) << arg
+        present = (mask >> arg) & 1
+        # branchless popcount of the below-arg prefix: sum the masked
+        # bits across the (static) key domain
+        iota = jnp.arange(self.n_keys, dtype=jnp.int32)
+        below = jnp.sum(((mask >> iota) & 1) * (iota < arg))
+        ok = jnp.where(
+            cmd == COUNT_BELOW, resp == below,
+            jnp.where(cmd == ADD, resp == 1 - present, resp == present))
+        new_mask = jnp.where(
+            cmd == ADD, mask | bit,
+            jnp.where(cmd == REMOVE, mask & ~bit, mask))
+        return jnp.stack([new_mask.astype(state.dtype)]), ok
+
+
+# ---------------------------------------------------------------------------
+# SUT implementations
+# ---------------------------------------------------------------------------
+
+def _rangeset_server(store: dict):
+    """Server applying add/remove/contains/count atomically per message;
+    also answers the racy SUT's per-key probe protocol."""
+    while True:
+        msg = yield Recv()
+        kind, key = msg.payload
+        items = store["items"]
+        if kind == "add":
+            if key in items:
+                yield Send(msg.src, 0)
+            else:
+                items.add(key)
+                yield Send(msg.src, 1)
+        elif kind == "remove":
+            if key in items:
+                items.discard(key)
+                yield Send(msg.src, 1)
+            else:
+                yield Send(msg.src, 0)
+        elif kind == "contains":
+            yield Send(msg.src, 1 if key in items else 0)
+        elif kind == "count_below":
+            yield Send(msg.src, sum(1 for k in items if k < key))
+
+
+class AtomicRangeSetSUT:
+    """Correct: each op — the range query included — is one atomically
+    applied server message.  Expected to PASS prop_concurrent."""
+
+    def __init__(self, spec: RangeSetSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {"items": set()}
+        sched.spawn("server", _rangeset_server(self.store), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        kind = ("add", "remove", "contains", "count_below")[cmd]
+        yield Send("server", (kind, arg))
+        msg = yield Recv()
+        return msg.payload
+
+
+class ScanningRangeSetSUT:
+    """Racy: COUNT_BELOW is a per-key contains SCAN — one round trip per
+    key below the bound — so adds/removes that land mid-scan yield a
+    count no single linearization point produces (a key counted before
+    its removal plus one added behind the cursor).  Point ops are
+    atomic; only the range op torn.  Expected to FAIL."""
+
+    def __init__(self, spec: RangeSetSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {"items": set()}
+        sched.spawn("server", _rangeset_server(self.store), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        if cmd != COUNT_BELOW:
+            kind = ("add", "remove", "contains")[cmd]
+            yield Send("server", (kind, arg))
+            msg = yield Recv()
+            return msg.payload
+        # non-atomic: each membership probe is its own round trip; the
+        # set can change between probes, so the sum is a torn snapshot
+        count = 0
+        for key in range(arg):
+            yield Send("server", ("contains", key))
+            msg = yield Recv()
+            count += msg.payload
+        return count

@@ -20,8 +20,13 @@ from .failover import AsyncReplFailoverSUT, SyncReplFailoverSUT
 from .multi import (AtomicMultiCasSUT, AtomicMultiRegisterSUT,
                     MultiCasSpec, MultiRegisterSpec, RacyMultiCasSUT,
                     ShardedStaleMultiRegisterSUT)
+from .lock import (AtomicSemaphoreSUT, RacyCheckThenActSemaphoreSUT,
+                   SemaphoreSpec)
+from .rangeset import (AtomicRangeSetSUT, RangeSetSpec,
+                       ScanningRangeSetSUT)
 from .set import AtomicSetSUT, RacyCheckThenActSetSUT, SetSpec
 from .stack import AtomicStackSUT, RacyTwoPhaseStackSUT, StackSpec
+from .txn import AtomicTxnSUT, TornCopyTxnSUT, TxnRegisterSpec
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +85,23 @@ MODELS: Dict[str, ModelEntry] = {
     "stack": ModelEntry(
         make_spec=StackSpec,
         impls={"atomic": AtomicStackSUT, "racy": RacyTwoPhaseStackSUT},
+        default_pids=8, default_ops=32),
+    # generation-plane families (ISSUE 17): a range-query set, a lock/
+    # semaphore cross-checking the race-lint fixtures, and the
+    # deliberately non-decomposable multi-key transaction family whose
+    # projection every consumer must REFUSE (models/txn.py docstring)
+    "rangeset": ModelEntry(
+        make_spec=RangeSetSpec,
+        impls={"atomic": AtomicRangeSetSUT, "racy": ScanningRangeSetSUT},
+        default_pids=4, default_ops=24),
+    "semaphore": ModelEntry(
+        make_spec=SemaphoreSpec,
+        impls={"atomic": AtomicSemaphoreSUT,
+               "racy": RacyCheckThenActSemaphoreSUT},
+        default_pids=4, default_ops=24),
+    "txn": ModelEntry(
+        make_spec=TxnRegisterSpec,
+        impls={"atomic": AtomicTxnSUT, "racy": TornCopyTxnSUT},
         default_pids=8, default_ops=32),
     # failover register: atomic = synchronous replication, racy = async
     # (the lost-acked-write bug).  Discriminated under a CRASH schedule

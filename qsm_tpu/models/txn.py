@@ -1,0 +1,203 @@
+"""Multi-key transactions — the DELIBERATELY non-decomposable family
+(ISSUE 17; P-compositionality refusal exercised as a feature).
+
+``TxnRegisterSpec`` looks exactly like ``MultiRegisterSpec`` — per-cell
+reads and writes with the same declarative :class:`~qsm_tpu.core.spec.
+KeyProj` tags — plus one multi-key op: ``copy(src, dst)`` reads cell
+``src`` and writes its value into cell ``dst`` in one atomic step.  The
+copy also DECLARES a KeyProj (keyed by ``src``), so on paper the spec
+advertises per-key decomposability; in truth a copy couples two keys —
+its write to ``dst`` is a change outside its declared key's component,
+and the value it writes depends on state the projected register can
+never see.
+
+That makes this family the compile-time validator's showcase:
+``projection_report`` (core/spec.py) fails it on the independence probe
+("step leaks into keys […]") and every decomposition consumer refuses
+with that report as the why stamp — ``PComp`` raises
+``NotDecomposableError``, the planner stamps ``decompose_keys=off
+(refused: …)``, the serve plane stamps ``pcomp=off (refused: …)``
+(pinned in tests/test_models_gen.py).  Whole-history checking remains
+fully sound — refusal costs speed, never verdicts.  The deliberate
+QSM-SPEC-PCOMP finding is whitelisted in ``.qsmlint`` with this
+rationale.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.spec import CmdSig, KeyProj, Spec
+from ..sched.scheduler import Recv, Scheduler, Send
+
+READ = 0
+WRITE = 1
+COPY = 2
+
+
+class TxnRegisterSpec(Spec):
+    """``n_cells`` registers over values [0, n_values) with a cross-cell
+    copy.
+
+    READ(cell) responds the cell's value; WRITE packs ``cell * n_values
+    + v`` and responds 0; COPY packs the ``src != dst`` pair as
+    ``src * (n_cells - 1) + off`` (``off`` skipping the diagonal), sets
+    ``dst := value(src)`` and responds 0.  ``2 <= n_cells <= n_values``
+    is required so the copy's (bogus) projection passes the DOMAIN
+    checks and the refusal stamp is the interesting one — the
+    independence failure ("step leaks into keys […]"), not a packing
+    arithmetic error.
+    """
+
+    name = "txn"
+
+    def __init__(self, n_cells: int = 4, n_values: int = 4):
+        if not 2 <= n_cells <= n_values:
+            raise ValueError(
+                "need 2 <= n_cells <= n_values (see docstring)")
+        self.n_cells = n_cells
+        self.n_values = n_values
+        self.STATE_DIM = n_cells
+        self.CMDS = (
+            CmdSig("read", n_args=n_cells, n_resps=n_values,
+                   proj=KeyProj(pcmd=READ, stride=1)),
+            CmdSig("write", n_args=n_cells * n_values, n_resps=1,
+                   proj=KeyProj(pcmd=WRITE, stride=n_values)),
+            # the lie: copy claims to be a per-src-key op projecting
+            # onto a register write, but its step mutates dst — the
+            # validator's independence probe catches exactly this
+            CmdSig("copy", n_args=n_cells * (n_cells - 1), n_resps=1,
+                   proj=KeyProj(pcmd=WRITE, stride=n_cells - 1)),
+        )
+
+    def initial_state(self) -> np.ndarray:
+        return np.zeros(self.n_cells, np.int32)
+
+    def write_arg(self, cell: int, value: int) -> int:
+        return cell * self.n_values + value
+
+    def copy_arg(self, src: int, dst: int) -> int:
+        off = dst - 1 if dst > src else dst  # diagonal excluded
+        return src * (self.n_cells - 1) + off
+
+    def copy_pair(self, arg: int):
+        src, off = divmod(arg, self.n_cells - 1)
+        return src, off + 1 if off >= src else off
+
+    def spec_kwargs(self):
+        return {"n_cells": self.n_cells, "n_values": self.n_values}
+
+    def state_elem_bounds(self):
+        return [self.n_values] * self.n_cells
+
+    def projected_spec(self):
+        from .register import RegisterSpec
+
+        return RegisterSpec(n_values=self.n_values)
+
+    def step_py(self, state, cmd, arg, resp):
+        state = list(state)
+        if cmd == READ:
+            return state, resp == state[arg]
+        if cmd == WRITE:
+            cell, value = divmod(arg, self.n_values)
+            state[cell] = value
+            return state, resp == 0
+        src, dst = self.copy_pair(arg)
+        state[dst] = state[src]
+        return state, resp == 0
+
+    def step_jax(self, state, cmd, arg, resp):
+        import jax.numpy as jnp
+
+        iota = jnp.arange(self.n_cells)
+        is_read = cmd == READ
+        is_write = cmd == WRITE
+        w_cell = arg // self.n_values
+        w_val = arg % self.n_values
+        src = arg // (self.n_cells - 1)
+        off = arg % (self.n_cells - 1)
+        dst = jnp.where(off >= src, off + 1, off)
+        cell = jnp.where(is_read, arg, jnp.where(is_write, w_cell, dst))
+        value = jnp.where(is_write, w_val, state[src])
+        ok = jnp.where(is_read, resp == state[arg], resp == 0)
+        new_state = jnp.where(~is_read & (iota == cell), value, state)
+        return new_state.astype(state.dtype), ok
+
+
+# ---------------------------------------------------------------------------
+# SUT implementations
+# ---------------------------------------------------------------------------
+
+def _txn_server(store: dict):
+    """One server applying read/write/copy per message, atomically."""
+    while True:
+        msg = yield Recv()
+        kind, *rest = msg.payload
+        if kind == "read":
+            yield Send(msg.src, store.get(rest[0], 0))
+        elif kind == "write":
+            cell, value = rest
+            store[cell] = value
+            yield Send(msg.src, 0)
+        else:  # copy, atomic server-side
+            src, dst = rest
+            store[dst] = store.get(src, 0)
+            yield Send(msg.src, 0)
+
+
+class AtomicTxnSUT:
+    """Correct: the copy is one server message — read-then-write applied
+    atomically.  Expected to PASS prop_concurrent."""
+
+    def __init__(self, spec: TxnRegisterSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {}
+        sched.spawn("server", _txn_server(self.store), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        if cmd == READ:
+            yield Send("server", ("read", arg))
+        elif cmd == WRITE:
+            cell, value = divmod(arg, self.spec.n_values)
+            yield Send("server", ("write", cell, value))
+        else:
+            src, dst = self.spec.copy_pair(arg)
+            yield Send("server", ("copy", src, dst))
+        msg = yield Recv()
+        return msg.payload
+
+
+class TornCopyTxnSUT:
+    """Racy: copy is read-src-then-write-dst as separate round trips —
+    a write to ``src`` that lands in between makes the copy install a
+    value no atomic copy could have observed at any single point
+    (stale-read torn transaction).  Expected to FAIL."""
+
+    def __init__(self, spec: TxnRegisterSpec):
+        self.spec = spec
+
+    def setup(self, sched: Scheduler) -> None:
+        self.store = {}
+        sched.spawn("server", _txn_server(self.store), daemon=True)
+
+    def perform(self, pid: int, cmd: int, arg: int):
+        if cmd == READ:
+            yield Send("server", ("read", arg))
+            msg = yield Recv()
+            return msg.payload
+        if cmd == WRITE:
+            cell, value = divmod(arg, self.spec.n_values)
+            yield Send("server", ("write", cell, value))
+            msg = yield Recv()
+            return msg.payload
+        src, dst = self.spec.copy_pair(arg)
+        yield Send("server", ("read", src))
+        msg = yield Recv()
+        # non-atomic: the source read happened in its own round trip;
+        # a write to src can land before this dst write does
+        yield Send("server", ("write", dst, msg.payload))
+        yield Recv()
+        return 0

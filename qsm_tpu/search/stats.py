@@ -94,6 +94,17 @@ class SearchStats:
     frontier_advances: int = 0   # quiescent cuts committed
     flips_pushed: int = 0        # violation flips handed to clients
     prefix_hits: int = 0         # cuts resumed from the decided-prefix bank
+    # Generation plane (qsm_tpu/gen): the workload-fuzzer's cost/shape
+    # record — command sequences generated, profile/seed mutations the
+    # steering loop applied, verdict flips (violations) its corpora
+    # induced, and feedback rounds scored.  A fuzz campaign's record
+    # must say how much adversarial workload it manufactured — and the
+    # oracle's own counters above stay untouched: generation never
+    # contributes to a verdict (docs/GENERATION.md soundness note).
+    gen_seqs: int = 0            # command sequences (histories) generated
+    gen_mutations: int = 0       # profile/seed mutations applied
+    gen_flips: int = 0           # violations induced by generated corpora
+    gen_feedback_rounds: int = 0  # steering rounds scored
 
     # -- derived -----------------------------------------------------------
     @property
@@ -121,7 +132,8 @@ class SearchStats:
                   "pcomp_subs", "pcomp_recombine_ms", "shrink_rounds",
                   "shrink_lanes", "shrink_memo_hits", "obs_events",
                   "session_events", "frontier_advances", "flips_pushed",
-                  "prefix_hits"):
+                  "prefix_hits", "gen_seqs", "gen_mutations", "gen_flips",
+                  "gen_feedback_rounds"):
             setattr(self, f, getattr(self, f) + getattr(other, f))
         # a maximum, not a tally: the composed record's worst sub-history
         # is the worst either side saw
@@ -192,6 +204,15 @@ class SearchStats:
             "fad": self.frontier_advances,
             "flp": self.flips_pushed,
             "pfh": self.prefix_hits,
+            # generation-plane counters (qsm_tpu/gen): a bench row from
+            # a fuzz campaign must say how many sequences it generated,
+            # how many mutations steering applied, how many verdict
+            # flips the corpora induced, and how many feedback rounds
+            # were scored
+            "gsq": self.gen_seqs,
+            "gmu": self.gen_mutations,
+            "gfl": self.gen_flips,
+            "gfr": self.gen_feedback_rounds,
         }
 
     def to_timings(self) -> Dict[str, float]:
@@ -243,6 +264,14 @@ class SearchStats:
             out["frontier_advances"] = float(self.frontier_advances)
             out["flips_pushed"] = float(self.flips_pushed)
             out["prefix_hits"] = float(self.prefix_hits)
+        # generation accounting only when the fuzzer actually generated
+        # — zeros would claim "fuzzed, produced nothing" on every plain
+        # check run
+        if self.gen_seqs:
+            out["gen_seqs"] = float(self.gen_seqs)
+            out["gen_mutations"] = float(self.gen_mutations)
+            out["gen_flips"] = float(self.gen_flips)
+            out["gen_feedback_rounds"] = float(self.gen_feedback_rounds)
         return out
 
 
@@ -254,7 +283,8 @@ _COUNTER_FIELDS = ("histories", "lockstep_iters", "nodes_explored",
                    "pcomp_split", "pcomp_subs", "pcomp_recombine_ms",
                    "shrink_rounds", "shrink_lanes", "shrink_memo_hits",
                    "obs_events", "session_events", "frontier_advances",
-                   "flips_pushed", "prefix_hits")
+                   "flips_pushed", "prefix_hits", "gen_seqs",
+                   "gen_mutations", "gen_flips", "gen_feedback_rounds")
 # pcomp_max_sub and shrink_ratio_pct are deliberately NOT delta fields:
 # a maximum/ratio has no meaningful "per-run difference", so stats_delta
 # keeps `after`'s value.

@@ -1774,6 +1774,27 @@ def cmd_explore(args) -> int:
 
 
 def cmd_fuzz(args) -> int:
+    if args.addr:
+        # closed-loop fleet fuzzing (docs/GENERATION.md): steered
+        # generated corpora as check requests + monitor sessions
+        # against a live fleet, every verdict re-proved locally.
+        # Exit codes compose the two failure planes: wrong verdicts
+        # trump everything, else the fleet's own health verdict
+        # (same codes as `qsm-tpu health`).
+        from ..gen.fleet import fuzz_fleet
+
+        rep = fuzz_fleet(
+            args.addr, args.models.split(","), rounds=args.rounds,
+            batch=args.histories, seed=args.seed,
+            session_every=args.session_every,
+            deadline_s=args.deadline, timeout_s=args.timeout,
+            checkpoint_dir=args.checkpoint_dir,
+            log=lambda m: print(m, file=sys.stderr))
+        print(json.dumps(rep))
+        if rep["wrong_verdicts_total"]:
+            return 1
+        return int(rep["exit_code"])
+
     from .fuzz import fuzz_parity
 
     if {"device", "segdc", "auto", "hybrid"} & set(args.backends.split(",")):
@@ -2277,15 +2298,38 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_explore)
 
     p = sub.add_parser(
-        "fuzz", help="differential backend fuzzing over random specs")
+        "fuzz", help="differential backend fuzzing over random specs; "
+                     "with --addr, closed-loop coverage-guided fuzzing "
+                     "of a live fleet (docs/GENERATION.md)")
     p.add_argument("--specs", type=int, default=10)
-    p.add_argument("--histories", type=int, default=32)
+    p.add_argument("--histories", type=int, default=32,
+                   help="histories per spec (differential mode) / per "
+                        "round batch (--addr mode)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--pids", type=int, default=4)
     p.add_argument("--ops", type=int, default=10)
     p.add_argument("--p-pending", type=float, default=0.1)
     p.add_argument("--backends", default="memo,cpp,device",
                    help="comma list from {memo, cpp, device, segdc, auto, hybrid}")
+    # closed-loop fleet mode (qsm_tpu/gen)
+    p.add_argument("--addr", default=None,
+                   help="soak a live fleet instead: server/router "
+                        "address (comma list = failover set)")
+    p.add_argument("--models", default="register,cas",
+                   help="comma list of registry models to fuzz "
+                        "(--addr mode)")
+    p.add_argument("--rounds", type=int, default=4,
+                   help="steering rounds per model (--addr mode)")
+    p.add_argument("--session-every", type=int, default=2,
+                   help="stream a monitor session every Nth round; "
+                        "0 disables (--addr mode)")
+    p.add_argument("--deadline", type=float, default=30.0,
+                   help="per-request server deadline_s (--addr mode)")
+    p.add_argument("--timeout", type=float, default=60.0,
+                   help="client timeout_s (--addr mode)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="directory for per-model steering checkpoints "
+                        "(resume rails, --addr mode)")
     p.set_defaults(fn=cmd_fuzz)
 
     p = sub.add_parser(

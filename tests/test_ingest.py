@@ -31,6 +31,9 @@ from qsm_tpu.utils.report import history_from_rows
 DATA = os.path.join(os.path.dirname(__file__), "data")
 GOLDEN_JEPSEN = os.path.join(DATA, "golden_jepsen_register.edn")
 GOLDEN_PORCUPINE = os.path.join(DATA, "golden_porcupine_kv.edn")
+GOLDEN_RANGESET = os.path.join(DATA, "golden_jepsen_rangeset.edn")
+GOLDEN_SEMAPHORE = os.path.join(DATA, "golden_jepsen_semaphore.edn")
+GOLDEN_TXN = os.path.join(DATA, "golden_porcupine_txn.edn")
 
 
 def _golden(path):
@@ -60,6 +63,61 @@ def test_golden_porcupine_round_trip_byte_stable():
     assert emit_trace("porcupine", h, "kv", spec) == text
     v = int(WingGongCPU(memo=True).check_histories(spec, [h])[0])
     assert v == 0  # the seeded stale read on key 1: VIOLATION
+
+
+def test_golden_rangeset_round_trip_byte_stable():
+    """ISSUE 17 family: the torn count-below scan (a count no single
+    linearization point produces) plus a ``:fail`` duplicate add and a
+    pending count — the full vocabulary round-trips byte-stably."""
+    text = _golden(GOLDEN_RANGESET)
+    spec = MODELS["rangeset"].make_spec()
+    rows = parse_trace("jepsen", text, "rangeset", spec)
+    h = history_from_rows(rows)
+    assert emit_trace("jepsen", h, "rangeset", spec) == text
+    assert h.n_pending == 1
+    v = int(WingGongCPU(memo=True).check_histories(spec, [h])[0])
+    assert v == 0  # the torn count: VIOLATION
+
+
+def test_golden_semaphore_round_trip_byte_stable():
+    text = _golden(GOLDEN_SEMAPHORE)
+    spec = MODELS["semaphore"].make_spec()
+    rows = parse_trace("jepsen", text, "semaphore", spec)
+    h = history_from_rows(rows)
+    assert emit_trace("jepsen", h, "semaphore", spec) == text
+    v = int(WingGongCPU(memo=True).check_histories(spec, [h])[0])
+    assert v == 1  # refused third acquire is legal: LINEARIZABLE
+
+
+def test_golden_txn_round_trip_byte_stable():
+    """The non-decomposable family still ingests and CHECKS like any
+    other — refusal costs decomposition, never verdicts.  The golden
+    seeds the stale-read torn copy (models/txn.py TornCopyTxnSUT)."""
+    text = _golden(GOLDEN_TXN)
+    spec = MODELS["txn"].make_spec()
+    rows = parse_trace("porcupine", text, "txn", spec)
+    h = history_from_rows(rows)
+    assert emit_trace("porcupine", h, "txn", spec) == text
+    v = int(WingGongCPU(memo=True).check_histories(spec, [h])[0])
+    assert v == 0  # copy installed a value no atomic copy observes
+
+
+def test_txn_map_refuses_diagonal_copy_and_rangeset_domain():
+    spec = MODELS["txn"].make_spec()
+    with pytest.raises(IngestError, match="must differ"):
+        parse_trace("porcupine",
+                    "{:process 0, :type :invoke, :f :copy, :key 1, "
+                    ":value 1}\n", "txn", spec)
+    rs = MODELS["rangeset"].make_spec()
+    with pytest.raises(IngestError, match="outside spec domain"):
+        parse_trace("jepsen",
+                    "{:process 0, :type :invoke, :f :add, "
+                    f":value [{rs.n_keys} nil]}}\n", "rangeset", rs)
+    # count-below's bound domain is one wider than the key domain
+    rows = parse_trace(
+        "jepsen", "{:process 0, :type :invoke, :f :count-below, "
+        f":value [{rs.n_keys} nil]}}\n", "rangeset", rs)
+    assert rows[0][2] == rs.n_keys
 
 
 def test_jepsen_cas_fail_completes_with_failure_response():

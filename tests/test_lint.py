@@ -16,6 +16,7 @@ import qsm_tpu.analysis.fixtures as fixtures
 from qsm_tpu.analysis import (ERROR, FAMILIES, Finding, Whitelist,
                               run_lint)
 from qsm_tpu.analysis.engine import (DEFAULT_FLEET_FILES,
+                                     DEFAULT_GEN_FILES,
                                      DEFAULT_MONITOR_FILES,
                                      DEFAULT_OBS_FILES,
                                      DEFAULT_OPS_FILES,
@@ -87,9 +88,13 @@ def test_in_tree_corpus_is_clean(report):
     # committed PROTOCOL.json artifact (ISSUE 16)
     assert len(DEFAULT_PROTOCOL_FILES) == 12
     assert "protocol" in report.passes
-    # a–l all registered and all ran in the default lane
-    assert sorted(FAMILIES) == list("abcdefghijkl")
-    assert report.families == list("abcdefghijkl")
+    # the generation-campaign bounds family (m): gen/ + the gen bench
+    # driver (ISSUE 17)
+    assert len(DEFAULT_GEN_FILES) == 5
+    assert "gen" in report.passes
+    # a–m all registered and all ran in the default lane
+    assert sorted(FAMILIES) == list("abcdefghijklm")
+    assert report.families == list("abcdefghijklm")
     assert report.ok, "\n".join(
         f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
 
@@ -305,8 +310,11 @@ def test_monitor_unbounded_buffer_is_caught():
     decided-prefix reassignment) must NOT be flagged."""
     from qsm_tpu.analysis.monitor_passes import check_monitor_file
 
+    # scope to the session stubs: the family-m seed-pool fixture in the
+    # same file legitimately trips this scan too (its own test covers it)
     findings = [f for f in check_monitor_file(fixtures.__file__)
-                if f.rule_id == "QSM-MON-UNBOUNDED"]
+                if f.rule_id == "QSM-MON-UNBOUNDED"
+                and "SessionBufferStub" in f.location]
     assert len(findings) == 2  # self.events and self.window
     assert {f.severity for f in findings} == {ERROR}
     assert all("UnboundedSessionBufferStub" in f.location
@@ -330,6 +338,67 @@ def test_monitor_live_tree_is_clean():
     for rel in DEFAULT_MONITOR_FILES:
         findings += check_monitor_file(os.path.join(REPO_ROOT, rel),
                                        root=REPO_ROOT)
+    assert findings == []
+
+
+def test_gen_unbounded_pool_is_caught():
+    """The gen pass's bulb check (family m, ISSUE 17): the seed-pool
+    stub whose corpus AND flip log grow once per round with no cap
+    comparison or eviction fires QSM-GEN-UNBOUNDED once per unbounded
+    attribute; the capacity-evicted / tail-windowed twin (the steer.py
+    SeedPool.add + kept-flips shapes) must NOT be flagged."""
+    from qsm_tpu.analysis.gen_passes import check_gen_file
+
+    findings = [f for f in check_gen_file(fixtures.__file__)
+                if f.rule_id == "QSM-GEN-UNBOUNDED"
+                and "SeedPoolStub" in f.location]
+    assert len(findings) == 2  # self.seeds and self.flips
+    assert {f.severity for f in findings} == {ERROR}
+    assert all("UnboundedSeedPoolStub" in f.location
+               for f in findings)
+    assert any("self.seeds" in f.message for f in findings)
+    assert any("self.flips" in f.message for f in findings)
+    assert not any("BoundedSeedPoolStub" in f.location
+                   for f in check_gen_file(fixtures.__file__))
+
+
+def test_gen_delegated_growth_is_not_flagged():
+    """Family m's refinement over family k's scan: ``self.pool.add(…)``
+    where ``pool`` is another object (``SeedPool()``) is delegation —
+    the delegate, in the scan set itself, carries the bound — so only
+    attributes the class owns as raw container literals are hunted."""
+    import textwrap
+
+    from qsm_tpu.analysis.gen_passes import check_gen_file
+
+    src = textwrap.dedent("""
+        class Campaign:
+            def __init__(self):
+                self.pool = SeedPool()
+            def round(self, entry):
+                self.pool.add(entry)
+    """)
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py") as f:
+        f.write(src)
+        f.flush()
+        assert check_gen_file(f.name) == []
+
+
+def test_gen_live_tree_is_clean():
+    """The generation plane itself keeps the discipline its pass gates:
+    capacity-evicted seed pool (steer.py), tail-windowed kept flips,
+    capped wrongness provenance (fleet.py)."""
+    import os
+
+    from qsm_tpu.analysis.engine import REPO_ROOT
+    from qsm_tpu.analysis.gen_passes import check_gen_file
+
+    findings = []
+    for rel in DEFAULT_GEN_FILES:
+        findings += check_gen_file(os.path.join(REPO_ROOT, rel),
+                                   root=REPO_ROOT)
     assert findings == []
 
 

@@ -1,0 +1,217 @@
+"""The generation-plane model families (ISSUE 17): rangeset, semaphore,
+txn — parity pins plus the txn family's REFUSAL pins.
+
+rangeset/semaphore are scalar-state specs riding every fast path, so
+they get the standard treatment: exhaustive py/jax step agreement over
+the full domain, atomic-impl-passes, racy-impl-fails-with-a-shrinkable
+counterexample.
+
+txn is deliberately different: its ``copy`` command writes TWO cells,
+so the spec is NOT P-decomposable — and it declares a per-key
+projection anyway, precisely so the validation layer has something to
+refuse.  The pins here are the refusals themselves, verbatim: the
+``projection_report`` problem string, ``PComp`` raising
+``NotDecomposableError``, the planner's ``decompose_keys=off
+(refused: …)`` why stamp, and the serve plane's ``pcomp=off
+(refused: …)`` plan_why.  A consumer that silently splits a txn
+history would verdict on a corpus the spec semantics don't describe —
+every refusal is a soundness gate, and each one is test-pinned so a
+refactor cannot quietly remove it.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from qsm_tpu import (PropertyConfig, Verdict, WingGongCPU, check_one,
+                     prop_concurrent)
+from qsm_tpu.core.spec import compile_step_table, projection_report
+from qsm_tpu.models.lock import (AtomicSemaphoreSUT,
+                                 RacyCheckThenActSemaphoreSUT,
+                                 SemaphoreSpec)
+from qsm_tpu.models.rangeset import (AtomicRangeSetSUT, RangeSetSpec,
+                                     ScanningRangeSetSUT)
+from qsm_tpu.models.txn import (AtomicTxnSUT, TornCopyTxnSUT,
+                                TxnRegisterSpec)
+from qsm_tpu.ops.pcomp import NotDecomposableError, PComp
+from qsm_tpu.utils.corpus import build_corpus
+
+RANGESET = RangeSetSpec(n_keys=4)
+SEMAPHORE = SemaphoreSpec(permits=2)
+TXN = TxnRegisterSpec(n_cells=2, n_values=3)
+
+RANGESET_CFG = PropertyConfig(n_trials=120, n_pids=4, max_ops=32, seed=11)
+SEMAPHORE_CFG = PropertyConfig(n_trials=80, n_pids=4, max_ops=24, seed=11)
+TXN_CFG = PropertyConfig(n_trials=60, n_pids=6, max_ops=24, seed=11)
+
+
+def _step_table_matches_step_jax(spec, n_states):
+    import jax.numpy as jnp
+
+    trans, ok = compile_step_table(spec, n_states)
+    for s in range(n_states):
+        for c, sig in enumerate(spec.CMDS):
+            for a in range(sig.n_args):
+                for r in range(sig.n_resps):
+                    ns, good = spec.step_jax(
+                        jnp.asarray([s], jnp.int32), jnp.int32(c),
+                        jnp.int32(a), jnp.int32(r))
+                    assert int(ns[0]) == trans[s, c, a, r], (s, c, a, r)
+                    assert bool(good) == ok[s, c, a, r], (s, c, a, r)
+
+
+def test_rangeset_step_table_matches_step_jax():
+    _step_table_matches_step_jax(RANGESET, 1 << RANGESET.n_keys)
+
+
+def test_semaphore_step_table_matches_step_jax():
+    _step_table_matches_step_jax(SEMAPHORE, SEMAPHORE.permits + 1)
+
+
+# -- parity pins: atomic clean, racy violates --------------------------
+
+def test_atomic_rangeset_passes():
+    res = prop_concurrent(RANGESET, AtomicRangeSetSUT(RANGESET),
+                          RANGESET_CFG)
+    assert res.ok, res.counterexample
+
+
+def test_scanning_rangeset_fails_and_shrinks():
+    res = prop_concurrent(RANGESET, ScanningRangeSetSUT(RANGESET),
+                          RANGESET_CFG)
+    assert not res.ok, "torn count_below scan was never caught"
+    cx = res.counterexample
+    assert check_one(WingGongCPU(), RANGESET,
+                     cx.history) == Verdict.VIOLATION
+
+
+def test_atomic_semaphore_passes():
+    res = prop_concurrent(SEMAPHORE, AtomicSemaphoreSUT(SEMAPHORE),
+                          SEMAPHORE_CFG)
+    assert res.ok, res.counterexample
+
+
+def test_racy_semaphore_fails_and_shrinks():
+    res = prop_concurrent(SEMAPHORE,
+                          RacyCheckThenActSemaphoreSUT(SEMAPHORE),
+                          SEMAPHORE_CFG)
+    assert not res.ok, "check-then-act over-grant was never caught"
+    cx = res.counterexample
+    assert check_one(WingGongCPU(), SEMAPHORE,
+                     cx.history) == Verdict.VIOLATION
+
+
+def test_atomic_txn_passes():
+    res = prop_concurrent(TXN, AtomicTxnSUT(TXN), TXN_CFG)
+    assert res.ok, res.counterexample
+
+
+def test_torn_copy_txn_fails():
+    res = prop_concurrent(TXN, TornCopyTxnSUT(TXN), TXN_CFG)
+    assert not res.ok, "torn copy was never caught"
+    cx = res.counterexample
+    assert check_one(WingGongCPU(), TXN, cx.history) == Verdict.VIOLATION
+
+
+# -- cross-backend parity on runner-produced corpora -------------------
+
+@pytest.mark.parametrize("family,spec,suts,cfg", [
+    ("rangeset", RANGESET, (AtomicRangeSetSUT, ScanningRangeSetSUT),
+     RANGESET_CFG),
+    ("semaphore", SEMAPHORE, (AtomicSemaphoreSUT,
+                              RacyCheckThenActSemaphoreSUT),
+     SEMAPHORE_CFG),
+])
+def test_new_scalar_family_backend_parity(family, spec, suts, cfg):
+    """The scalar families ride every fast path: memo ladder, quiescent
+    -cut segdc, the device kernel's table-gather path, and the native
+    C++ table checker must all agree on a mixed atomic/racy corpus.
+    The racy bug fires rarely under the runner's fixed seeds, so the
+    property layer's counterexample anchors the violating side."""
+    import numpy as np
+
+    from conftest import assert_backend_parity
+    from qsm_tpu.native import CppOracle
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+    from qsm_tpu.ops.segdc import SegDC
+
+    hists = build_corpus(spec, suts, n=10, n_pids=3, max_ops=12,
+                         seed_prefix=f"genpar_{family}")
+    res = prop_concurrent(spec, suts[1](spec), cfg)
+    assert not res.ok
+    hists.append(res.counterexample.history)
+    cpu = assert_backend_parity(spec, hists, JaxTPU(spec))
+
+    seg = SegDC(spec).check_histories(spec, hists)
+    np.testing.assert_array_equal(np.asarray(seg), cpu)
+
+    cpp = CppOracle(spec)
+    np.testing.assert_array_equal(cpp.check_histories(spec, hists), cpu)
+    assert cpp.native_histories == len(hists)  # no silent fallback
+
+
+def test_txn_backend_parity_memo_vs_segdc():
+    """txn is vector-state and non-decomposable — the whole-history
+    paths (memo oracle, segdc with its whole-history fallback) must
+    still agree; decomposition never enters (refusal pins below)."""
+    import numpy as np
+
+    from qsm_tpu.ops.segdc import SegDC
+    from qsm_tpu.ops.wing_gong_cpu import WingGongCPU
+
+    hists = build_corpus(TXN, (AtomicTxnSUT, TornCopyTxnSUT), n=8,
+                         n_pids=4, max_ops=12, seed_prefix="genpar_txn")
+    res = prop_concurrent(TXN, TornCopyTxnSUT(TXN), TXN_CFG)
+    assert not res.ok
+    hists.append(res.counterexample.history)
+    cpu = WingGongCPU(memo=True).check_histories(TXN, hists)
+    seg = SegDC(TXN).check_histories(TXN, hists)
+    np.testing.assert_array_equal(np.asarray(seg), np.asarray(cpu))
+    assert (np.asarray(cpu) == int(Verdict.VIOLATION)).any()
+    assert (np.asarray(cpu) == int(Verdict.LINEARIZABLE)).any()
+
+
+# -- txn refusal pins: every consumer must refuse to decompose ---------
+
+def test_txn_projection_report_names_the_leak():
+    """The validator's problem string, verbatim: ``copy`` steps leak
+    past their own key, so keys are not independent.  Planner and serve
+    render this exact string in their refusal stamps."""
+    spec = TxnRegisterSpec(n_cells=2, n_values=3)
+    assert projection_report(spec) == [
+        "copy(arg=0): step leaks into keys [1] beyond its own key 0 "
+        "— keys are not independent"]
+
+
+def test_txn_pcomp_construction_refuses():
+    with pytest.raises(NotDecomposableError):
+        PComp(TxnRegisterSpec(n_cells=2, n_values=3))
+
+
+def test_txn_planner_refuses_with_why_stamp():
+    from qsm_tpu.search.planner import plan_search, profile_corpus
+
+    spec = TxnRegisterSpec(n_cells=2, n_values=3)
+    hists = build_corpus(spec, (AtomicTxnSUT, TornCopyTxnSUT), n=6,
+                         n_pids=4, max_ops=16, seed_prefix="txnplan")
+    plan = plan_search(spec, profile_corpus(hists, spec), platform="cpu")
+    assert not plan.decompose_keys
+    assert any(w.startswith("decompose_keys=off (refused: copy(arg=0)")
+               for w in plan.why), plan.why
+
+
+def test_txn_serve_refuses_with_plan_why():
+    from qsm_tpu.serve import CheckClient, CheckServer
+
+    spec = TxnRegisterSpec()
+    hists = build_corpus(spec, (AtomicTxnSUT, TornCopyTxnSUT), n=4,
+                         n_pids=4, max_ops=12, seed_prefix="txnserve")
+    srv = CheckServer(flush_s=0.005, max_lanes=8).start()
+    try:
+        with CheckClient(srv.address) as client:
+            res = client.check("txn", hists)
+        assert res["ok"], res
+        assert any(w.startswith("pcomp=off (refused: copy(arg=0)")
+                   for w in res["plan_why"]), res["plan_why"]
+    finally:
+        srv.stop()

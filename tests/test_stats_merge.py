@@ -41,7 +41,9 @@ _ADDITIVE = ("lockstep_iters", "nodes_explored", "memo_prunes",
              "worker_faults", "node_faults", "pcomp_split", "pcomp_subs",
              "pcomp_recombine_ms", "shrink_rounds", "shrink_lanes",
              "shrink_memo_hits", "obs_events", "session_events",
-             "frontier_advances", "flips_pushed", "prefix_hits")
+             "frontier_advances", "flips_pushed", "prefix_hits",
+             "gen_seqs", "gen_mutations", "gen_flips",
+             "gen_feedback_rounds")
 
 
 def _filled(base: int) -> SearchStats:
@@ -165,7 +167,12 @@ def test_to_compact_full_key_set_and_values():
     assert sorted(c) == sorted(
         ("iph", "nph", "prunes", "rescued", "segs", "ord", "plan",
          "deg", "fb", "wf", "ndf", "pcs", "pcn", "pcm", "shr", "shl",
-         "shm", "sho", "obe", "sev", "fad", "flp", "pfh"))
+         "shm", "sho", "obe", "sev", "fad", "flp", "pfh",
+         "gsq", "gmu", "gfl", "gfr"))
+    assert c["gsq"] == st.gen_seqs
+    assert c["gmu"] == st.gen_mutations
+    assert c["gfl"] == st.gen_flips
+    assert c["gfr"] == st.gen_feedback_rounds
     assert c["pcm"] == st.pcomp_max_sub
     assert c["sho"] == st.shrink_ratio_pct
     assert c["obe"] == st.obs_events
@@ -190,6 +197,7 @@ def test_to_timings_gates_optional_blocks():
     assert "shrink_rounds" not in t
     assert "obs_events" not in t
     assert "session_events" not in t
+    assert "gen_seqs" not in t
     assert "resilience_degradations" not in t
     full = _filled(2)
     t2 = full.to_timings()
@@ -200,6 +208,8 @@ def test_to_timings_gates_optional_blocks():
     assert t2["session_events"] == float(full.session_events)
     assert t2["prefix_hits"] == float(full.prefix_hits)
     assert t2["flips_pushed"] == float(full.flips_pushed)
+    assert t2["gen_seqs"] == float(full.gen_seqs)
+    assert t2["gen_flips"] == float(full.gen_flips)
 
 
 def test_absorb_round_trips_through_collect_composition():

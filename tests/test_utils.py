@@ -28,7 +28,9 @@ def test_registry_covers_all_five_configs():
     assert {"register", "ticket", "cas", "queue", "kv"} <= set(MODELS)
     assert set(MODELS) == {"register", "ticket", "cas", "queue", "kv",
                            "set", "stack", "failover",
-                           "multireg", "multicas"}
+                           "multireg", "multicas",
+                           # generation-plane families (ISSUE 17)
+                           "rangeset", "semaphore", "txn"}
     for name, entry in MODELS.items():
         spec, sut = make(name, "racy")
         assert hasattr(sut, "perform")

@@ -48,6 +48,9 @@ _HEADLINE_KEYS = (
     "engine_call_ratio", "call_ratio_batched", "wall_ratio",
     "nodes_ratio", "ratio_n3_vs_n1", "speedup", "ratio", "mean_ratio",
     "tracing_off_overhead_pct", "tracing_on_overhead_pct",
+    # the GEN artifact's steering trend: best steered/unsteered flip
+    # ratio and how many families cleared the ≥3× gate
+    "max_flip_ratio", "families_passing",
     "value", "p50_ms", "p99_ms",
     # the LINT artifact's wire-contract trend (flattened from its
     # nested ``protocol`` block): op vocabulary size, handler/caller

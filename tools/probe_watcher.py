@@ -80,7 +80,7 @@ CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 # interprocedural race analyzer), independent of the window artifacts'
 # ROUND_TAG — renaming those retires banked measurements, renaming this
 # just says which rule set produced the findings.
-LINT_ROUND = "r16"  # family (l): wire-contract conformance — r16
+LINT_ROUND = "r17"  # family (m): generation-campaign bounds — r17
 LINT_ARTIFACT = os.path.join(REPO, f"LINT_{LINT_ROUND}.json")
 
 # Committed archive of the P-compositionality bench (tools/
@@ -144,6 +144,19 @@ MONITOR_ARTIFACT = os.path.join(REPO,
 # full scan = streamed + resume + scratch + flip + parity + summary
 MONITOR_MIN_ROWS = 6
 _MONITOR_STATE: dict = {"attempted": False}
+
+# Committed archive of the generation bench (tools/bench_gen.py):
+# HOST-ONLY like the other off-window gates — steered vs unsteered
+# fuzzing at matched engine-call budget, the flip/witness audit, and
+# the 2-node closed-loop soak — refreshed off-window on CellJournal
+# --resume rails.  Tracks its own round tag (the generation plane
+# landed in r17).
+GEN_ROUND = "r17"
+GEN_ARTIFACT = os.path.join(REPO, f"BENCH_GEN_{GEN_ROUND}.json")
+# full scan = (steered + unsteered) × 3 families + flip_audit +
+# soak_fleet + summary
+GEN_MIN_ROWS = 9
+_GEN_STATE: dict = {"attempted": False}
 
 # Cached verdict of the pre-seize lint gate, keyed on a SOURCE
 # fingerprint — not process lifetime: the watcher runs all round while
@@ -355,6 +368,15 @@ def _maybe_archive_monitor(timeout: float = 900.0) -> None:
     host-only gates."""
     _maybe_archive(_MONITOR_STATE, MONITOR_ARTIFACT, "bench_monitor.py",
                    MONITOR_MIN_ROWS, "monitor_bench", timeout)
+
+
+def _maybe_archive_gen(timeout: float = 900.0) -> None:
+    """The generation bench artifact (tools/bench_gen.py): the
+    steered-vs-unsteered flip/node ratios, the zero-miss flip audit
+    and the closed-loop soak verdict archived beside the other
+    host-only gates."""
+    _maybe_archive(_GEN_STATE, GEN_ARTIFACT, "bench_gen.py",
+                   GEN_MIN_ROWS, "gen_bench", timeout)
 
 
 def _run_window_bench(bench_timeout: float, extra_args, label: str,
@@ -741,6 +763,7 @@ def main() -> int:
         _maybe_archive_obs()
         _maybe_archive_fleet()
         _maybe_archive_monitor()
+        _maybe_archive_gen()
     while True:
         t0 = time.time()
         _maybe_compact_probe_log()  # bounded; no-op below the threshold
