@@ -13,7 +13,7 @@
 PYTHON ?= python
 # keep in lockstep with tools/probe_watcher.py LINT_ROUND (the watcher
 # archives the same document before every window seize)
-LINT_ARTIFACT ?= LINT_r17.json
+LINT_ARTIFACT ?= LINT_r18.json
 
 # P-compositionality bench (tools/bench_pcomp.py): host-only — no TPU
 # window needed — on CellJournal --resume rails; refreshes the
@@ -60,9 +60,19 @@ MONITOR_ARTIFACT ?= BENCH_MONITOR_r14.json
 # docs/GENERATION.md)
 GEN_ARTIFACT ?= BENCH_GEN_r17.json
 
+# Durable-session chaos soak (tools/soak_sessions.py): host-only,
+# CellJournal --resume rails; refreshes the committed BENCH_SESSIONS
+# artifact (≥1000 concurrent sessions held open through a rolling
+# SIGKILL restart of all three nodes, a SIGKILL of the active router
+# with standby takeover off the shared lease + session-journal stores,
+# and one node leave + one node join with handoff — zero wrong
+# verdicts, zero lost flips, every resume riding banked decided
+# prefixes; docs/MONITOR.md "Durability")
+SESSIONS_ARTIFACT ?= BENCH_SESSIONS_r18.json
+
 .PHONY: lint-gate lint-changed lint-sarif protocol test bench-pcomp \
 	bench-shrink bench-obs bench-fleet bench-monitor bench-gen \
-	bench-report
+	soak-sessions bench-report
 
 lint-gate:
 	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT)
@@ -104,6 +114,10 @@ bench-monitor:
 bench-gen:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_gen.py \
 		--out $(GEN_ARTIFACT) --resume
+
+soak-sessions:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_sessions.py \
+		--out $(SESSIONS_ARTIFACT) --resume
 
 # Aggregate every committed BENCH_*.json into one per-round trend
 # table (BENCH_REPORT.md + BENCH_REPORT.json, atomic + deterministic)

@@ -108,16 +108,22 @@ DEFAULT_RACE_FILES = (
     "qsm_tpu/fleet/gossip.py",
     # the monitor plane: session objects are driven from connection
     # threads while the manager's totals and the router's journals are
-    # read from stats/replay paths — same closed program
+    # read from stats/replay paths — same closed program; the durable
+    # session store (ISSUE 18) does disk IO under per-session locks
     "qsm_tpu/monitor/frontier.py", "qsm_tpu/monitor/session.py",
+    "qsm_tpu/monitor/store.py",
     # the fleet-observability plane: the collector's sweep runs on the
     # router's beat thread while obs.trace readers and the federation
     # fan-out run on connection threads; the SLO evaluator is hit from
     # health ops, metrics scrapes and the breach trigger concurrently
     "qsm_tpu/obs/collect.py", "qsm_tpu/obs/slo.py",
+    # the durable-session chaos soak: worker threads drive per-thread
+    # clients while the rig SIGKILLs and respawns the fleet under them
+    "qsm_tpu/gen/soak.py",
     "tools/bench_serve.py", "tools/bench_pcomp.py",
     "tools/bench_shrink.py", "tools/bench_fleet.py",
-    "tools/probe_watcher.py", "tools/soak_prune.py")
+    "tools/probe_watcher.py", "tools/soak_prune.py",
+    "tools/soak_sessions.py")
 
 # the shrink-plane modules the frontier-bound pass covers (family h):
 # the plane itself plus its bench driver
@@ -125,19 +131,22 @@ DEFAULT_SHRINK_FILES = (
     "qsm_tpu/shrink/frontier.py", "qsm_tpu/shrink/shrinker.py",
     "tools/bench_shrink.py")
 
-# the fleet-tier modules the re-dispatch + lease passes cover (family
-# j): the tier itself (HA lease and gossip modules included) plus its
-# soak bench
+# the fleet-tier modules the re-dispatch + lease + handoff passes
+# cover (family j): the tier itself (HA lease and gossip modules
+# included) plus its soak benches — the r13 fleet soak and the r18
+# durable-session chaos rig
 DEFAULT_FLEET_FILES = (
     "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/membership.py",
     "qsm_tpu/fleet/replog.py", "qsm_tpu/fleet/lease.py",
-    "qsm_tpu/fleet/gossip.py", "tools/bench_fleet.py")
+    "qsm_tpu/fleet/gossip.py", "tools/bench_fleet.py",
+    "qsm_tpu/gen/soak.py", "tools/soak_sessions.py")
 
 # the monitor-plane modules the session-bound pass covers (family k):
 # the streaming sessions + frontiers, the ingest adapters that feed
 # them, and the monitor bench driver
 DEFAULT_MONITOR_FILES = (
     "qsm_tpu/monitor/frontier.py", "qsm_tpu/monitor/session.py",
+    "qsm_tpu/monitor/store.py",
     "qsm_tpu/ingest/adapters.py", "qsm_tpu/ingest/edn.py",
     "qsm_tpu/ingest/specmap.py", "qsm_tpu/ingest/tail.py",
     "tools/bench_monitor.py")
@@ -164,6 +173,7 @@ DEFAULT_OBS_FILES = (
     "qsm_tpu/fleet/replog.py", "qsm_tpu/fleet/lease.py",
     "qsm_tpu/fleet/gossip.py",
     "qsm_tpu/monitor/frontier.py", "qsm_tpu/monitor/session.py",
+    "qsm_tpu/monitor/store.py",
     "qsm_tpu/ingest/adapters.py", "qsm_tpu/ingest/edn.py",
     "qsm_tpu/ingest/specmap.py", "qsm_tpu/ingest/tail.py",
     "tools/bench_obs.py", "tools/bench_fleet.py",
@@ -191,7 +201,7 @@ DEFAULT_PROTOCOL_FILES = (
     "qsm_tpu/serve/client.py", "qsm_tpu/serve/admission.py",
     "qsm_tpu/serve/frames.py",
     "qsm_tpu/fleet/router.py", "qsm_tpu/fleet/gossip.py",
-    "qsm_tpu/fleet/membership.py",
+    "qsm_tpu/fleet/membership.py", "qsm_tpu/fleet/lease.py",
     "qsm_tpu/obs/collect.py", "qsm_tpu/monitor/session.py",
     "qsm_tpu/utils/cli.py",
     "PROTOCOL.json")
@@ -463,9 +473,10 @@ FAMILIES: Dict[str, Family] = {f.fid: f for f in (
            triggers=("qsm_tpu/analysis/obs_passes.py",
                      "qsm_tpu/analysis/astutil.py")),
     Family(fid="j", key="fleet",
-           title="fleet re-dispatch + lease discipline (bounded "
-                 "attempts, failed-node exclusion, term/expiry-gated "
-                 "promotion)",
+           title="fleet re-dispatch + lease + handoff discipline "
+                 "(bounded attempts, failed-node exclusion, "
+                 "term/expiry-gated promotion, seeded joins, migrated "
+                 "leaves)",
            files=DEFAULT_FLEET_FILES, per_file=_per_file_fleet,
            triggers=("qsm_tpu/analysis/fleet_passes.py",
                      "qsm_tpu/analysis/astutil.py")),

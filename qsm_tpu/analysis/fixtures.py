@@ -664,6 +664,65 @@ class LeasedTakeoverRouterStub:
         return got
 
 
+class ColdRebalanceRouterStub:
+    """Seeded bugs for QSM-FLEET-HANDOFF (the elastic-membership
+    discipline, ISSUE 18): ``join_cold`` mutates the ring with no
+    replog seeding — the newcomer owns key ranges whose banked verdict
+    rows it does not hold, so every key routed there re-folds from
+    scratch; ``leave_sticky`` retires a node without touching its
+    routed sessions — each one still names the corpse as owner and
+    every next verb re-dispatches into the void.  Never executed."""
+
+    def __init__(self, membership, links, sessions):
+        self.membership = membership
+        self.links = links
+        self.sessions = sessions
+
+    def join_cold(self, nid, addr):
+        # <-- bug: the ring moves, the rows do not
+        self.membership.add_node(nid, addr)
+        self.links[nid] = object()
+        return True
+
+    def leave_sticky(self, nid):
+        self.links.pop(nid, None)
+        # <-- bug: the retiree's sessions keep it as owner
+        return self.membership.remove_node(nid)
+
+
+class RebalancingRouterStub:
+    """Sanctioned twin: a join seeds the newcomer with an on-the-spot
+    anti-entropy sweep (gossip-driven, subsumption-bounded — nodes
+    already holding the rows ship nothing) and a leave invalidates
+    every routed session the retiree owned, so each journal replays
+    onto the new ring owner on its next verb (live migration,
+    exactly-once by seq; the fleet/router.py ``_handle_membership``
+    shape) — must stay CLEAN under QSM-FLEET-HANDOFF."""
+
+    def __init__(self, membership, sessions):
+        self.membership = membership
+        self.sessions = sessions
+
+    def join(self, nid, addr):
+        joined = self.membership.add_node(nid, addr)
+        if joined:
+            return self.anti_entropy_sweep()
+        return {}
+
+    def leave(self, nid):
+        left = self.membership.remove_node(nid)
+        migrated = 0
+        if left:
+            for sess in self.sessions.values():
+                if sess.node == nid:
+                    sess.node = None
+                    migrated += 1
+        return migrated
+
+    def anti_entropy_sweep(self):
+        return {"segments_shipped": 0}
+
+
 class UnboundedSessionBufferStub:
     """Seeded bug for the monitor passes (family k): a session object
     whose event buffer grows on every append with NO cap comparison and

@@ -49,6 +49,30 @@ AST lint over the fleet modules and the fleet bench tool:
   ``rec = lease.read(); if lease.expired(rec, grace): ...
   lease.acquire()`` with the term riding the response
   (fleet/router.py ``ha_beat`` is the model).
+
+* ``QSM-FLEET-HANDOFF`` (error) — the elastic-membership discipline
+  (ISSUE 18, fleet/membership.py).  A ring mutation is a call through
+  a handle (``membership.add_node(...)`` / ``self.membership.
+  remove_node(...)``), and the mutating function must carry the
+  matching handoff discipline:
+
+  - a JOIN with no replog handoff anywhere in the function (no
+    ``handoff``/``anti_entropy``/``sweep`` token) leaves the newcomer
+    owning key ranges whose banked verdict rows it does not hold —
+    every key routed there re-folds from scratch and a flip banked on
+    the previous owner is invisible until some later sweep; and
+  - a LEAVE with no session migration (no ``migrat`` token) leaves
+    every routed session naming the retiree as owner — each next verb
+    re-dispatches into the void instead of replaying the journal onto
+    the new ring owner.
+
+  Sanctioned form: ``add_node`` reporting a join triggers an
+  ``anti_entropy_sweep()`` on the spot (gossip-driven,
+  subsumption-bounded — nodes already holding the rows ship nothing);
+  ``remove_node`` reporting a leave invalidates the retiree's routed
+  sessions (``sess.node = None``, counted as migrated) so each
+  journal replays onto the new owner on its next verb, exactly-once
+  by seq (fleet/router.py ``_handle_membership`` is the model).
 """
 
 from __future__ import annotations
@@ -65,6 +89,10 @@ _DISPATCH_CALLS = {"request", "dispatch"}
 # requirement keeps ordinary Lock/Semaphore .acquire() out of scope)
 _PROMOTE_CALLS = {"acquire", "promote", "takeover", "take_over"}
 _CONSULT_TOKENS = ("term", "expir")
+_RING_JOIN_CALLS = {"add_node"}
+_RING_LEAVE_CALLS = {"remove_node"}
+_HANDOFF_TOKENS = ("handoff", "anti_entropy", "sweep")
+_MIGRATE_TOKENS = ("migrat",)
 
 
 def _is_const_true(test: ast.AST) -> bool:
@@ -127,9 +155,10 @@ def _is_lease_promote(call: ast.Call) -> bool:
     return any("lease" in part.lower() for part in chain[:-1])
 
 
-def _consults_lease_state(fn: ast.AST) -> bool:
-    """Does this function ever read a term/expiry-named thing — a name,
-    an attribute, or a ``rec["term"]``-style string key?"""
+def _mentions(fn: ast.AST, tokens) -> bool:
+    """Does this function ever touch a thing named for one of
+    ``tokens`` — a name, an attribute, or a ``rec["term"]``-style
+    string key?"""
     for node in ast.walk(fn):
         text = None
         if isinstance(node, ast.Name):
@@ -140,9 +169,66 @@ def _consults_lease_state(fn: ast.AST) -> bool:
                                                           str):
             text = node.value
         if text is not None and any(tok in text.lower()
-                                    for tok in _CONSULT_TOKENS):
+                                    for tok in tokens):
             return True
     return False
+
+
+def _consults_lease_state(fn: ast.AST) -> bool:
+    """Does this function ever read a term/expiry-named thing?"""
+    return _mentions(fn, _CONSULT_TOKENS)
+
+
+def _ring_mutation(call: ast.Call, verbs) -> bool:
+    """``<...>.add_node(...)`` / ``<...>.remove_node(...)`` — a ring
+    mutation through a handle (the handle requirement keeps the
+    Membership method DEFINITIONS, which are bare names, out of
+    scope)."""
+    chain = attr_chain(call.func)
+    return bool(chain) and len(chain) >= 2 and chain[-1] in verbs
+
+
+def _check_handoff_discipline(tree: ast.Module, relpath: str
+                              ) -> List[Finding]:
+    """QSM-FLEET-HANDOFF (module docstring): per function, at most one
+    finding per broken direction — a join with no handoff, a leave
+    with no migration."""
+    out: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        joins = [n for n in ast.walk(fn) if isinstance(n, ast.Call)
+                 and _ring_mutation(n, _RING_JOIN_CALLS)]
+        leaves = [n for n in ast.walk(fn) if isinstance(n, ast.Call)
+                  and _ring_mutation(n, _RING_LEAVE_CALLS)]
+        if joins and not _mentions(fn, _HANDOFF_TOKENS):
+            out.append(Finding(
+                ERROR, "QSM-FLEET-HANDOFF",
+                f"{relpath}:{fn.name}:{joins[0].lineno}",
+                "ring join without replog handoff — the newcomer owns "
+                "key ranges whose banked verdict rows it does not "
+                "hold, so every key routed there re-folds from "
+                "scratch and a flip banked on the previous owner is "
+                "invisible until some later sweep",
+                "seed the newcomer on the spot: run "
+                "anti_entropy_sweep() (gossip-driven, "
+                "subsumption-bounded — nodes already holding the rows "
+                "ship nothing) when add_node reports a join "
+                "(fleet/router.py _handle_membership is the model)"))
+        if leaves and not _mentions(fn, _MIGRATE_TOKENS):
+            out.append(Finding(
+                ERROR, "QSM-FLEET-HANDOFF",
+                f"{relpath}:{fn.name}:{leaves[0].lineno}",
+                "ring leave without session migration — every routed "
+                "session still naming the retired node as owner "
+                "re-dispatches into the void on its next verb instead "
+                "of replaying its journal onto the new ring owner",
+                "invalidate the retiree's sessions under each session "
+                "lock (sess.node = None, counted as migrated) so each "
+                "journal replays onto the new owner on the next verb, "
+                "exactly-once by seq (fleet/router.py "
+                "_handle_membership is the model)"))
+    return out
 
 
 def _check_lease_discipline(tree: ast.Module, relpath: str
@@ -204,6 +290,7 @@ def check_fleet_file(path: str, root: Optional[str] = None
             pass
     fn_of = _function_map(tree)
     out: List[Finding] = _check_lease_discipline(tree, relpath)
+    out += _check_handoff_discipline(tree, relpath)
     for node in ast.walk(tree):
         if not isinstance(node, (ast.While, ast.For)):
             continue

@@ -128,7 +128,8 @@ class Membership:
         self.heartbeat_s = heartbeat_s
         self._nodes: Dict[str, _Node] = {
             nid: _Node(nid, addr) for nid, addr in nodes}
-        self.ring = HashRing(list(self._nodes), vnodes=vnodes)
+        self.vnodes = int(vnodes)
+        self.ring = HashRing(list(self._nodes), vnodes=self.vnodes)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -137,6 +138,8 @@ class Membership:
         self.probe_failures = 0
         self.quarantines = 0
         self.readmissions = 0
+        self.joins = 0
+        self.leaves = 0
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "Membership":
@@ -257,6 +260,42 @@ class Membership:
             return
         self._obs.event(name, **attrs)
 
+    # -- elastic membership (ISSUE 18) ---------------------------------
+    def add_node(self, node_id: str, address: str) -> bool:
+        """JOIN: the node's vnode points enter the ring.  Consistent
+        hashing moves ONLY the key ranges those points claim — every
+        other key keeps its owner, so the fleet's hot banks stay hot
+        through a rebalance.  Idempotent: re-joining a member is a
+        no-op (False), except that a member re-joining from a NEW
+        address re-addresses in place (a node that moved hosts keeps
+        its identity, health record and key ranges)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                if node.address == address:
+                    return False
+                node.address = address           # moved hosts, same nid
+            else:
+                self._nodes[node_id] = _Node(node_id, address)
+                self.ring = HashRing(list(self._nodes),
+                                     vnodes=self.vnodes)
+            self.joins += 1
+        self._emit("fleet.join", node=node_id, address=address)
+        return True
+
+    def remove_node(self, node_id: str) -> bool:
+        """LEAVE: the node's vnode points retire; only the key ranges
+        it owned move (to the next point clockwise).  Idempotent —
+        removing a non-member is a no-op (False)."""
+        with self._lock:
+            if node_id not in self._nodes:
+                return False
+            del self._nodes[node_id]
+            self.ring = HashRing(list(self._nodes), vnodes=self.vnodes)
+            self.leaves += 1
+        self._emit("fleet.leave", node=node_id)
+        return True
+
     # -- routing queries -----------------------------------------------
     def address_of(self, node_id: str) -> str:
         return self._nodes[node_id].address
@@ -315,5 +354,7 @@ class Membership:
                 "probe_failures": self.probe_failures,
                 "quarantines": self.quarantines,
                 "readmissions": self.readmissions,
+                "joins": self.joins,
+                "leaves": self.leaves,
                 "policy": self.policy.name,
             }

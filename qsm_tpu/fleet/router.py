@@ -300,6 +300,7 @@ class FleetRouter:
                  ae_max_segments: int = 32,
                  allow_shutdown: bool = True,
                  node_id: str = "router",
+                 session_dir: Optional[str] = None,
                  lease_path: Optional[str] = None,
                  lease_ttl_s: float = 3.0,
                  ha_grace_s: Optional[float] = None,
@@ -345,6 +346,11 @@ class FleetRouter:
         self._ladders: Dict[str, tuple] = {}
         # RLock: _ladder_for's build path re-enters through _spec_for
         self._ladders_lock = threading.RLock()
+        # the session verbs' last rung (ISSUE 18): an in-router
+        # SessionManager that takes a session when the fleet is
+        # exhausted instead of shedding it — built lazily, like the
+        # check path's warm engines above
+        self._local_sessions = None
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -355,6 +361,7 @@ class FleetRouter:
         self.histories = 0
         self.shrink_requests = 0
         self.node_faults = 0     # node exchanges lost (death/wedge/part.)
+        self.lease_faults = 0    # lease-store transactions lost (faults)
         self.node_sheds = 0      # node answered SHED (backpressure)
         self.redispatches = 0    # lane groups moved to another node
         self.ladder_batches = 0  # groups the in-process rung decided
@@ -372,6 +379,17 @@ class FleetRouter:
         # costs bank hits, not re-searches (docs/MONITOR.md "Fleet").
         self._sessions_lock = threading.Lock()
         self._sessions: Dict[str, _RoutedSession] = {}
+        # durable session journals (ISSUE 18, monitor/store.py): with
+        # ``session_dir`` every journal snapshots/appends behind the
+        # live object, so a router restart — or the STANDBY taking the
+        # lease, pointed at the same shared store like the lease file —
+        # rehydrates a session it never served and replays it onto the
+        # ring.  None = journals die with the process (pre-ISSUE-18).
+        self._session_store = None
+        if session_dir is not None:
+            from ..monitor.store import SessionStore
+
+            self._session_store = SessionStore(session_dir)
         self.max_sessions = 1024
         self.session_event_cap = 65_536   # per-session journal bound
         # a client that crashed without closing must not pin a journal
@@ -382,6 +400,9 @@ class FleetRouter:
         self.session_requests = 0
         self.session_replays = 0          # journals replayed onto a node
         self.session_evicted = 0          # idle journals reclaimed at cap
+        self.session_ladder = 0           # verbs the in-router rung took
+        self.session_migrations = 0       # owners invalidated by a leave
+        self.session_rehydrated = 0       # journals loaded from the store
         self._session_n = 0
         # router HA (fleet/lease.py; module docstring).  Without a
         # lease the router is unconditionally active — the single-
@@ -600,10 +621,12 @@ class FleetRouter:
         send_doc(conn, doc)
 
     _SESSION_OPS = ("session.open", "session.append", "session.close")
+    _MEMBER_OPS = ("node.join", "node.leave")
 
     def _handle(self, conn: socket.socket, req: dict) -> None:
         op = req.get("op", "check")
         if op in ("check", "shrink") + self._SESSION_OPS \
+                + self._MEMBER_OPS \
                 and not self._active_now():
             # a non-active (or expired-term) router must never answer
             # a verdict: SHED with the router block, client hops on
@@ -649,6 +672,14 @@ class FleetRouter:
             except Exception as e:  # noqa: BLE001 — answer, don't die
                 self._send(conn, {"id": req.get("id"), "ok": False,
                                   "session": req.get("session"),
+                                  "error": f"{type(e).__name__}: {e}"})
+        elif op in self._MEMBER_OPS:
+            try:
+                self._handle_membership(conn, op, req)
+            except OSError:
+                raise
+            except Exception as e:  # noqa: BLE001 — answer, don't die
+                self._send(conn, {"id": req.get("id"), "ok": False,
                                   "error": f"{type(e).__name__}: {e}"})
         else:
             self._send(conn, {"ok": False,
@@ -1158,6 +1189,16 @@ class FleetRouter:
                                            f"one of {sorted(MODELS)}"})
                 return
             sid = req.get("session")
+            # a named sid that is not live may still be DURABLE (a
+            # router restart, or this is the standby post-takeover on
+            # the shared store): rehydrate before creating fresh, or
+            # the re-open would wipe the journal the client resumes on
+            rehydrated = None
+            with self._sessions_lock:
+                known = sid is not None and str(sid) in self._sessions
+            if sid is not None and not known:
+                rehydrated = self._rehydrate_session(str(sid))
+            created = False
             with self._sessions_lock:
                 if sid is not None and str(sid) in self._sessions:
                     sess = self._sessions[str(sid)]
@@ -1182,16 +1223,48 @@ class FleetRouter:
                             req, "session cap", trace, root), trace,
                             root, t_req, verb='session')
                         return
-                    if sid is None:
-                        self._session_n += 1
-                        sid = f"{self.node_id}-s{self._session_n:06d}"
-                    sess = _RoutedSession(str(sid), model,
-                                          req.get("spec_kwargs") or {})
-                    self._sessions[sess.sid] = sess
+                    if rehydrated is not None:
+                        if rehydrated.model != model:
+                            self._send(conn, {
+                                "id": req.get("id"), "ok": False,
+                                "trace": trace,
+                                "error": f"session {sid} is durable "
+                                         f"against "
+                                         f"{rehydrated.model!r}"})
+                            return
+                        sess = rehydrated
+                        self._sessions[sess.sid] = sess
+                        self.session_rehydrated += 1
+                    else:
+                        if sid is None:
+                            self._session_n += 1
+                            sid = f"{self.node_id}-s{self._session_n:06d}"
+                        sess = _RoutedSession(str(sid), model,
+                                              req.get("spec_kwargs")
+                                              or {})
+                        self._sessions[sess.sid] = sess
+                        created = True
+            if created and self._session_store is not None:
+                # seed the durable journal before any events ride it
+                # (session lock only — never under _sessions_lock, the
+                # one global order; disk IO stays off the registry)
+                with sess.lock:
+                    self._session_store.snapshot(
+                        sess.sid, self._session_doc(sess))
         else:
             sid = str(req.get("session") or "")
             with self._sessions_lock:
                 sess = self._sessions.get(sid)
+            if sess is None:
+                sess = self._rehydrate_session(sid)
+                if sess is not None:
+                    with self._sessions_lock:
+                        raced = self._sessions.get(sid)
+                        if raced is not None:
+                            sess = raced
+                        else:
+                            self._sessions[sid] = sess
+                            self.session_rehydrated += 1
             if sess is None:
                 self._send(conn, {"id": req.get("id"), "ok": False,
                                   "session": sid, "trace": trace,
@@ -1211,6 +1284,15 @@ class FleetRouter:
                 with sess.lock:
                     doc = self._route_session(sess, op, req, deadline,
                                               trace, root)
+                    if doc is None:
+                        # the fleet is exhausted: the session verbs'
+                        # LAST RUNG (ISSUE 18) is the router's own
+                        # in-process SessionManager, exactly the check
+                        # path's host ladder — SHED only if that rung
+                        # refuses too
+                        doc = self._session_ladder(sess, op, req,
+                                                   deadline, trace,
+                                                   root)
             except SessionLimit as e:
                 doc = {**self._shed(req, str(e), trace, root),
                        "session": sess.sid}
@@ -1220,11 +1302,50 @@ class FleetRouter:
             elif op == "session.close" and doc.get("ok"):
                 with self._sessions_lock:
                     self._sessions.pop(sess.sid, None)
+                if self._session_store is not None:
+                    self._session_store.drop(sess.sid)
             self._respond(conn, doc, trace, root, t_req,
                           status="shed" if doc.get("shed") else "ok",
                           verb='session')
         finally:
             self.admission.release(1)
+
+    @staticmethod
+    def _session_doc(sess: _RoutedSession) -> dict:
+        """The durable form of one routed session (caller holds
+        ``sess.lock``): identity + the full journal.  Small by bound —
+        the event cap bounds the journal, snap-every bounds the tail."""
+        return {"sid": sess.sid, "model": sess.model,
+                "spec_kwargs": dict(sess.spec_kwargs),
+                "events": [list(e) for e in sess.events]}
+
+    def _rehydrate_session(self, sid: str
+                           ) -> Optional["_RoutedSession"]:
+        """Rebuild a routed session from the durable store; None on a
+        miss or an unreadable doc.  The caller registers it (and only
+        the registered object counts — a racing rehydrate loses).  The
+        rebuilt session has ``node=None``, so the next verb replays the
+        journal onto the ring owner exactly like a node-loss failover."""
+        if self._session_store is None:
+            return None
+        loaded = self._session_store.load(sid)
+        if loaded is None:
+            return None
+        doc, tail = loaded
+        try:
+            sess = _RoutedSession(str(doc["sid"]), str(doc["model"]),
+                                  dict(doc.get("spec_kwargs") or {}))
+            events = [list(e) for e in doc.get("events", [])]
+            for batch in tail:
+                start = int(batch["seq"])
+                if start > len(events):
+                    break            # torn tail: stop at the gap
+                events.extend(batch["events"]
+                              [max(0, len(events) - start):])
+            sess.events = events[:self.session_event_cap]
+        except (KeyError, TypeError, ValueError):
+            return None
+        return sess
 
     def _route_session(self, sess: _RoutedSession, op: str, req: dict,
                        deadline: float, trace: str, root: str
@@ -1251,6 +1372,16 @@ class FleetRouter:
                     f"session {sess.sid}: router journal cap "
                     f"{self.session_event_cap} reached")
             sess.events.extend(fresh)
+            if self._session_store is not None and fresh:
+                # journal the fresh suffix behind the live object
+                # (caller holds sess.lock; same snap-every compaction
+                # contract as MonitorSession.append)
+                self._session_store.append_events(sess.sid, start,
+                                                  fresh)
+                if self._session_store.tail_len(sess.sid) \
+                        >= self._session_store.snap_every:
+                    self._session_store.snapshot(
+                        sess.sid, self._session_doc(sess))
             # the forwarded append is ALWAYS seq-stamped with the
             # batch's journal position: a seq-less client's events
             # were just replayed inside the journal (a fresh/restarted
@@ -1351,6 +1482,147 @@ class FleetRouter:
                     f"node {target}: session replay refused: "
                     f"{replayed.get('error') or replayed}")
 
+    def _session_ladder(self, sess: _RoutedSession, op: str, req: dict,
+                        deadline: float, trace: str, root: str
+                        ) -> Optional[dict]:
+        """The session verbs' last in-process rung (ISSUE 18): with
+        every node excluded, the router's own SessionManager takes the
+        session — the journal replays into a local MonitorSession
+        exactly as it would onto a node (idempotent by seq), and the
+        verdict stays exact.  A flip here pushes the UNMINIMIZED
+        stream as the repro (the shrink plane lives on the nodes;
+        ``complete: false`` says so honestly).  Caller holds
+        ``sess.lock``; the local session/manager locks nest inside it
+        and never the other way — the one global order."""
+        from ..monitor import SessionManager
+
+        with self._ladders_lock:
+            if self._local_sessions is None:
+                self._local_sessions = SessionManager(
+                    max_sessions=self.max_sessions,
+                    max_events=self.session_event_cap)
+            mgr = self._local_sessions
+        spec = self._spec_for(sess.model, sess.spec_kwargs)
+        self.obs.event("route.ladder", trace=trace, parent=root,
+                       op=op, session=sess.sid)
+        with self._lock:
+            self.session_ladder += 1
+        s, resumed = mgr.open(sess.sid, spec, None, trace=trace)
+        with s.lock:
+            s.model, s.spec_kwargs = sess.model, dict(sess.spec_kwargs)
+            if sess.events:   # idempotent journal replay, like a node
+                s.append([list(e) if isinstance(e, (list, tuple))
+                          else e for e in sess.events], seq=0)
+            if op == "session.close":
+                verdict = s.close()
+                doc = {"id": req.get("id"), "ok": True,
+                       "session": s.sid, "seq": s.seq,
+                       "verdict": VERDICT_NAMES[verdict],
+                       "trace": trace, "flipped": s.flipped,
+                       "ladder": True,
+                       **{k: v for k, v in s.counters().items()
+                          if k != "frontiers"}}
+                mgr.close(s.sid)
+                return doc
+            already_pushed = s.flip_pushed
+            verdict = s.decide()
+            c = s.counters()
+            doc = {"id": req.get("id"), "ok": True, "session": s.sid,
+                   "seq": s.seq, "verdict": VERDICT_NAMES[verdict],
+                   "trace": trace, "ladder": True,
+                   "decided_prefix": c["committed_ops"],
+                   "window_ops": c["window_ops"]}
+            if op == "session.open":
+                doc.update(model=sess.model, resumed=resumed,
+                           per_key=False)
+            else:
+                # the client's batch was journaled before routing, so
+                # its events are inside the replay above; the applied
+                # count it expects is its own batch's length
+                doc["applied"] = len(req.get("events") or [])
+            if s.flipped and not already_pushed:
+                s.flip_pushed = True
+                mgr.note_flip()
+                rows = [list(r) for r in (s.flip_rows or s.rows)]
+                doc["flip"] = {
+                    "verdict": VERDICT_NAMES[int(Verdict.VIOLATION)],
+                    "initial_ops": len(rows), "final_ops": len(rows),
+                    "rounds": 0, "one_minimal": False,
+                    "complete": False, "repro": rows,
+                    "why": "router last rung: shrink plane lives on "
+                           "the nodes — unminimized stream repro"}
+            elif s.flipped:
+                doc["flipped"] = True
+        return doc
+
+    # -- elastic membership (ISSUE 18; docs/SERVING.md) ----------------
+    def _handle_membership(self, conn: socket.socket, op: str,
+                           req: dict) -> None:
+        """``node.join`` adds a node to the ring (consistent hashing
+        moves only the ranges its vnode points claim) and opens its
+        link; an anti-entropy sweep runs on the spot so the newcomer
+        receives the replog segments its new ranges need (handoff is
+        gossip-driven and subsumption-bounded — nodes already holding
+        the rows ship nothing).  ``node.leave`` retires the node,
+        closes its link, and invalidates it as owner of every routed
+        session (each journal replays onto the new ring owner on its
+        next verb — live migration, exactly-once by seq).  Both are
+        idempotent; both are active-gated like every routing op."""
+        nid = str(req.get("node") or "")
+        if not nid:
+            self._send(conn, {"id": req.get("id"), "ok": False,
+                              "error": f"{op} needs 'node'"})
+            return
+        if op == "node.join":
+            addr = str(req.get("address") or "")
+            if not addr:
+                self._send(conn, {"id": req.get("id"), "ok": False,
+                                  "error": "node.join needs 'address'"})
+                return
+            joined = self.membership.add_node(nid, addr)
+            old = self.links.get(nid)
+            if old is None or joined:
+                self.links[nid] = NodeLink(nid, addr)
+                if old is not None:
+                    old.close_all()
+            swept = {}
+            if joined and not self._stop.is_set():
+                # seed the newcomer's replog NOW (bounded by the
+                # anti-entropy preset; the periodic beat finishes any
+                # backlog) so its first routed keys hit warm banks
+                swept = self.anti_entropy_sweep()
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "joined": joined, "node": nid,
+                              "nodes": len(self.membership.all_ids()),
+                              "handoff": swept})
+            return
+        left = self.membership.remove_node(nid)
+        migrated = 0
+        if left:
+            link = self.links.pop(nid, None)
+            if link is not None:
+                link.close_all()
+            # live session migration: snapshot under the registry lock,
+            # invalidate owners under each SESSION lock outside it
+            # (session-lock-before-manager-lock — the one global order)
+            with self._sessions_lock:
+                owned = [s for s in self._sessions.values()
+                         if s.node == nid]
+            for sess in owned:
+                with sess.lock:
+                    if sess.node == nid:
+                        sess.node = None
+                        migrated += 1
+            if migrated:
+                with self._lock:
+                    self.session_migrations += migrated
+                self.obs.event("session.migrate", node=nid,
+                               sessions=migrated)
+        self._send(conn, {"id": req.get("id"), "ok": True,
+                          "left": left, "node": nid,
+                          "sessions_migrated": migrated,
+                          "nodes": len(self.membership.all_ids())})
+
     # -- shed / respond ------------------------------------------------
     def _shed(self, req: dict, reason: str, trace: str = "",
               parent: str = "") -> dict:
@@ -1405,7 +1677,7 @@ class FleetRouter:
         if self.lease is None:
             return {"role": self.ha_role, "term": self.term}
         if self.ha_role == "active":
-            rec = self.lease.renew(self.term)
+            rec = self._lease_call(self.lease.renew, self.term)
             if rec is not None:
                 self._lease_expires = rec["expires_at"]
             else:
@@ -1425,10 +1697,24 @@ class FleetRouter:
             # term just to answer everything from its own ladder
             return {"role": self.ha_role, "term": self.term,
                     "blocked": "no reachable node"}
-        got = self.lease.acquire(self.ha_grace_s)
+        got = self._lease_call(self.lease.acquire, self.ha_grace_s)
         if got is not None:
             self._promote(got, superseded=rec)
         return {"role": self.ha_role, "term": self.term}
+
+    def _lease_call(self, fn, *args):
+        """One lease-store transaction under the ``lease`` fault site
+        (resilience/faults.py): an injected raise/hang — like any
+        transport loss a TcpLeaseStore already maps to None — is a
+        LOST BEAT, counted, never a dead beat thread.  Safety is
+        preserved by construction: a lost renew demotes (one-way per
+        term), a lost acquire just waits for the next beat."""
+        try:
+            return fn(*args)
+        except (InjectedFault, OSError):
+            with self._lock:
+                self.lease_faults += 1
+            return None
 
     def _nodes_reachable(self) -> bool:
         """The standby's independent pre-promotion health probe: at
@@ -1895,6 +2181,10 @@ class FleetRouter:
                     "requests": self.session_requests,
                     "replays": self.session_replays,
                     "evicted": self.session_evicted,
+                    "ladder": self.session_ladder,
+                    "migrated": self.session_migrations,
+                    "rehydrated": self.session_rehydrated,
+                    "durable": self._session_store is not None,
                     "max_sessions": self.max_sessions,
                     "event_cap": self.session_event_cap,
                 }
@@ -1909,9 +2199,11 @@ class FleetRouter:
                      "term": self.term,
                      "holder": self.node_id,
                      "takeovers": self.takeovers,
-                     "ha_sheds": self.ha_sheds}
+                     "ha_sheds": self.ha_sheds,
+                     "lease_faults": self.lease_faults}
         if self.lease is not None:
             lease["path"] = self.lease.path
+            lease["store"] = type(self.lease.store).__name__
             lease["ttl_s"] = self.lease.ttl_s
             if self.ha_role == "active":
                 lease["expires_in_s"] = round(

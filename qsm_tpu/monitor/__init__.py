@@ -16,10 +16,11 @@ from .frontier import (IncrementalFrontier, PrefixHasher,
 from .session import (DEFAULT_MAX_EVENTS, DEFAULT_MAX_SESSIONS,
                       MonitorSession, SessionError, SessionLimit,
                       SessionManager)
+from .store import SessionStore
 
 __all__ = [
     "IncrementalFrontier", "PrefixHasher", "MonitorSession",
-    "SessionManager", "SessionError", "SessionLimit",
+    "SessionManager", "SessionError", "SessionLimit", "SessionStore",
     "encode_frontier_states", "decode_frontier_states",
     "DEFAULT_MAX_EVENTS", "DEFAULT_MAX_SESSIONS",
 ]

@@ -62,8 +62,12 @@ from ..ops.segdc import _Budget, _end_states, default_middle_oracle
 from ..sched.runner import PENDING_T
 
 # the prefix rows' own fingerprint domain: a prefix key can never
-# collide with serve.cache.fingerprint_key's (spec, whole-history) doc
-_PREFIX_DOMAIN = "qsm_tpu_monitor_prefix_v1"
+# collide with serve.cache.fingerprint_key's (spec, whole-history) doc.
+# v2 = the hash-CHAIN form (ISSUE 18): the rolling digest became a
+# serializable chain state, which changes every non-empty prefix key —
+# the domain bump retires v1 rows wholesale instead of letting the two
+# schemes alias
+_PREFIX_DOMAIN = "qsm_tpu_monitor_prefix_v2"
 # witness-slot header tag for encoded frontier state sets
 _FRONTIER_TAG = -7741
 
@@ -76,25 +80,41 @@ DEFAULT_NODE_BUDGET = 2_000_000
 
 
 class PrefixHasher:
-    """Rolling sha256 over a spec identity header plus each committed
-    op — ``key()`` is O(1) via digest-state copy, so the n-th prefix
-    fingerprint never re-hashes the n-1 ops before it."""
+    """Rolling sha256 HASH CHAIN over a spec identity header plus each
+    committed op: ``state_{k+1} = sha256(state_k || op_k)``.  ``key()``
+    is O(1) (the state IS the key), so the n-th prefix fingerprint
+    never re-hashes the n-1 ops before it — and unlike a streaming
+    digest object, the chain state is one hex string that round-trips
+    through JSON, which is what lets a DURABLE session (ISSUE 18,
+    monitor/store.py) resume its fingerprint mid-stream after a
+    process restart without replaying the prefix."""
 
     def __init__(self, spec: Spec):
-        self._h = hashlib.sha256()
-        self._h.update(json.dumps(
+        self.state = hashlib.sha256(json.dumps(
             [_PREFIX_DOMAIN, spec.name, spec.spec_kwargs()],
-            sort_keys=True).encode())
+            sort_keys=True).encode()).hexdigest()
         self.ops_hashed = 0
 
     def push(self, op: Op) -> None:
-        self._h.update(json.dumps(
-            [op.pid, op.cmd, op.arg, op.resp, op.invoke_time,
-             op.response_time]).encode())
+        self.state = hashlib.sha256(
+            (self.state + json.dumps(
+                [op.pid, op.cmd, op.arg, op.resp, op.invoke_time,
+                 op.response_time])).encode()).hexdigest()
         self.ops_hashed += 1
 
     def key(self) -> str:
-        return self._h.copy().hexdigest()
+        return self.state
+
+    def copy(self) -> "PrefixHasher":
+        return PrefixHasher.from_state(self.state, self.ops_hashed)
+
+    @classmethod
+    def from_state(cls, state: str, ops_hashed: int) -> "PrefixHasher":
+        """Rebuild a hasher mid-chain (durable-session resume)."""
+        h = cls.__new__(cls)
+        h.state = str(state)
+        h.ops_hashed = int(ops_hashed)
+        return h
 
 
 def encode_frontier_states(states: Sequence[Tuple[int, ...]]) -> List[list]:
@@ -240,9 +260,7 @@ class IncrementalFrontier:
         return self.verdict
 
     def _peek_hasher(self, seg: Sequence[Op]) -> PrefixHasher:
-        peek = PrefixHasher.__new__(PrefixHasher)
-        peek._h = self.hasher._h.copy()
-        peek.ops_hashed = self.hasher.ops_hashed
+        peek = self.hasher.copy()
         for op in seg:
             peek.push(op)
         return peek
@@ -299,6 +317,53 @@ class IncrementalFrontier:
             return
         self.bank.put(key, int(Verdict.LINEARIZABLE),
                       encode_frontier_states(states))
+
+    # -- durability (ISSUE 18) -----------------------------------------
+    def to_doc(self) -> dict:
+        """The frontier's COMPLETE resumable state as one JSON-safe doc:
+        window ops as 6-rows, frontier states, the hash-chain state,
+        pending indices, counters, verdict, saturation.  The bank and
+        oracle are NOT in the doc — they are process-local substrate the
+        restorer re-binds (the banked prefix rows themselves already
+        ride the replog)."""
+        return {
+            "window": [[op.pid, op.cmd, op.arg, op.resp,
+                        op.invoke_time, op.response_time]
+                       for op in self.window],
+            "states": sorted([int(v) for v in s] for s in self.states),
+            "hash_state": self.hasher.state,
+            "ops_hashed": self.hasher.ops_hashed,
+            "pending": {str(p): i for p, i in self._pending.items()},
+            "verdict": self.verdict,
+            "saturated": self._saturated,
+            "counters": dataclasses.asdict(self.counters),
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict, spec: Spec, *, bank=None, oracle=None,
+                 node_budget: int = DEFAULT_NODE_BUDGET,
+                 max_states: int = DEFAULT_MAX_STATES
+                 ) -> "IncrementalFrontier":
+        """Inverse of :meth:`to_doc`: O(doc) deserialization, ZERO
+        engine folds — the committed prefix resumes as its hash-chain
+        state plus the frontier state set, never as a replay."""
+        f = cls(spec, bank=bank, oracle=oracle,
+                node_budget=node_budget, max_states=max_states)
+        f.window = [
+            Op(pid=int(r[0]), cmd=int(r[1]), arg=int(r[2]),
+               resp=int(r[3]), invoke_time=int(r[4]),
+               response_time=int(r[5]))
+            for r in doc["window"]]
+        f.states = {tuple(int(v) for v in s) for s in doc["states"]}
+        f.hasher = PrefixHasher.from_state(doc["hash_state"],
+                                           doc["ops_hashed"])
+        f._pending = {int(p): int(i)
+                      for p, i in doc.get("pending", {}).items()}
+        f.verdict = int(doc["verdict"])
+        f._saturated = bool(doc.get("saturated", False))
+        f.counters = FrontierCounters(
+            **{k: int(v) for k, v in doc.get("counters", {}).items()})
+        return f
 
     # -- introspection -------------------------------------------------
     def snapshot(self) -> dict:

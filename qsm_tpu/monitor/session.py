@@ -107,6 +107,9 @@ class MonitorSession:
         # failover replay and the flip->shrink path can rebuild engines
         self.model: Optional[str] = None
         self.spec_kwargs: dict = {}
+        # durable substrate (monitor/store.py), bound by the manager;
+        # None = in-memory only (the PR 14 behavior, unchanged)
+        self.store = None
         self._row_of_pid: Dict[int, int] = {}   # outstanding invocation row
         self._key_of_pid: Dict[int, Optional[int]] = {}
         self._frontiers: Dict[Optional[int], IncrementalFrontier] = {}
@@ -144,6 +147,15 @@ class MonitorSession:
             self._apply(ev)
             self.seq += 1
         self._drain(final=False)
+        if self.store is not None:
+            # journal the fresh slice under its stream index; replay
+            # re-appends with the same seq, so overlap is idempotent.
+            # Past the tail cap, compact: rewrite the snapshot from the
+            # live session (the journaled batches are now inside it)
+            self.store.append_events(self.sid, self.seq - len(fresh),
+                                     fresh)
+            if self.store.tail_len(self.sid) >= self.store.snap_every:
+                self.store.snapshot(self.sid, self.to_doc())
         return len(fresh)
 
     def _apply(self, ev) -> None:
@@ -312,6 +324,88 @@ class MonitorSession:
             return v
         return self.decide()
 
+    # -- durability (ISSUE 18) ------------------------------------------
+    def to_doc(self) -> dict:
+        """The session's COMPLETE resumable state as one JSON-safe doc
+        (caller holds :attr:`lock`).  O(window + reorder buffer), not
+        O(stream): the rows log rides along for flip repros, but the
+        decided prefix itself is only its hash-chain state + frontier
+        states — the banked prefix rows stay in the replog where they
+        already live."""
+        return {
+            "sid": self.sid,
+            "spec_name": self.spec.name,
+            "spec_kwargs": self.spec.spec_kwargs(),
+            "model": self.model,
+            "model_kwargs": dict(self.spec_kwargs),
+            "per_key": self.proj is not None,
+            "rows": [list(r) for r in self.rows],
+            "seq": self.seq,
+            "closed": self.closed,
+            "flipped": self.flipped,
+            "flip_pushed": self.flip_pushed,
+            "flip_rows": self.flip_rows,
+            "row_of_pid": {str(p): i
+                           for p, i in self._row_of_pid.items()},
+            "key_of_pid": {str(p): k
+                           for p, k in self._key_of_pid.items()},
+            "heap": [[t, k, n, list(payload)]
+                     for t, k, n, payload in self._heap],
+            "heap_seq": self._heap_seq,
+            "horizon": self._horizon,
+            "last_t": self._last_t,
+            "auto_t": self._auto_t,
+            "frontiers": {("" if key is None else str(key)): f.to_doc()
+                          for key, f in self._frontiers.items()},
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict, spec: Spec, *,
+                 proj_spec: Optional[Spec] = None,
+                 bank=None,
+                 node_budget: int = DEFAULT_NODE_BUDGET,
+                 max_states: int = DEFAULT_MAX_STATES,
+                 max_events: int = DEFAULT_MAX_EVENTS,
+                 trace: str = "") -> "MonitorSession":
+        """Inverse of :meth:`to_doc` — O(doc) deserialization, zero
+        engine folds.  The caller passes the REBUILT spec (and
+        projected spec) and must have checked identity against the
+        doc's ``spec_name``/``spec_kwargs`` first; bank/oracle are
+        re-bound process-locally."""
+        s = cls(doc["sid"], spec, proj_spec=proj_spec, bank=bank,
+                node_budget=node_budget, max_states=max_states,
+                max_events=max_events, trace=trace)
+        s.model = doc.get("model")
+        s.spec_kwargs = dict(doc.get("model_kwargs") or {})
+        s.rows = [list(r) for r in doc["rows"]]
+        s.seq = int(doc["seq"])
+        s.closed = bool(doc["closed"])
+        s.flipped = bool(doc["flipped"])
+        # the one attribute whose post-publication writes are
+        # lock-guarded (the flip-push latch): restore it under the
+        # same guard, keeping one discipline across every write site
+        with s.lock:
+            s.flip_pushed = bool(doc.get("flip_pushed", False))
+        s.flip_rows = doc.get("flip_rows")
+        s._row_of_pid = {int(p): int(i)
+                         for p, i in doc["row_of_pid"].items()}
+        s._key_of_pid = {int(p): (None if k is None else int(k))
+                         for p, k in doc["key_of_pid"].items()}
+        s._heap = [(int(t), int(k), int(n), tuple(payload))
+                   for t, k, n, payload in doc["heap"]]
+        heapq.heapify(s._heap)
+        s._heap_seq = int(doc["heap_seq"])
+        s._horizon = int(doc["horizon"])
+        s._last_t = int(doc["last_t"])
+        s._auto_t = int(doc["auto_t"])
+        for kstr, fdoc in doc["frontiers"].items():
+            key = None if kstr == "" else int(kstr)
+            fspec = proj_spec if key is not None else spec
+            s._frontiers[key] = IncrementalFrontier.from_doc(
+                fdoc, fspec, bank=bank, node_budget=node_budget,
+                max_states=max_states)
+        return s
+
     # -- introspection --------------------------------------------------
     def history(self) -> History:
         """The stream so far as a canonical History (the ONE decoder,
@@ -366,8 +460,12 @@ class SessionManager:
                  max_events: int = DEFAULT_MAX_EVENTS,
                  node_budget: int = DEFAULT_NODE_BUDGET,
                  max_states: int = DEFAULT_MAX_STATES,
-                 idle_s: float = 3600.0):
+                 idle_s: float = 3600.0,
+                 store=None):
         self.bank = bank
+        # durable substrate (monitor/store.py SessionStore-shaped);
+        # None = in-memory sessions only, the pre-ISSUE-18 behavior
+        self.store = store
         self.max_sessions = max(1, int(max_sessions))
         self.max_events = int(max_events)
         self.node_budget = int(node_budget)
@@ -386,6 +484,7 @@ class SessionManager:
         self.opened = 0
         self.closed = 0
         self.resumed = 0             # open() calls that found a live sid
+        self.restored = 0            # opens resumed from the durable store
         self.evicted = 0             # idle sessions reclaimed at cap
         self.flips_pushed = 0        # flip payloads handed to clients
         self._closed_events = 0
@@ -421,6 +520,14 @@ class SessionManager:
         # never reach for a session lock while holding its own
         for s_old in stale:
             self._fold(s_old, evicted=True)
+        # durable resume (ISSUE 18): a sid evicted at cap or lost to a
+        # process restart comes back from the store in O(doc) — zero
+        # engine folds; the journal tail replays seq-idempotently onto
+        # banked prefixes.  Outside both locks: deserialization touches
+        # no shared state until the session is registered below
+        restored: Optional[MonitorSession] = None
+        if sid is not None and self.store is not None:
+            restored = self._restore(sid, spec, proj_spec, trace)
         with self._lock:
             if sid is not None and sid in self._sessions:
                 # a racing open of the same sid won between our lock
@@ -438,6 +545,10 @@ class SessionManager:
                     f"session cap {self.max_sessions} reached "
                     f"({len(self._sessions)} live) — close sessions "
                     "or raise max_sessions")
+            if restored is not None:
+                self._sessions[sid] = restored
+                self.restored += 1
+                return restored, True
             if sid is None:
                 self._n += 1
                 sid = f"s{self._n:06d}"
@@ -449,20 +560,68 @@ class SessionManager:
                                node_budget=self.node_budget,
                                max_states=self.max_states,
                                max_events=self.max_events, trace=trace)
+            s.store = self.store
             self._sessions[sid] = s
             self.opened += 1
-            return s, False
+        if self.store is not None:
+            # seed the durable file before any batch journals against
+            # it (an atomic rewrite, so it also resets a file whose
+            # restore came back unreplayable).  Session lock, never
+            # under the manager's — the one global order
+            with s.lock:
+                self.store.snapshot(sid, s.to_doc())
+        return s, False
+
+    def _restore(self, sid: str, spec: Spec,
+                 proj_spec: Optional[Spec], trace: str
+                 ) -> Optional[MonitorSession]:
+        """Rebuild ``sid`` from the durable store; None on a miss or an
+        unreplayable file (the open proceeds fresh and re-seeds it).
+        Same identity gate as the live-resume path — a durable doc for
+        a DIFFERENT spec is refused loudly, never silently shadowed.
+        The store binds only AFTER the tail replays, so the replay
+        never re-journals its own batches."""
+        loaded = self.store.load(sid)
+        if loaded is None:
+            return None
+        doc, tail = loaded
+        if (doc.get("spec_name"), doc.get("spec_kwargs")) != \
+                (spec.name, spec.spec_kwargs()):
+            raise SessionError(
+                f"session {sid} is durable against "
+                f"{doc.get('spec_name')!r}; close it first")
+        try:
+            s = MonitorSession.from_doc(
+                doc, spec, proj_spec=proj_spec, bank=self.bank,
+                node_budget=self.node_budget,
+                max_states=self.max_states,
+                max_events=self.max_events, trace=trace)
+            for batch in tail:
+                s.append(batch["events"], seq=batch["seq"])
+        except (KeyError, TypeError, ValueError, SessionLimit):
+            return None
+        s.store = self.store
+        return s
 
     def _pop_idle_locked(self) -> List[MonitorSession]:
         """Pop sessions idle past ``idle_s``, LRU-first (caller holds
         ``_lock``; no session locks touched here — the fold happens
         outside, in the one global lock order).  An evicted client
         resumes by re-open + seq replay with its banked prefixes
-        intact."""
+        intact.
+
+        With a durable store the cap bounds MEMORY, not open sessions:
+        if nothing is idle, the LRU session is evicted to the store
+        anyway (its file is already current — every append journals —
+        so a returning client restores in O(doc) with zero folds).
+        Without a store the cap stays hard and the open raises
+        SessionLimit, the pre-ISSUE-18 behavior."""
         now = time.monotonic()
-        return [self._sessions.pop(sid)
-                for sid in [k for k, s in self._sessions.items()
-                            if now - s.last_used >= self.idle_s]]
+        victims = [k for k, s in self._sessions.items()
+                   if now - s.last_used >= self.idle_s]
+        if not victims and self.store is not None and self._sessions:
+            victims = [next(iter(self._sessions))]  # LRU: oldest entry
+        return [self._sessions.pop(sid) for sid in victims]
 
     def _fold(self, s: MonitorSession, evicted: bool = False) -> None:
         """Fold a departing session's counters into the running totals
@@ -492,6 +651,9 @@ class SessionManager:
         if s is None:
             return None
         self._fold(s)
+        if self.store is not None:
+            # a closed session answered its verdict; nothing resumes it
+            self.store.drop(sid)
         return s
 
     def note_flip(self) -> None:
@@ -504,7 +666,8 @@ class SessionManager:
             live = list(self._sessions.values())
             out = {"sessions_live": len(live),
                    "opened": self.opened, "closed": self.closed,
-                   "resumed": self.resumed, "evicted": self.evicted,
+                   "resumed": self.resumed, "restored": self.restored,
+                   "evicted": self.evicted,
                    "session_events": self._closed_events,
                    "frontier_advances": self._closed_advances,
                    "prefix_hits": self._closed_prefix_hits,

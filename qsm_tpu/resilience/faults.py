@@ -68,7 +68,12 @@ the router's own in-process host ladder, tests/test_fleet.py), ``router``
 (serve/client.py client→router round-trips — partition/hang/raise there
 exercises the client's bounded multi-address failover onto the standby
 router, tests/test_fleet_ha.py; same hang/raise/kill/partition grammar,
-one level further out).
+one level further out), ``lease`` (fleet/lease.py lease-store
+acquire/renew transactions — partition/hang/raise there makes the HA
+plane lose beats: an active that cannot renew demotes (one-way per
+term), a standby that cannot acquire waits, and NOBODY serves a stale
+term; the router counts the losses as ``lease_faults``,
+tests/test_fleet_ha.py).
 """
 
 from __future__ import annotations

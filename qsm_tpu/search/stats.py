@@ -79,6 +79,10 @@ class SearchStats:
     # while deciding these lanes — fleet/router.py stamps it so a batch
     # that survived a node loss (re-dispatched to a surviving node or
     # the router's own ladder) says so in its own cost record
+    lease_faults: int = 0       # lease-store beats lost (fault/transport)
+    # while this work ran — the HA plane's cost record: a soak that
+    # rode out lease-store partitions must say how many beats the
+    # arbitration lost (fleet/lease.py `lease` fault site)
     # span<->stats bridge (qsm_tpu/obs): trace events emitted while
     # deciding these lanes.  The serve dispatch path stamps it into the
     # batch's compact record and the batch's `serve.dispatch` span
@@ -128,7 +132,8 @@ class SearchStats:
                   "memo_inserts", "compactions", "chunk_rounds", "rescued",
                   "deferred", "tail_histories", "segments_split",
                   "segments_total", "degradations", "retries",
-                  "worker_faults", "node_faults", "pcomp_split",
+                  "worker_faults", "node_faults", "lease_faults",
+                  "pcomp_split",
                   "pcomp_subs", "pcomp_recombine_ms", "shrink_rounds",
                   "shrink_lanes", "shrink_memo_hits", "obs_events",
                   "session_events", "frontier_advances", "flips_pushed",
@@ -179,6 +184,7 @@ class SearchStats:
             "fb": self.fallback_engine,
             "wf": self.worker_faults,
             "ndf": self.node_faults,
+            "lsf": self.lease_faults,
             # P-compositionality counters ride every compact record too:
             # a bench row from a decomposed run must say it decomposed
             # (and into what) or its rate reads as a whole-history rate
@@ -237,6 +243,8 @@ class SearchStats:
             out["resilience_worker_faults"] = float(self.worker_faults)
         if self.node_faults:
             out["resilience_node_faults"] = float(self.node_faults)
+        if self.lease_faults:
+            out["resilience_lease_faults"] = float(self.lease_faults)
         # pcomp accounting only when decomposition actually happened —
         # zeros would claim "pcomp ran, split nothing" on every
         # whole-history run
@@ -280,6 +288,7 @@ _COUNTER_FIELDS = ("histories", "lockstep_iters", "nodes_explored",
                    "chunk_rounds", "rescued", "deferred", "tail_histories",
                    "segments_split", "segments_total", "degradations",
                    "retries", "worker_faults", "node_faults",
+                   "lease_faults",
                    "pcomp_split", "pcomp_subs", "pcomp_recombine_ms",
                    "shrink_rounds", "shrink_lanes", "shrink_memo_hits",
                    "obs_events", "session_events", "frontier_advances",

@@ -192,6 +192,26 @@ class CheckClient:
     def stats(self) -> dict:
         return self._round_trip({"op": "stats"})
 
+    # -- elastic membership (ISSUE 18; fleet/router.py) ----------------
+    def node_join(self, node: str, address: str) -> dict:
+        """Add a node to a router's ring (idempotent): consistent
+        hashing moves only the key ranges the newcomer's vnode points
+        claim, and the router seeds its replog by anti-entropy before
+        answering."""
+        return self._round_trip({"op": "node.join",
+                                 "id": f"q{next(_ids)}",
+                                 "node": str(node),
+                                 "address": str(address)})
+
+    def node_leave(self, node: str) -> dict:
+        """Retire a node from a router's ring (idempotent): its key
+        ranges move to the next points clockwise and every session it
+        owned migrates live — the journal replays onto the new owner
+        on its next verb, exactly-once by seq."""
+        return self._round_trip({"op": "node.leave",
+                                 "id": f"q{next(_ids)}",
+                                 "node": str(node)})
+
     # -- fleet observability (docs/OBSERVABILITY.md "Fleet") -----------
     def health(self) -> dict:
         """The ``health`` op: SLO status of the server/router (and,

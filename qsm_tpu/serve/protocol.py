@@ -114,6 +114,32 @@ the observability surface must stay up mid-incident:
   every node's health.  ``qsm-tpu health`` maps the status to pinned
   exit codes (0/1/2; 3 unreachable).
 
+Lease service (ISSUE 18, fleet/lease.py): a server started with a
+``lease_path`` additionally answers the ``lease.acquire`` /
+``lease.renew`` / ``lease.release`` / ``lease.read`` ops — the
+transaction surface :class:`~qsm_tpu.fleet.lease.TcpLeaseStore` rides
+so routers on DIFFERENT hosts can share one lease record.  Each op is
+one request/response pair carrying ``holder`` (plus ``ttl_s`` /
+``grace_s`` / ``term`` as the transaction needs); the server runs the
+identical flock-excluded FileLeaseStore transaction and answers
+``{"ok": true, "acquired"|"renewed"|"released": bool, "record":
+{...}}``.  A refused transaction is an OK response with the flag
+false — transport errors are the only None a TcpLeaseStore caller
+sees, and both read as "lost this beat".
+
+Elastic membership (ISSUE 18, fleet/membership.py): a router answers
+``{"op": "node.join", "node": ID, "address": ADDR}`` and ``{"op":
+"node.leave", "node": ID}`` — live ring membership changes.  Join
+rebuilds the consistent-hash ring (only the arriving node's key
+ranges move), starts replog handoff via the next anti-entropy sweep,
+and invalidates the routed-session pins whose ring owner changed so
+their next op replays the journal onto the new owner (exactly-once by
+``seq``).  Leave is the inverse: the departing node's ranges scatter
+to survivors and its pinned sessions migrate on their next op.  Both
+are active-gated (a standby must not mutate the fleet view) and
+idempotent (re-joining a present node / re-leaving an absent one is a
+no-op).
+
 Check/shrink/session requests may also carry ``parent`` — the span id
 of the caller's dispatch edge.  A router stamps its ``node.dispatch``
 span there, so the node's whole request subtree pins under the router
@@ -158,6 +184,8 @@ OPS = (
     "replog.covers", "replog.subsumed",
     "gossip.peers",
     "obs.spans", "obs.trace", "obs.metrics", "health",
+    "lease.acquire", "lease.renew", "lease.release", "lease.read",
+    "node.join", "node.leave",
 )
 
 # Ops that MAY legally sit on a retrying call path (CheckClient
@@ -170,6 +198,14 @@ OPS = (
 #                       events; close is a no-op on a closed session
 #   replog.*/gossip.* — anti-entropy reads + set-union writes
 #   stats/obs.*/health— read-only snapshots (spans is cursor-paged)
+#   lease.*           — the store transaction is term-gated: a replayed
+#                       acquire of an own live record is a renew (same
+#                       term), a replayed renew refreshes the same
+#                       term, a replayed release re-tombstones the
+#                       already-released record, read is read-only
+#   node.join/leave   — membership set-union/difference: re-adding a
+#                       present node or removing an absent one is a
+#                       no-op rebuild of the same ring
 # ``shutdown`` is deliberately ABSENT: re-sending it after a mid-flight
 # failover could stop a *different* process than the one addressed, so
 # the client sends it on a single non-retrying attempt
@@ -181,6 +217,8 @@ IDEMPOTENT_OPS = (
     "replog.covers", "replog.subsumed",
     "gossip.peers",
     "obs.spans", "obs.trace", "obs.metrics", "health",
+    "lease.acquire", "lease.renew", "lease.release", "lease.read",
+    "node.join", "node.leave",
 )
 
 # Envelope keys: request keys any sender may attach / response keys

@@ -216,6 +216,8 @@ class CheckServer:
                  session_events: int = 65_536,
                  session_states: int = 64,
                  session_budget: int = 2_000_000,
+                 session_dir: Optional[str] = None,
+                 lease_path: Optional[str] = None,
                  slo: Optional[str] = None,
                  slo_window_s: float = 60.0):
         if engine not in ("auto", "planned"):
@@ -306,6 +308,17 @@ class CheckServer:
         self._gossip_fanout = int(gossip_fanout)
         if self.replog is not None and peers:
             self._make_gossip(peers)
+        # lease hosting (fleet/lease.py, ISSUE 18): with a lease_path
+        # this node answers the lease.* ops, so routers on OTHER hosts
+        # share one record through a TcpLeaseStore — the flock and the
+        # clock both live here, keeping the single-authority safety
+        # argument of the filesystem lease
+        self.lease_store = None
+        self.lease_ops = 0
+        if lease_path is not None:
+            from ..fleet.lease import FileLeaseStore
+
+            self.lease_store = FileLeaseStore(lease_path)
         self.admission = AdmissionController(
             queue_depth=queue_depth, policy=self.policy,
             pool_state=self.pool.shed_state if self.pool else None)
@@ -361,10 +374,18 @@ class CheckServer:
         # moment it is decidable with a shrink-plane-minimized repro.
         from ..monitor import SessionManager
 
+        # ``session_dir`` makes sessions DURABLE (ISSUE 18,
+        # monitor/store.py): restart-or-evicted sids resume from the
+        # snapshot+journal substrate in O(doc) with zero engine folds
+        session_store = None
+        if session_dir is not None:
+            from ..monitor import SessionStore
+
+            session_store = SessionStore(session_dir)
         self.monitor = SessionManager(
             bank=self.cache, max_sessions=max_sessions,
             max_events=session_events, node_budget=session_budget,
-            max_states=session_states)
+            max_states=session_states, store=session_store)
 
     def _make_gossip(self, peers) -> None:
         from ..fleet.gossip import GossipAgent
@@ -644,6 +665,9 @@ class CheckServer:
             self._handle_replog(conn, op, req)
         elif op == "gossip.peers":
             self._handle_gossip_peers(conn, req)
+        elif op in ("lease.acquire", "lease.renew", "lease.release",
+                    "lease.read"):
+            self._handle_lease(conn, op, req)
         elif op in ("session.open", "session.append", "session.close"):
             try:
                 self._handle_session(conn, op, req)
@@ -870,6 +894,49 @@ class CheckServer:
         self._send(conn, {"id": req.get("id"), "ok": True,
                           "peers": self.gossip.peer_ids(),
                           "interval_s": self.gossip.interval_s})
+
+    # -- the lease service (fleet/lease.py TcpLeaseStore) --------------
+    def _handle_lease(self, conn: socket.socket, op: str,
+                      req: dict) -> None:
+        """The lease-hosting surface: each op runs ONE flock-excluded
+        FileLeaseStore transaction on this node's ``lease_path`` —
+        the term/expiry semantics routers see over TCP are byte-for-
+        byte the single-host semantics, with this host's clock as the
+        one authority.  A REFUSED transaction (live foreign term,
+        superseded renew, lost flock beat) is an OK response with the
+        flag false — only transport failure reads as a lost beat on
+        the caller's side, so the two are never conflated."""
+        if self.lease_store is None:
+            self._send(conn, {"id": req.get("id"), "ok": False,
+                              "error": "node hosts no lease record "
+                                       "(start with lease_path)"})
+            return
+        holder = str(req.get("holder", ""))
+        self.lease_ops += 1
+        if op == "lease.read":
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "record": self.lease_store.read()})
+            return
+        if op == "lease.acquire":
+            rec = self.lease_store.acquire(
+                holder, ttl_s=float(req.get("ttl_s", 3.0)),
+                grace_s=float(req.get("grace_s", 0.0)))
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "acquired": rec is not None,
+                              "record": (rec if rec is not None
+                                         else self.lease_store.read())})
+            return
+        if op == "lease.renew":
+            rec = self.lease_store.renew(
+                holder, int(req.get("term", -1)),
+                ttl_s=float(req.get("ttl_s", 3.0)))
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "renewed": rec is not None,
+                              "record": rec})
+            return
+        self.lease_store.release(holder)  # lease.release
+        self._send(conn, {"id": req.get("id"), "ok": True,
+                          "released": True})
 
     # -- the check path ------------------------------------------------
     def _handle_check(self, conn: socket.socket, req: dict) -> None:
@@ -1298,7 +1365,8 @@ class CheckServer:
         finally:
             self.admission.release(1)
 
-    def _session_append(self, s, req: dict, deadline: float,
+    def _session_append(self, s: "MonitorSession", req: dict,
+                        deadline: float,
                         trace: str, root: str) -> dict:
         events = req.get("events")
         if not isinstance(events, list) or not events:
@@ -1320,15 +1388,19 @@ class CheckServer:
             # the flip: pushed on the append that made the violation
             # decidable (a verdict only changes when an event arrives,
             # so this response IS the earliest possible push), carrying
-            # the shrink-plane-minimized repro + its certificate
-            s.flip_pushed = True
+            # the shrink-plane-minimized repro + its certificate.  The
+            # session RLock is already held by the dispatching caller;
+            # re-acquiring keeps the guard visible at the write.
+            with s.lock:
+                s.flip_pushed = True
             self.monitor.note_flip()
             doc["flip"] = self._session_flip(s, deadline, trace, root)
         elif s.flipped:
             doc["flipped"] = True  # terminal; repro already delivered
         return doc
 
-    def _session_flip(self, s, deadline: float, trace: str,
+    def _session_flip(self, s: "MonitorSession", deadline: float,
+                      trace: str,
                       root: str) -> dict:
         """Auto-minimize the violating stream through the PR 10 shrink
         plane (frontier candidates ride the shared micro-batcher and
@@ -1373,7 +1445,8 @@ class CheckServer:
                        traces=[trace])
         return flip
 
-    def _session_close(self, s, req: dict, deadline: float,
+    def _session_close(self, s: "MonitorSession", req: dict,
+                       deadline: float,
                        trace: str, root: str) -> dict:
         verdict = s.close()
         doc = {"id": req.get("id"), "ok": True, "session": s.sid,
@@ -1841,6 +1914,11 @@ class CheckServer:
             "admission": self.admission.snapshot(),
             "batcher": self.batcher.snapshot(),
             "cache": self.cache.stats(),
+            # lease hosting (fleet/lease.py): transaction count of the
+            # lease.* surface — None unless this node hosts the record
+            "lease_host": ({"path": self.lease_store.describe(),
+                            "ops": self.lease_ops}
+                           if self.lease_store is not None else None),
             # node-to-node anti-entropy accounting (fleet/gossip.py):
             # None unless this node gossips
             "gossip": (self.gossip.snapshot()

@@ -488,6 +488,9 @@ def cmd_serve(args) -> int:
         replog_seal_rows=args.replog_seal_rows,
         peers=peers or None, gossip_s=args.gossip_s,
         gossip_fanout=args.gossip_fanout,
+        max_sessions=args.max_sessions,
+        session_dir=args.session_dir,
+        lease_path=args.lease_host,
         slo=args.slo, slo_window_s=args.slo_window)
     warm = [m.strip() for m in args.warm.split(",")] if args.warm else []
     warm = [m for m in warm if m]
@@ -557,6 +560,10 @@ def cmd_fleet(args) -> int:
             cmd = [sys.executable, "-m", "qsm_tpu", "serve",
                    "--port", "0", "--node-id", f"n{i}",
                    "--replog-dir", os.path.join(replog_root, f"n{i}")]
+            if args.session_root:
+                cmd += ["--session-dir",
+                        os.path.join(args.session_root, f"n{i}"),
+                        "--max-sessions", str(args.max_sessions)]
             if args.workers:
                 cmd += ["--workers", str(args.workers)]
             if args.warm:
@@ -612,6 +619,7 @@ def cmd_fleet(args) -> int:
         heartbeat_s=args.heartbeat_s,
         anti_entropy_s=args.anti_entropy_s,
         node_id=args.router_id,
+        session_dir=args.session_journal,
         lease_path=args.lease_path,
         lease_ttl_s=args.lease_ttl_s,
         trace_log=args.trace_log, flight_dir=args.flight_dir,
@@ -1822,6 +1830,27 @@ def cmd_fuzz(args) -> int:
     return 0 if rep.ok else 1
 
 
+def cmd_soak(args) -> int:
+    """The ISSUE 18 chaos soak (gen/soak.py): durable sessions held
+    open through rolling node restarts, an active-router SIGKILL and
+    one node leave/join, with every flip and close verdict re-proved
+    by a fresh memo oracle.  Prints the gate report as one JSON line;
+    exit 0 only when ``gate_ok`` (zero wrong verdicts, zero lost
+    flips, durable resumes banked, SLO health green)."""
+    from ..gen.soak import soak_sessions
+
+    rep = soak_sessions(
+        sessions=args.sessions, ops_per_session=args.ops,
+        model=args.model, seed=args.seed, workers=args.workers,
+        max_sessions=args.max_sessions, lease_ttl_s=args.lease_ttl_s,
+        fuzz_rounds=args.fuzz_rounds, run_dir=args.run_dir,
+        faults=args.faults, log=lambda m: print(m, file=sys.stderr))
+    print(json.dumps(rep))
+    if not rep.get("gate_ok"):
+        return 1
+    return int(rep.get("exit_code", 0))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="qsm_tpu",
@@ -2011,6 +2040,25 @@ def main(argv=None) -> int:
                         "'check=250ms:p99,shed_rate<0.01' — exposed "
                         "as burn-rate gauges, the `health` op and the "
                         "slo.breach flight-dump trigger")
+    p.add_argument("--session-dir", default=None, metavar="DIR",
+                   help="durable monitor sessions (monitor/store.py): "
+                        "session state snapshots + bounded journal "
+                        "tails under DIR; a restarted or cap-evicted "
+                        "session resumes in O(doc) from its banked "
+                        "prefixes with zero engine folds — and the "
+                        "session cap then bounds memory, not open "
+                        "sessions (docs/MONITOR.md \"Durability\")")
+    p.add_argument("--max-sessions", type=int, default=256,
+                   help="live monitor-session slots; with "
+                        "--session-dir the LRU session past the cap "
+                        "is evicted to the durable store, without it "
+                        "the cap is hard (session.open SHEDs)")
+    p.add_argument("--lease-host", default=None, metavar="PATH",
+                   help="host the fleet HA lease on this node: serve "
+                        "the lease.* ops over a FileLeaseStore at "
+                        "PATH, so routers on OTHER hosts point "
+                        "--lease-store tcp://this-node at it "
+                        "(fleet/lease.py)")
     p.add_argument("--slo-window", type=float, default=60.0,
                    help="SLO sliding-window seconds")
     p.set_defaults(fn=cmd_serve)
@@ -2039,6 +2087,22 @@ def main(argv=None) -> int:
     p.add_argument("--replog-root", default=None, metavar="DIR",
                    help="root directory for spawned nodes' segmented "
                         "verdict logs (default: a temp dir)")
+    p.add_argument("--session-root", default=None, metavar="DIR",
+                   help="give each spawned node a durable session "
+                        "store under DIR/<node-id> (serve "
+                        "--session-dir): sessions survive node "
+                        "restarts and cap eviction")
+    p.add_argument("--session-journal", default=None, metavar="DIR",
+                   help="durable ROUTER session journals "
+                        "(monitor/store.py): point the active and "
+                        "standby routers at the SAME dir (like "
+                        "--lease-store) and a takeover rehydrates "
+                        "sessions the standby never served, replaying "
+                        "them onto the ring")
+    p.add_argument("--max-sessions", type=int, default=256,
+                   help="per-spawned-node live session slots (serve "
+                        "--max-sessions; memory bound when "
+                        "--session-root is set)")
     p.add_argument("--queue-depth", type=int, default=4096,
                    help="router admission bound (lanes in flight)")
     p.add_argument("--quarantine-after", type=int, default=3,
@@ -2060,13 +2124,17 @@ def main(argv=None) -> int:
                    metavar="PORT",
                    help="router Prometheus /metrics port (per-node "
                         "health + traffic series)")
-    p.add_argument("--lease-path", default=None, metavar="PATH",
-                   help="router-HA lease file (fleet/lease.py): run "
+    p.add_argument("--lease-path", "--lease-store", dest="lease_path",
+                   default=None, metavar="STORE",
+                   help="router-HA lease store (fleet/lease.py): run "
                         "several `qsm-tpu fleet` routers with the SAME "
-                        "fleet config and lease path — one wins active "
+                        "fleet config and lease store — one wins active "
                         "(term-stamped responses), the rest stand by "
                         "and take over on lease expiry; clients ride "
-                        "it with a comma --addr list")
+                        "it with a comma --addr list.  A filesystem "
+                        "path flocks a local record; tcp://HOST:PORT "
+                        "speaks the lease.* ops to a node started "
+                        "with --lease-host, so routers span hosts")
     p.add_argument("--lease-ttl-s", type=float, default=3.0,
                    help="lease TTL seconds (renewed each beat; a dead "
                         "active is superseded within ~1.5x this)")
@@ -2331,6 +2399,40 @@ def main(argv=None) -> int:
                    help="directory for per-model steering checkpoints "
                         "(resume rails, --addr mode)")
     p.set_defaults(fn=cmd_fuzz)
+
+    p = sub.add_parser(
+        "soak",
+        help="chaos soak of durable sessions (gen/soak.py): spawn a "
+             "3-node fleet + active/standby routers, hold N monitor "
+             "sessions open through rolling node restarts, a SIGKILL "
+             "of the active router and one node leave/join, every "
+             "verdict re-proved by a fresh memo oracle "
+             "(docs/MONITOR.md \"Durability\")")
+    p.add_argument("--sessions", type=int, default=1000,
+                   help="concurrent monitor sessions held open "
+                        "through the fault schedule")
+    p.add_argument("--ops", type=int, default=12,
+                   help="events per session stream")
+    p.add_argument("--model", default="register", choices=sorted(MODELS))
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--workers", type=int, default=8,
+                   help="client threads driving the session verbs")
+    p.add_argument("--max-sessions", type=int, default=256,
+                   help="per-node live session slots (the memory "
+                        "bound; the durable store holds the rest)")
+    p.add_argument("--lease-ttl-s", type=float, default=1.0)
+    p.add_argument("--fuzz-rounds", type=int, default=2,
+                   help="PR 17 closed-loop rounds run against the "
+                        "surviving router mid-soak (0 = skip)")
+    p.add_argument("--run-dir", default=None, metavar="DIR",
+                   help="keep the fleet's replogs/session stores/"
+                        "lease here (default: a temp dir, removed)")
+    p.add_argument("--faults", default=None, metavar="SPEC",
+                   help="QSM_TPU_FAULTS grammar injected into every "
+                        "spawned node (resilience/faults.py), e.g. "
+                        "'raise:serve:0.01' — the rig's retry riders "
+                        "must still land every verb exactly once")
+    p.set_defaults(fn=cmd_soak)
 
     p = sub.add_parser(
         "stats",

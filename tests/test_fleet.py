@@ -628,3 +628,121 @@ def test_replog_adopt_refuses_name_fingerprint_mismatch(tmp_path):
     # the consistent pair adopts fine
     good = f"seg-x-000001-{fp[:12]}.jsonl"
     assert [r["key"] for r in log.adopt(good, fp, lines)] == ["k"]
+
+
+# --- elastic membership (ISSUE 18) -----------------------------------------
+
+def test_membership_join_leave_moves_only_affected_ranges():
+    """Consistent-hash elasticity: a join moves ONLY the key ranges
+    the newcomer's vnode points claim (every other key keeps its
+    owner), and the matching leave restores the original ownership
+    exactly.  Both verbs are idempotent."""
+    m = Membership([("n0", "unused:1"), ("n1", "unused:2")])
+    keys = [f"key{i}" for i in range(300)]
+    before = {k: m.ring.node_for(k, {"n0", "n1"}) for k in keys}
+    assert m.add_node("n2", "unused:3")
+    assert not m.add_node("n2", "unused:3")      # idempotent re-join
+    allowed = {"n0", "n1", "n2"}
+    after = {k: m.ring.node_for(k, allowed) for k in keys}
+    moved = [k for k in keys if after[k] != before[k]]
+    assert moved, "a 3rd node must claim some ranges"
+    assert all(after[k] == "n2" for k in moved)  # only ITS ranges moved
+    # a member re-joining from a new address re-addresses in place
+    assert m.add_node("n1", "moved:9")
+    assert m.address_of("n1") == "moved:9"
+    assert {k: m.ring.node_for(k, allowed) for k in keys} == after
+    # the leave is the exact inverse
+    assert m.remove_node("n2")
+    assert not m.remove_node("n2")               # idempotent re-leave
+    assert {k: m.ring.node_for(k, {"n0", "n1"}) for k in keys} == before
+    snap = m.snapshot()
+    assert snap["joins"] == 2 and snap["leaves"] == 1
+
+
+def test_node_join_leave_rebalances_and_migrates_sessions(tmp_path):
+    """The wire verbs: ``node.join`` opens the link and rebalances the
+    ring; ``node.leave`` of a session's owner migrates the session
+    live — the journal replays onto the new owner on the next verb,
+    exactly-once by seq, and the stream closes with the exact
+    verdict."""
+    from qsm_tpu.core.history import sequential_history
+    from qsm_tpu.serve.protocol import history_to_rows
+
+    router, nodes = _fleet(tmp_path, n_nodes=2)
+    extra = CheckServer(node_id="n2",
+                        replog_dir=str(tmp_path / "replog_extra"),
+                        flush_s=0.005).start()
+    client = None
+    try:
+        client = CheckClient(router.address, timeout_s=10.0)
+        h = sequential_history([(0, 1, 1, 0), (0, 0, 0, 1),
+                                (1, 1, 2, 0), (1, 0, 0, 2)] * 6)
+        rows = history_to_rows(h)
+        half = len(rows) // 2
+        opened = client.session_open("register")
+        sid = opened["session"]
+        for i, r in enumerate(rows[:half]):
+            assert client.session_append(sid, [r], seq=i)["ok"]
+        # JOIN: the third node enters the ring and takes traffic
+        joined = client.node_join("n2", extra.address)
+        assert joined["ok"] and joined["joined"], joined
+        assert joined["nodes"] == 3
+        assert not client.node_join("n2", extra.address)["joined"]
+        assert "n2" in router.membership.all_ids()
+        # LEAVE the session's owner: the session migrates live
+        owner = router._sessions[sid].node
+        assert owner is not None
+        left = client.node_leave(owner)
+        assert left["ok"] and left["left"], left
+        assert left["sessions_migrated"] == 1
+        assert left["nodes"] == 2
+        assert router._sessions[sid].node is None
+        for i, r in enumerate(rows[half:]):
+            out = client.session_append(sid, [r], seq=half + i)
+            assert out["ok"], out
+        fin = client.session_close(sid)
+        assert fin["ok"] and fin["verdict"] == "LINEARIZABLE"
+        assert fin["ops"] == len(rows)
+        assert router.session_migrations == 1
+        assert router.stats()["session"]["migrated"] == 1
+    finally:
+        if client is not None:
+            client.close()
+        _teardown(router, nodes)
+        extra.stop()
+
+
+def test_session_ladder_takes_over_when_fleet_exhausted(tmp_path):
+    """ISSUE 18 satellite: with every node down, the session verbs no
+    longer SHED — the router's own in-process SessionManager is the
+    last rung (exactly the check path's host ladder), the verdict
+    stays exact, and a flip still pushes (unminimized, honestly
+    marked)."""
+    router, nodes = _fleet(tmp_path, n_nodes=1)
+    client = None
+    try:
+        nodes[0].stop()          # the whole fleet is now unreachable
+        client = CheckClient(router.address, timeout_s=10.0)
+        opened = client.session_open("register")
+        assert opened["ok"] and opened.get("ladder"), opened
+        sid = opened["session"]
+        out = client.session_append(
+            sid, [[0, 1, 1, 0, 0, 1], [1, 1, 2, 2, 2, 3]], seq=0)
+        assert out["ok"] and out.get("ladder"), out
+        assert out["applied"] == 2
+        # a violation decides on the in-router rung too: read 7 was
+        # never written
+        out = client.session_append(sid, [[2, 0, 0, 7, 4, 5]], seq=2)
+        assert out["ok"] and out["verdict"] == "VIOLATION"
+        flip = out.get("flip")
+        assert flip and not flip["complete"]      # honest: unminimized
+        assert flip["repro"], flip
+        fin = client.session_close(sid)
+        assert fin["ok"] and fin["verdict"] == "VIOLATION"
+        assert fin.get("ladder") and fin["flipped"]
+        assert router.session_ladder >= 3
+        assert router.stats()["session"]["ladder"] >= 3
+    finally:
+        if client is not None:
+            client.close()
+        router.stop()

@@ -15,7 +15,8 @@ import time
 import pytest
 
 from qsm_tpu.fleet.gossip import GossipAgent
-from qsm_tpu.fleet.lease import Lease
+from qsm_tpu.fleet.lease import (FileLeaseStore, Lease, TCP_SCHEME,
+                                 TcpLeaseStore)
 from qsm_tpu.fleet.replog import SegmentedLog
 from qsm_tpu.fleet.router import FleetRouter
 from qsm_tpu.models import AtomicCasSUT, CasSpec, RacyCasSUT
@@ -70,10 +71,28 @@ def _router(nodes, node_id="router", lease_path=None, **kw):
 
 # --- the lease itself ------------------------------------------------------
 
-def test_lease_terms_are_monotonic_and_one_way(tmp_path):
+@pytest.fixture(params=["file", "tcp"])
+def lease_store(request, tmp_path):
+    """BOTH lease stores (ISSUE 18): the raw record path (file) and a
+    lease-hosting node's ``tcp://`` address whose own FileLeaseStore
+    backs the SAME record file.  Every term/expiry pin must hold
+    identically over both — the TCP store is a transport, never a
+    different arbitration."""
     path = str(tmp_path / "lease.json")
-    a = Lease(path, holder="rA", ttl_s=0.3)
-    b = Lease(path, holder="rB", ttl_s=0.3)
+    if request.param == "file":
+        yield path, path
+    else:
+        host = CheckServer(lease_path=path).start()
+        try:
+            yield TCP_SCHEME + host.address, path
+        finally:
+            host.stop()
+
+
+def test_lease_terms_are_monotonic_and_one_way(lease_store):
+    target, path = lease_store
+    a = Lease(target, holder="rA", ttl_s=0.3)
+    b = Lease(target, holder="rB", ttl_s=0.3)
     rec = a.acquire()
     assert rec["term"] == 1 and rec["holder"] == "rA"
     assert b.acquire() is None          # live foreign term: refused
@@ -89,7 +108,8 @@ def test_lease_terms_are_monotonic_and_one_way(tmp_path):
     assert a.renew(1) is None
     time.sleep(0.35)
     assert a.acquire()["term"] == 3     # ...which it can, after expiry
-    # a garbled record reads as expired, never crashes
+    # a garbled record reads as expired, never crashes (written to the
+    # BACKING file — over TCP that is the lease host's own record)
     with open(path, "w") as f:
         f.write("{torn")
     assert Lease.expired(b.read())
@@ -114,16 +134,71 @@ def test_lease_lock_contention_loses_the_beat_never_blocks(tmp_path):
     assert a.acquire()["term"] == 1
 
 
+def test_tcp_lease_store_transport_loss_is_a_lost_beat():
+    """A TcpLeaseStore whose host is unreachable loses every beat —
+    None from each transaction, never an exception, never a block
+    (the exact contract a lost flock beat has)."""
+    dead = Lease("tcp://127.0.0.1:1", holder="rA", ttl_s=0.3)
+    assert isinstance(dead.store, TcpLeaseStore)
+    assert dead.acquire() is None
+    assert dead.renew(1) is None
+    assert dead.read() is None
+    dead.release()                  # a no-op, not a crash
+    assert dead.path == "tcp://127.0.0.1:1"
+
+
+def test_make_store_dispatches_on_scheme(tmp_path):
+    assert isinstance(Lease(str(tmp_path / "l.json"), holder="x").store,
+                      FileLeaseStore)
+    assert isinstance(Lease("tcp://h:1", holder="x").store,
+                      TcpLeaseStore)
+    # a pre-built store passes through (routers handed a shared store)
+    st = FileLeaseStore(str(tmp_path / "l2.json"))
+    assert Lease(st, holder="x").store is st
+
+
+def test_lease_fault_site_demotes_never_serves_stale(tmp_path,
+                                                     monkeypatch):
+    """The ``lease`` fault site (satellite of ISSUE 18): an injected
+    failure at renew is a LOST BEAT — the active demotes (one-way per
+    term) instead of serving under a term it cannot prove live, the
+    loss is counted, and the beat thread survives."""
+    nodes = _nodes(tmp_path, n=1)
+    lease = str(tmp_path / "lease.json")
+    ra = _router(nodes, node_id="rA", lease_path=lease)
+    try:
+        assert ra.ha_role == "active" and ra._active_now()
+        monkeypatch.setenv("QSM_TPU_FAULTS", "raise:lease")
+        ra.ha_beat()
+        assert ra.ha_role == "superseded"
+        assert not ra._active_now()
+        assert ra.lease_faults >= 1
+        assert ra.stats()["lease"]["lease_faults"] >= 1
+        from qsm_tpu.resilience.faults import fired_snapshot
+
+        assert fired_snapshot().get("lease", 0) >= 1
+        monkeypatch.delenv("QSM_TPU_FAULTS")
+        # re-entry only by WINNING a later term (the record expires,
+        # the gated path takes term 2)
+        time.sleep(TTL + TTL * 0.5 + 0.1)
+        ra.ha_beat()
+        assert ra.ha_role == "active" and ra.term == 2
+    finally:
+        ra.stop()
+        for s in nodes:
+            s.stop()
+
+
 # --- split brain -----------------------------------------------------------
 
 def test_split_brain_exactly_one_router_serves(tmp_path, corpus,
-                                               expected):
-    """THE split-brain pin: two routers, one lease.  After a takeover
-    the stale-term router answers SHED with a ``router_superseded``
-    block — never a verdict — while the new active serves under the
-    bumped term."""
+                                               expected, lease_store):
+    """THE split-brain pin: two routers, one lease — over BOTH stores.
+    After a takeover the stale-term router answers SHED with a
+    ``router_superseded`` block — never a verdict — while the new
+    active serves under the bumped term."""
     nodes = _nodes(tmp_path, n=2)
-    lease = str(tmp_path / "lease.json")
+    lease, _path = lease_store
     ra = _router(nodes, node_id="rA", lease_path=lease)
     rb = _router(nodes, node_id="rB", lease_path=lease)
     try:
@@ -221,13 +296,15 @@ def test_takeover_emits_span_and_flight_dump(tmp_path, corpus):
             s.stop()
 
 
-def test_clean_shutdown_hands_the_term_over_immediately(tmp_path):
-    """stop() on the active releases the lease as an expired TOMBSTONE:
-    the standby's next beat promotes without waiting out the TTL, and
-    the term still advances (monotonic across clean handovers — the
-    same term must never come from two brains)."""
+def test_clean_shutdown_hands_the_term_over_immediately(tmp_path,
+                                                        lease_store):
+    """stop() on the active releases the lease as an expired TOMBSTONE
+    (over BOTH stores): the standby's next beat promotes without
+    waiting out the TTL, and the term still advances (monotonic across
+    clean handovers — the same term must never come from two
+    brains)."""
     nodes = _nodes(tmp_path, n=1)
-    lease = str(tmp_path / "lease.json")
+    lease, _path = lease_store
     ra = _router(nodes, node_id="rA", lease_path=lease)
     rb = _router(nodes, node_id="rB", lease_path=lease)
     try:

@@ -78,15 +78,15 @@ def test_in_tree_corpus_is_clean(report):
     assert "obs" in report.passes
     # the fleet re-dispatch + lease family (j): router/membership/
     # replog + the r13 lease/gossip modules + the soak bench
-    assert len(DEFAULT_FLEET_FILES) == 6
+    assert len(DEFAULT_FLEET_FILES) == 8
     assert "fleet" in report.passes
     # the monitor-session bounds family (k): monitor/ + ingest/ + the
     # monitor bench driver (ISSUE 14)
-    assert len(DEFAULT_MONITOR_FILES) == 7
+    assert len(DEFAULT_MONITOR_FILES) == 8
     assert "monitor" in report.passes
     # the wire-contract family (l): the socket-protocol planes plus the
     # committed PROTOCOL.json artifact (ISSUE 16)
-    assert len(DEFAULT_PROTOCOL_FILES) == 12
+    assert len(DEFAULT_PROTOCOL_FILES) == 13
     assert "protocol" in report.passes
     # the generation-campaign bounds family (m): gen/ + the gen bench
     # driver (ISSUE 17)
@@ -261,7 +261,8 @@ def test_fleet_redispatch_is_caught():
     # BoundedRedispatchRouterStub (tried.add + exclude=) stays clean
     assert "no bounded attempt budget" in hits[0].message
     assert "never excludes the failed node" in hits[1].message
-    by_rule.pop("QSM-FLEET-LEASE")  # pinned by its own bulb test
+    by_rule.pop("QSM-FLEET-LEASE")    # pinned by its own bulb test
+    by_rule.pop("QSM-FLEET-HANDOFF")  # pinned by its own bulb test
     assert not by_rule  # nothing else fires on the fixture module
 
 
@@ -284,6 +285,28 @@ def test_fleet_lease_is_caught():
     # the sanctioned LeasedTakeoverRouterStub stays clean
     assert not any("LeasedTakeoverRouterStub" in f.location
                    or "beat" in f.location for f in findings)
+
+
+def test_fleet_handoff_is_caught():
+    """The handoff pass's bulb check (family j, ISSUE 18): the join
+    that never seeds the newcomer's replog and the leave that never
+    migrates the retiree's routed sessions each fire
+    QSM-FLEET-HANDOFF exactly once; the sweep-on-join +
+    invalidate-on-leave twin must NOT be flagged."""
+    from qsm_tpu.analysis.fleet_passes import check_fleet_file
+
+    findings = [f for f in check_fleet_file(fixtures.__file__)
+                if f.rule_id == "QSM-FLEET-HANDOFF"]
+    assert len(findings) == 2
+    assert {f.severity for f in findings} == {ERROR}
+    assert "join_cold" in findings[0].location
+    assert "without replog handoff" in findings[0].message
+    assert "leave_sticky" in findings[1].location
+    assert "without session migration" in findings[1].message
+    # the sanctioned RebalancingRouterStub stays clean
+    assert not any("RebalancingRouterStub" in f.location
+                   or ":join:" in f.location or ":leave:" in f.location
+                   for f in findings)
 
 
 def test_fleet_live_tree_is_clean():
@@ -508,12 +531,12 @@ def test_lint_report_carries_protocol_summary(report):
     """``qsm-tpu lint --json`` exposes the contract trend block —
     bench_report.py rows key off these counts."""
     assert report.protocol is not None
-    assert report.protocol["ops"] == 17
+    assert report.protocol["ops"] == 23
     assert report.protocol["handled_ops"] == report.protocol["ops"]
     assert report.protocol["called_ops"] == report.protocol["ops"]
     # shutdown is the one deliberately non-idempotent op, and it must
     # never appear on a retrying path
-    assert report.protocol["idempotent_ops"] == 16
+    assert report.protocol["idempotent_ops"] == 22
     assert "shutdown" not in report.protocol["retried_ops"]
 
 

@@ -38,7 +38,8 @@ _ADDITIVE = ("lockstep_iters", "nodes_explored", "memo_prunes",
              "memo_inserts", "compactions", "chunk_rounds", "rescued",
              "deferred", "tail_histories", "segments_split",
              "segments_total", "degradations", "retries",
-             "worker_faults", "node_faults", "pcomp_split", "pcomp_subs",
+             "worker_faults", "node_faults", "lease_faults",
+             "pcomp_split", "pcomp_subs",
              "pcomp_recombine_ms", "shrink_rounds", "shrink_lanes",
              "shrink_memo_hits", "obs_events", "session_events",
              "frontier_advances", "flips_pushed", "prefix_hits",
@@ -166,8 +167,8 @@ def test_to_compact_full_key_set_and_values():
     c = st.to_compact()
     assert sorted(c) == sorted(
         ("iph", "nph", "prunes", "rescued", "segs", "ord", "plan",
-         "deg", "fb", "wf", "ndf", "pcs", "pcn", "pcm", "shr", "shl",
-         "shm", "sho", "obe", "sev", "fad", "flp", "pfh",
+         "deg", "fb", "wf", "ndf", "lsf", "pcs", "pcn", "pcm", "shr",
+         "shl", "shm", "sho", "obe", "sev", "fad", "flp", "pfh",
          "gsq", "gmu", "gfl", "gfr"))
     assert c["gsq"] == st.gen_seqs
     assert c["gmu"] == st.gen_mutations
@@ -182,6 +183,7 @@ def test_to_compact_full_key_set_and_values():
     assert c["pfh"] == st.prefix_hits
     assert c["wf"] == st.worker_faults
     assert c["ndf"] == st.node_faults
+    assert c["lsf"] == st.lease_faults
     assert c["iph"] == round(st.lockstep_iters / st.histories, 1)
     assert c["nph"] == round(st.nodes_explored / st.histories, 1)
 

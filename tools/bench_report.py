@@ -51,6 +51,10 @@ _HEADLINE_KEYS = (
     # the GEN artifact's steering trend: best steered/unsteered flip
     # ratio and how many families cleared the ≥3× gate
     "max_flip_ratio", "families_passing",
+    # the SESSIONS artifact's durability trend: how many session
+    # resumes the chaos schedule forced, how many rode banked decided
+    # prefixes, and the standby-takeover latency
+    "resume_restored_total", "prefix_hits_total", "router_takeover_s",
     "value", "p50_ms", "p99_ms",
     # the LINT artifact's wire-contract trend (flattened from its
     # nested ``protocol`` block): op vocabulary size, handler/caller

@@ -80,7 +80,7 @@ CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 # interprocedural race analyzer), independent of the window artifacts'
 # ROUND_TAG — renaming those retires banked measurements, renaming this
 # just says which rule set produced the findings.
-LINT_ROUND = "r17"  # family (m): generation-campaign bounds — r17
+LINT_ROUND = "r18"  # family (j): fleet handoff discipline — r18
 LINT_ARTIFACT = os.path.join(REPO, f"LINT_{LINT_ROUND}.json")
 
 # Committed archive of the P-compositionality bench (tools/
@@ -157,6 +157,21 @@ GEN_ARTIFACT = os.path.join(REPO, f"BENCH_GEN_{GEN_ROUND}.json")
 # soak_fleet + summary
 GEN_MIN_ROWS = 9
 _GEN_STATE: dict = {"attempted": False}
+
+# Committed archive of the durable-session chaos soak (tools/
+# soak_sessions.py): HOST-ONLY like the other off-window gates —
+# ≥1000 concurrent monitor sessions held open through a rolling node
+# restart, an active-router SIGKILL with standby takeover off the
+# shared lease + session-journal stores, and one node leave + one
+# node join with handoff — refreshed off-window on CellJournal
+# --resume rails.  Tracks its own round tag (the durable-session
+# plane landed in r18).
+SESSIONS_ROUND = "r18"
+SESSIONS_ARTIFACT = os.path.join(REPO,
+                                 f"BENCH_SESSIONS_{SESSIONS_ROUND}.json")
+# full scan = soak + summary
+SESSIONS_MIN_ROWS = 2
+_SESSIONS_STATE: dict = {"attempted": False}
 
 # Cached verdict of the pre-seize lint gate, keyed on a SOURCE
 # fingerprint — not process lifetime: the watcher runs all round while
@@ -377,6 +392,16 @@ def _maybe_archive_gen(timeout: float = 900.0) -> None:
     host-only gates."""
     _maybe_archive(_GEN_STATE, GEN_ARTIFACT, "bench_gen.py",
                    GEN_MIN_ROWS, "gen_bench", timeout)
+
+
+def _maybe_archive_sessions(timeout: float = 1500.0) -> None:
+    """The durable-session soak artifact (tools/soak_sessions.py):
+    the chaos-schedule survival verdict (zero wrong verdicts, zero
+    lost flips, every resume off banked decided prefixes) archived
+    beside the other host-only gates."""
+    _maybe_archive(_SESSIONS_STATE, SESSIONS_ARTIFACT,
+                   "soak_sessions.py", SESSIONS_MIN_ROWS,
+                   "sessions_soak", timeout)
 
 
 def _run_window_bench(bench_timeout: float, extra_args, label: str,
@@ -764,6 +789,7 @@ def main() -> int:
         _maybe_archive_fleet()
         _maybe_archive_monitor()
         _maybe_archive_gen()
+        _maybe_archive_sessions()
     while True:
         t0 = time.time()
         _maybe_compact_probe_log()  # bounded; no-op below the threshold

@@ -1,0 +1,115 @@
+"""Durable-session chaos soak — the ISSUE 18 acceptance artifact.
+
+Runs the ``qsm-tpu soak`` rig (qsm_tpu/gen/soak.py) at gate scale —
+≥1000 concurrent monitor sessions held open through (a) a rolling
+SIGKILL restart of all three nodes, (b) a SIGKILL of the active router
+with standby takeover off the shared lease + session-journal stores,
+and (c) one node leave + one node join with replog handoff — plus a
+PR 17 closed-loop fuzz pass against the surviving router, every flip
+and close verdict re-proved by a fresh memo oracle.
+
+Output: a resumable ``CellJournal`` (``--resume`` re-runs zero
+completed cells) banked as BENCH_SESSIONS_<tag>.json; `make
+soak-sessions` commits it and tools/bench_report.py folds it into
+BENCH_REPORT.md.
+
+    python tools/soak_sessions.py [--tag r18] [--sessions 1000]
+        [--resume]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run(tag: str, out_path, sessions: int, workers: int,
+        resume: bool) -> int:
+    from qsm_tpu.gen.soak import soak_sessions
+    from qsm_tpu.resilience.checkpoint import CellJournal
+
+    path = out_path or os.path.join(REPO, f"BENCH_SESSIONS_{tag}.json")
+    header = {
+        "artifact": "BENCH_SESSIONS",
+        "device_fallback": None,   # host-side by design: process
+        # churn + durable restores, measured where they are honest
+        "platform": "cpu",
+        "schedule": "rolling node restart x3 + active-router SIGKILL "
+                    "+ node leave/join + closed-loop fuzz",
+        "sessions": sessions,
+        "host_cores": os.cpu_count(),
+        "captured_iso": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+    }
+    journal = CellJournal(path, header, resume=resume)
+    if journal.complete("soak") is None:
+        journal.emit("soak", soak_sessions(
+            sessions=sessions, workers=workers,
+            log=lambda m: print(m, file=sys.stderr)))
+    rep = journal.complete("soak")
+    summary = {
+        "metric": "durable_session_chaos_soak",
+        "sessions": rep["sessions"],
+        "ops_per_session": rep["ops_per_session"],
+        "truth_violations": rep["truth_violations"],
+        "wrong_verdicts": rep["wrong_verdicts"],
+        "wrong_verdicts_total": (rep["wrong_verdicts"]
+                                 + rep["fuzz"]["wrong_verdicts_total"]),
+        "flips_total": rep["flips_total"],
+        "lost_flips": rep["lost_flips"],
+        "unproved_flips": rep["unproved_flips"],
+        "rolling_restart_s": rep["rolling_restart_s"],
+        "rolling_restart_zero_lost": bool(
+            rep["wrong_verdicts"] == 0 and rep["lost_flips"] == 0),
+        "router_takeover": rep["router_takeover"],
+        "router_takeover_s": rep["router_takeover_s"],
+        "node_leave": rep["node_leave"],
+        "node_join": rep["node_join"],
+        "resume_restored_total": rep["resume_restored_total"],
+        "prefix_hits_total": rep["prefix_hits_total"],
+        "health_status": rep["health_status"],
+        "health_exit_code": rep["exit_code"],
+        "elapsed_s": rep["elapsed_s"],
+        "gate_ok": rep["gate_ok"],
+        "resumed_cells": journal.resumed_cells,
+        "artifact": os.path.basename(path),
+    }
+    if journal.complete("summary") is None:
+        journal.emit("summary", summary)
+    print(json.dumps(summary))
+    return 0 if summary["gate_ok"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tag", default="r18")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--sessions", type=int, default=1000,
+                    help="concurrent sessions (the gate floor)")
+    ap.add_argument("--workers", type=int, default=8,
+                    help="client threads driving the session verbs")
+    ap.add_argument("--resume", action="store_true",
+                    help="adopt completed cells from a prior journal "
+                         "at the output path (resilience/checkpoint)")
+    args = ap.parse_args(argv)
+
+    from qsm_tpu.utils.device import force_cpu_platform
+
+    force_cpu_platform()
+    try:
+        return run(args.tag, args.out, args.sessions, args.workers,
+                   args.resume)
+    except Exception as e:  # noqa: BLE001 — diagnostic line, not a traceback
+        print(json.dumps({"metric": "durable_session_chaos_soak",
+                          "error": f"{type(e).__name__}: {e}"}))
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
