@@ -1,8 +1,8 @@
 # qsm_tpu CI/tooling entry points.
 #
 # `lint-gate` is the static-analysis gate: it runs every registered
-# qsmlint pass family (a–m, docs/ANALYSIS.md) over the full tree,
-# archives the JSON findings document to LINT_r17.json (the artifact
+# qsmlint pass family (a–n, docs/ANALYSIS.md) over the full tree,
+# archives the JSON findings document to LINT_r19.json (the artifact
 # probe_watcher also refreshes before every window seize) and FAILS
 # (exit 1) on any non-whitelisted error-severity finding — including
 # QSM-PROTO-DRIFT when the committed PROTOCOL.json no longer matches a
@@ -13,7 +13,7 @@
 PYTHON ?= python
 # keep in lockstep with tools/probe_watcher.py LINT_ROUND (the watcher
 # archives the same document before every window seize)
-LINT_ARTIFACT ?= LINT_r18.json
+LINT_ARTIFACT ?= LINT_r19.json
 
 # P-compositionality bench (tools/bench_pcomp.py): host-only — no TPU
 # window needed — on CellJournal --resume rails; refreshes the
@@ -70,9 +70,19 @@ GEN_ARTIFACT ?= BENCH_GEN_r17.json
 # prefixes; docs/MONITOR.md "Durability")
 SESSIONS_ARTIFACT ?= BENCH_SESSIONS_r18.json
 
+# Mesh-dispatch bench (tools/bench_mesh.py): host-only — forced
+# virtual CPU devices (--xla_force_host_platform_device_count) stand
+# in for the lane axis — on CellJournal --resume rails; refreshes the
+# committed BENCH_MESH artifact (lanes/sec at mesh widths 1/2/4/8 on
+# the four model families with kv pcomp-split, bit-identical
+# verdict/witness/shrink/monitor parity across every width vs a fresh
+# CPU oracle, and the 3-vs-1-node fleet cell re-run under 8 forced
+# devices to DECIDE the previously waived ratio_n3_vs_n1 gate)
+MESH_ARTIFACT ?= BENCH_MESH_r19.json
+
 .PHONY: lint-gate lint-changed lint-sarif protocol test bench-pcomp \
 	bench-shrink bench-obs bench-fleet bench-monitor bench-gen \
-	soak-sessions bench-report
+	soak-sessions bench-mesh bench-report
 
 lint-gate:
 	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT)
@@ -118,6 +128,13 @@ bench-gen:
 soak-sessions:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_sessions.py \
 		--out $(SESSIONS_ARTIFACT) --resume
+
+# NOTE: no JAX_PLATFORMS pin here — the bench spawns its own children
+# under forced_host_device_env (which sets the platform AND the
+# forced device count per child)
+bench-mesh:
+	$(PYTHON) tools/bench_mesh.py \
+		--out $(MESH_ARTIFACT) --resume
 
 # Aggregate every committed BENCH_*.json into one per-round trend
 # table (BENCH_REPORT.md + BENCH_REPORT.json, atomic + deterministic)

@@ -12,7 +12,11 @@ Layers (mirroring SURVEY.md §1, redesigned TPU-first):
   ``JaxTPU`` branch-and-bound kernel (reference L5)
 * ``qsm_tpu.models``   — the five milestone specs + correct/racy SUT pairs
   (reference L7)
-* ``qsm_tpu.parallel`` — mesh/sharding for batch-parallel checking at scale
+* ``qsm_tpu.mesh``     — the mesh-sharded dispatch substrate: ONE
+  NamedSharding lane axis under every check plane (plain batches, pcomp
+  sub-lanes, shrink frontiers, monitor re-checks, serve fan-out), with
+  mesh-divisible compile buckets and bit-identical verdicts at any mesh
+  shape (docs/MESH.md; ``qsm_tpu.parallel`` is its deprecated re-export)
 * ``qsm_tpu.analysis`` — ``qsmlint``: static spec/kernel/determinism
   analysis that catches window-burning defects before any TPU window
   opens (docs/ANALYSIS.md)

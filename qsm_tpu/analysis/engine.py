@@ -187,6 +187,17 @@ DEFAULT_GEN_FILES = (
     "qsm_tpu/gen/steer.py", "qsm_tpu/gen/fleet.py",
     "tools/bench_gen.py")
 
+# the mesh-dispatch scan set (family n): the substrate itself, the
+# consumers that thread a sharding through compile buckets and flush
+# targets, and the mesh bench driver (ISSUE 19).  topology.py is IN
+# the set on purpose: the one place device enumeration may live must
+# itself scan clean (parameters only, no literal counts).
+DEFAULT_MESH_FILES = (
+    "qsm_tpu/mesh/topology.py", "qsm_tpu/mesh/dispatch.py",
+    "qsm_tpu/ops/jax_kernel.py", "qsm_tpu/search/planner.py",
+    "qsm_tpu/serve/batcher.py",
+    "tools/bench_mesh.py")
+
 # the wire-contract scan set (family l): the contract source, every
 # module that dispatches or sends protocol ops, the helpers whose
 # return docs become responses, and the CLI consumer paths.  The
@@ -396,6 +407,12 @@ def _per_file_gen(path: str, root: str) -> List[Finding]:
     return check_gen_file(path, root=root)
 
 
+def _per_file_mesh(path: str, root: str) -> List[Finding]:
+    from .mesh_passes import check_mesh_file
+
+    return check_mesh_file(path, root=root)
+
+
 def _run_protocol(ctx: _LintRun, files: List[str]) -> List[Finding]:
     # one extraction serves both the conformance passes and the
     # report's ``protocol`` summary block (bench_report trends it);
@@ -505,6 +522,12 @@ FAMILIES: Dict[str, Family] = {f.fid: f for f in (
            triggers=("qsm_tpu/analysis/gen_passes.py",
                      # family m's scan shares family k's class scan
                      "qsm_tpu/analysis/monitor_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+    Family(fid="n", key="mesh",
+           title="mesh-dispatch discipline (no hardcoded device "
+                 "counts, no host transfer inside sharded dispatch)",
+           files=DEFAULT_MESH_FILES, per_file=_per_file_mesh,
+           triggers=("qsm_tpu/analysis/mesh_passes.py",
                      "qsm_tpu/analysis/astutil.py")),
 )}
 

@@ -1000,3 +1000,85 @@ class IdempotentRetryClientStub:
             except OSError:
                 continue
         return None
+
+
+# --- family (n): mesh-dispatch fixtures ----------------------------------
+#
+# Never executed (the imports inside the method bodies never run);
+# tests point the mesh AST pass at this file and assert each rule
+# fires on its seeded stub and stays silent on the sanctioned twin.
+
+
+class HardcodedMeshStub:
+    """Seeded bugs for QSM-MESH-HARDCODE: a topology slot pinned by
+    indexing the device enumeration, and a literal device count baked
+    into a mesh constructor — the two shapes that make a sharded
+    program run only on the box it was written on."""
+
+    def pin_first_device(self):
+        # watchdogged (family d's sanctioned probe form) so exactly
+        # ONE family owns this bug: the INDEXING is the mesh defect
+        import jax
+
+        from ..resilience.policy import watchdog
+
+        return watchdog(lambda: jax.devices()[0],  # <-- bug: slot pin
+                        5.0, label="fixture")
+
+    def build_fixed_mesh(self):
+        from ..mesh import make_mesh
+
+        return make_mesh(8)              # <-- bug: literal device count
+
+
+class ShapePolymorphicMeshStub:
+    """The sanctioned twin: the lane-axis width is threaded as a
+    parameter and device enumeration is only ever *counted*, never
+    indexed — must stay CLEAN under QSM-MESH-HARDCODE."""
+
+    def __init__(self, n_devices):
+        self.n_devices = n_devices
+
+    def build_mesh(self):
+        from ..mesh import make_mesh
+
+        return make_mesh(self.n_devices)     # threaded: sanctioned
+
+    def lane_width(self):
+        import jax
+
+        from ..resilience.policy import watchdog
+
+        return watchdog(lambda: len(jax.devices()),  # counted, not
+                        5.0, label="fixture")        # indexed: clean
+
+
+class TransferringDispatchStub:
+    """Seeded bug for QSM-MESH-TRANSFER: the same function applies the
+    lane sharding AND pulls the result back to host — the dispatch
+    path funnels the whole lane axis through one device's memory while
+    reporting an N-device mesh."""
+
+    def shard_then_pull(self, arrays, sharding):
+        import jax
+        import numpy as np
+
+        shards = [jax.device_put(a, sharding) for a in arrays]
+        return [np.asarray(s) for s in shards]   # <-- bug: host pull
+
+
+class DeviceResidentDispatchStub:
+    """The sanctioned twin: sharding application and host readback
+    live in DIFFERENT functions (the jax_kernel.py ``_shard_carry`` /
+    ``_compact_carry_host`` split) — must stay CLEAN under
+    QSM-MESH-TRANSFER."""
+
+    def shard(self, arrays, sharding):
+        import jax
+
+        return [jax.device_put(a, sharding) for a in arrays]
+
+    def pull(self, shards):
+        import numpy as np
+
+        return [np.asarray(s) for s in shards]

@@ -1,0 +1,189 @@
+"""Mesh-dispatch passes (pass family *n* of docs/ANALYSIS.md): the
+sharded-substrate discipline.
+
+The mesh substrate (qsm_tpu/mesh/) has ONE contract: every consumer
+rides a lane axis whose width is a *parameter* — the same program must
+run on 1, 2 or 8 devices with bit-identical verdicts (docs/MESH.md).
+Two source-level defect classes break that contract silently:
+
+* ``QSM-MESH-HARDCODE`` (error) — a literal device count baked into a
+  mesh constructor (``make_mesh(8)``, ``make_mesh_2d(2, 4)``,
+  ``Mesh(...)`` with an int-literal positional arg) or direct
+  device-array indexing (``jax.devices()[0]``,
+  ``jax.local_devices()[i]``).  Either pins the program to one
+  topology: the code "works" on the box it was written on and
+  misshards — or crashes — on every other mesh.  Device counts must
+  be threaded (``make_mesh(n)`` / ``mesh_device_count(...)``); the
+  mesh helpers themselves (qsm_tpu/mesh/topology.py) build meshes
+  from parameters only and scan clean under this rule — that is the
+  point: topology.py is the ONE place device enumeration lives.
+
+* ``QSM-MESH-TRANSFER`` (error) — a host transfer (``np.asarray`` /
+  ``np.array`` / ``jax.device_get`` / ``.item()``) in the SAME
+  function that applies a sharding (a ``device_put`` call or a
+  ``NamedSharding`` construction).  Pulling a freshly sharded value
+  back to host serializes the whole lane axis through one device's
+  memory — the dispatch path keeps single-device wall-clock while
+  reporting an N-device mesh.  Scope is the function: the sanctioned
+  shape keeps sharding application and host readback in different
+  functions (jax_kernel.py ``_shard_carry`` vs ``_compact_carry_host``
+  — the compaction path gathers on host FIRST, then re-applies the
+  sharding through the dedicated helper).
+
+Both rules are deliberately structural (AST only, no imports executed):
+the parity *behaviour* is gated by tests/test_mesh.py and the bench
+parity cell; this family catches the shape of the regression before a
+multi-chip window ever opens.
+
+Scan set: qsm_tpu/mesh/ + the sharded consumers (ops/jax_kernel.py,
+search/planner.py, serve/batcher.py) + tools/bench_mesh.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, List, Optional, Tuple
+
+from .astutil import attr_chain, call_name, parse_module
+from .findings import ERROR, Finding
+
+# constructors whose positional device-count arguments must be
+# threaded, never literal
+_MESH_CTORS = {"make_mesh", "make_mesh_2d", "Mesh"}
+
+# callables returning the process's device array; subscripting their
+# result pins a topology index
+_DEVICE_ENUMS = {("jax", "devices"), ("jax", "local_devices")}
+
+# host-transfer call shapes (family b's kernel pass hunts these inside
+# traced bodies; here the scope is any sharding-applying function)
+_NP_PULLS = {"asarray", "array"}
+_NP_MODULES = {"np", "numpy"}
+
+
+def _functions(tree: ast.Module) -> Iterator[Tuple[str, ast.AST]]:
+    """(qualified name, node) for every function, including methods —
+    ``Cls.fn`` for methods, ``fn`` at module level, ``outer.inner``
+    for nested defs."""
+    def walk(node: ast.AST, prefix: str) -> Iterator[Tuple[str, ast.AST]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = f"{prefix}{child.name}"
+                yield name, child
+                yield from walk(child, f"{name}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+    yield from walk(tree, "")
+
+
+def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of ``fn``'s own body, nested function defs excluded — the
+    transfer rule is function-scoped, and a nested def is its own
+    scope (it is reported under its own qualified name)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_device_enum_subscript(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Call)
+            and tuple(attr_chain(node.value.func)) in _DEVICE_ENUMS)
+
+
+def _literal_mesh_ctor_arg(node: ast.AST) -> Optional[int]:
+    """The first int-literal positional arg of a mesh constructor call,
+    or None when the call is clean/not a mesh ctor."""
+    if not (isinstance(node, ast.Call)
+            and call_name(node) in _MESH_CTORS):
+        return None
+    for arg in node.args:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, int) \
+                and not isinstance(arg.value, bool):
+            return arg.value
+    return None
+
+
+def _is_sharding_apply(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and call_name(node) in ("device_put", "NamedSharding"))
+
+
+def _host_pull(node: ast.AST) -> Optional[str]:
+    """The spelled name of a host-transfer call, or None."""
+    if not isinstance(node, ast.Call):
+        return None
+    chain = attr_chain(node.func)
+    if len(chain) >= 2 and chain[0] in _NP_MODULES \
+            and chain[-1] in _NP_PULLS:
+        return ".".join(chain)
+    if chain and chain[-1] == "device_get":
+        return ".".join(chain)
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+            and not node.args:
+        return ".item()"
+    return None
+
+
+def check_mesh_file(path: str, root: Optional[str] = None
+                    ) -> List[Finding]:
+    tree = parse_module(path)
+    relpath = path
+    if root:
+        try:
+            relpath = os.path.relpath(path, root)
+        except ValueError:
+            pass
+    out: List[Finding] = []
+    for fn_name, fn in _functions(tree):
+        applies: List[Tuple[int, str]] = []
+        pulls: List[Tuple[int, str]] = []
+        for node in _own_nodes(fn):
+            if _is_device_enum_subscript(node):
+                out.append(Finding(
+                    ERROR, "QSM-MESH-HARDCODE",
+                    f"{relpath}:{fn_name}:{node.lineno}",
+                    "indexes the device enumeration directly "
+                    "(jax.devices()[i]) — pins a topology slot, so the "
+                    "same program misshards on any other mesh shape",
+                    "build placements through qsm_tpu.mesh helpers "
+                    "(make_mesh / batch_sharding) and let NamedSharding "
+                    "place the lanes"))
+            n = _literal_mesh_ctor_arg(node)
+            if n is not None:
+                out.append(Finding(
+                    ERROR, "QSM-MESH-HARDCODE",
+                    f"{relpath}:{fn_name}:{node.lineno}",
+                    f"hardcodes a device count ({n}) into a mesh "
+                    "constructor — the lane axis width must be a "
+                    "parameter or the program only runs on one "
+                    "topology",
+                    "thread the count (make_mesh(n_devices)) or derive "
+                    "it (mesh_device_count())"))
+            if _is_sharding_apply(node):
+                applies.append((node.lineno, call_name(node)))
+            pull = _host_pull(node)
+            if pull is not None:
+                pulls.append((node.lineno, pull))
+        if applies and pulls:
+            a_line, a_name = applies[0]
+            p_line, p_name = pulls[0]
+            out.append(Finding(
+                ERROR, "QSM-MESH-TRANSFER",
+                f"{relpath}:{fn_name}:{p_line}",
+                f"host transfer ({p_name}, line {p_line}) in the same "
+                f"function that applies a sharding ({a_name}, line "
+                f"{a_line}) — the sharded dispatch path funnels the "
+                "whole lane axis through one device's host memory",
+                "split the function: apply shardings in one helper "
+                "(the _shard_carry shape), gather on host in another "
+                "(_compact_carry_host gathers FIRST, then re-applies "
+                "through the helper)"))
+    return out

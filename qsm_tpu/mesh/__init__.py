@@ -1,0 +1,30 @@
+"""Mesh-sharded dispatch substrate: ONE NamedSharding lane axis under every
+check plane (plain batches, pcomp sub-lanes, shrink frontiers, monitor
+re-checks, serve fan-out).  Topology (construction + the sharding contract)
+in :mod:`.topology`; dispatch policy (divisible bucket ladders, the one-call
+:func:`sharded_backend`) in :mod:`.dispatch`.  docs/MESH.md is the prose
+contract; ``qsm_tpu.parallel`` is the deprecated former home.
+"""
+
+from .dispatch import (backend_sharding, mesh_bucket_ladder,
+                       mesh_slots_table, sharded_backend)
+from .topology import (LANE_AXIS, batch_sharding, init_distributed,
+                       lane_sharding_of, make_mesh, make_mesh_2d,
+                       mesh_device_count, mesh_shape_key,
+                       replicated_sharding)
+
+__all__ = [
+    "LANE_AXIS",
+    "backend_sharding",
+    "batch_sharding",
+    "init_distributed",
+    "lane_sharding_of",
+    "make_mesh",
+    "make_mesh_2d",
+    "mesh_bucket_ladder",
+    "mesh_device_count",
+    "mesh_shape_key",
+    "mesh_slots_table",
+    "replicated_sharding",
+    "sharded_backend",
+]

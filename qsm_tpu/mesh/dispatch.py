@@ -1,0 +1,98 @@
+"""Mesh-sharded dispatch: divisible bucket ladders + the one-call backend.
+
+The chunked kernel driver (ops/jax_kernel.py) pads every batch into a bucket
+ladder and compiles one executable per (n_ops, bucket, slots, chunk, unroll)
+shape.  Under a mesh the lane axis of every bucket must divide by the device
+count — an uneven bucket leaves devices holding ragged shards and XLA falls
+back to slower non-uniform partitioning — and the compile cache must key on
+the mesh shape (:func:`qsm_tpu.mesh.topology.mesh_shape_key`) so a 1-chip
+executable never serves an 8-chip mesh.  This module owns both policies:
+
+* :func:`mesh_bucket_ladder` / :func:`mesh_slots_table` — restrict a plan's
+  bucket ladder (and its per-bucket memo-slot caps) to mesh-divisible widths.
+* :func:`sharded_backend` — the one-call constructor every consumer rides:
+  plain check batches, pcomp per-key sub-lanes, shrink frontiers, monitor
+  frontier re-checks, and the serve dispatch all take the backend this
+  returns (a planner-built engine whose ``sharding`` spans the mesh).
+
+Soundness contract: sharding is ONLY a placement change.  Verdicts and
+witnesses are bit-identical across mesh shapes (tests/test_mesh.py pins
+1x/2x/8x on every registered family, pcomp + shrink + monitor included).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+from .topology import batch_sharding, make_mesh, mesh_device_count
+
+
+def mesh_bucket_ladder(buckets: Sequence[int],
+                       n_devices: int) -> Tuple[int, ...]:
+    """Restrict a batch-bucket ladder to widths divisible by the mesh.
+
+    Keeps the ladder's shape (ascending, deduped) and guarantees a
+    non-empty result: when every bucket is narrower than the mesh the
+    ladder collapses to ``(n_devices,)`` — one lane per device is the
+    narrowest batch a mesh can hold evenly.  ``n_devices <= 1`` is the
+    identity (unsharded callers never pay a ladder change)."""
+    n = max(1, int(n_devices))
+    if n == 1:
+        return tuple(buckets)
+    kept = tuple(b for b in buckets if b % n == 0)
+    return kept or (n,)
+
+
+def mesh_slots_table(slots_for_batch: Dict[int, int],
+                     buckets: Sequence[int]) -> Dict[int, int]:
+    """Per-bucket memo-slot caps for a (possibly filtered) ladder: known
+    buckets keep their cap, new ones (the ``(n_devices,)`` collapse case)
+    get the driver's default of 32 (``JaxTPU._slots_for``)."""
+    return {b: slots_for_batch.get(b, 32) for b in buckets}
+
+
+def sharded_backend(spec, *, devices: Optional[int] = None, mesh=None,
+                    budget: int = 2_000, profile=None, plan=None,
+                    **device_kw):
+    """Planner-built check backend whose lane axis spans a mesh.
+
+    The ONE constructor for mesh-sharded dispatch: builds (or takes) the
+    mesh, derives the batch-axis :func:`~qsm_tpu.mesh.topology
+    .batch_sharding`, plans with mesh-divisible buckets
+    (``plan_search(mesh_devices=...)``), and hands both to
+    ``search.planner.build_backend`` — so pcomp key-splitting, SegDC
+    segmentation, ordering, and every other plan decision compose with
+    sharding instead of each consumer re-deriving placement.
+
+    ``devices=None`` with ``mesh=None`` spans all addressable devices
+    (``jax.device_count()``); pass ``devices=1`` for an explicitly
+    single-device backend (parity baselines).  Extra ``device_kw``
+    forwards to the engine constructor exactly as ``build_backend`` does.
+    Returns the backend; the mesh is reachable via
+    ``backend_sharding(backend).mesh`` when introspection is needed.
+    """
+    from ..search.planner import build_backend, plan_search
+
+    if mesh is None:
+        mesh = make_mesh(devices)
+    n = mesh_device_count(mesh)
+    if plan is None:
+        plan = plan_search(spec, profile=profile, mesh_devices=n)
+    sharding = batch_sharding(mesh) if n > 1 else None
+    return build_backend(spec, plan, budget=budget, sharding=sharding,
+                         **device_kw)
+
+
+def backend_sharding(backend):
+    """The NamedSharding a (possibly combinator-wrapped) backend dispatches
+    under, or None.  Unwraps pcomp/segdc layers via their ``inner``
+    attribute — combinators delegate dispatch, so the innermost engine
+    owns placement."""
+    seen = set()
+    while backend is not None and id(backend) not in seen:
+        seen.add(id(backend))
+        sh = getattr(backend, "sharding", None)
+        if sh is not None:
+            return sh
+        backend = getattr(backend, "inner", None)
+    return None

@@ -1,22 +1,34 @@
-"""Device mesh + batch-axis sharding for the checker plane.
+"""Mesh construction + the batch-axis sharding contract, in ONE place.
 
 The reference's distribution story is actor messaging (distributed-process
 over network-transport-*, SURVEY.md §5 comm backend); its checker is pure and
 single-threaded.  Our checker plane instead scales the *batch axis* of the
-linearisation kernel over a ``jax.sharding.Mesh``: trials and shrink
-candidates are independent (SURVEY.md §2b "trial/batch parallelism"), so the
-natural mapping is data parallelism — shard histories over devices, replicate
-the (tiny) spec state, and let XLA place everything with zero collectives in
-the hot loop (verdict gather rides the ICI at the end of the batch).
+linearisation kernel over a ``jax.sharding.Mesh``: trials, per-key
+sub-histories, shrink candidates, and monitor frontier re-checks are
+independent (SURVEY.md §2b "trial/batch parallelism"), so the natural mapping
+is data parallelism — shard histories over devices, replicate the (tiny) spec
+state, and let XLA place everything with zero collectives in the hot loop
+(verdict gather rides the ICI at the end of the batch).
 
 Single chip needs none of this; the helpers here exist so the SAME kernel
 runs unchanged from v5e-1 to a full pod slice: ``pjit``-style sharding comes
 entirely from ``NamedSharding`` annotations on the inputs.
+
+This module is the promotion of the dormant ``qsm_tpu/parallel/mesh.py``
+(which is now a deprecation re-export): construction (:func:`make_mesh`,
+:func:`make_mesh_2d`, :func:`init_distributed`), placement
+(:func:`batch_sharding`, :func:`replicated_sharding`,
+:func:`lane_sharding_of`), and identity (:func:`mesh_device_count`,
+:func:`mesh_shape_key` — what compile-bucket keys must include so a 1-chip
+executable never serves an 8-chip mesh).  docs/MESH.md is the prose contract.
 """
 
 from __future__ import annotations
 
 from typing import Optional, Sequence
+
+#: The canonical name of the lane (history-batch) axis on 1-D meshes.
+LANE_AXIS = "batch"
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -61,9 +73,9 @@ def init_distributed(coordinator_address: Optional[str] = None,
     return True
 
 
-def make_mesh(n_devices: Optional[int] = None, axis: str = "batch"):
+def make_mesh(n_devices: Optional[int] = None, axis: str = LANE_AXIS):
     """A 1-D device mesh over the first ``n_devices`` devices (all by
-    default).  The single axis is the history-batch axis."""
+    default).  The single axis is the history-batch (lane) axis."""
     import jax
     from jax.sharding import Mesh
 
@@ -79,7 +91,7 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "batch"):
 
 
 def make_mesh_2d(n_hosts: int, per_host: int,
-                 axes: Sequence[str] = ("host", "batch")):
+                 axes: Sequence[str] = ("host", LANE_AXIS)):
     """A (host, device) mesh: dim 0 maps hosts (DCN between real hosts),
     dim 1 the devices within a host (ICI).  Works identically over virtual
     CPU devices, which is how the dryrun validates the multi-host program
@@ -114,3 +126,51 @@ def replicated_sharding(mesh):
     from jax.sharding import PartitionSpec as P
 
     return jax.NamedSharding(mesh, P())
+
+
+def lane_sharding_of(sharding):
+    """THE lane-axis derivation: the NamedSharding that places dim 0 of a
+    batch-leading array the same way ``sharding`` places its dim 0.
+
+    Every sharded dispatch site (kernel args, the carry, compaction
+    outputs) needs exactly this — the mesh and first-dim placement of the
+    caller's sharding, regardless of what trailing dims that sharding also
+    names.  Before this helper existed the derivation lived as two
+    near-identical blocks inside ``ops/jax_kernel.py``; one definition
+    means one place to extend when the lane axis ever becomes 2-D
+    (host, device)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    axis = sharding.spec[0] if sharding.spec else None
+    return jax.NamedSharding(sharding.mesh, P(axis))
+
+
+def mesh_device_count(obj=None) -> int:
+    """Device count under a ``Mesh``, a ``NamedSharding``, or None (= the
+    process-global ``jax.device_count()``).  This is the number that must
+    appear in every compile-bucket identity (:func:`mesh_shape_key`) and
+    that batch widths must divide by (``qsm_tpu.mesh.dispatch``)."""
+    if obj is None:
+        import jax
+
+        return jax.device_count()
+    mesh = getattr(obj, "mesh", obj)  # NamedSharding -> its mesh
+    size = getattr(mesh, "size", None)
+    return int(size) if size is not None else len(mesh.devices.flat)
+
+
+def mesh_shape_key(sharding) -> tuple:
+    """Hashable identity of a sharding's mesh SHAPE for compile caches:
+    ``(device_count, axis_names...)`` — or ``(1,)`` for unsharded.
+
+    Why device_count and not just the axis names: two meshes named
+    ("batch",) over 1 vs 8 chips produce executables with different SPMD
+    partitioning; a cache keyed without the count would serve the 1-chip
+    executable to the 8-chip mesh (ISSUE 19's bucket-identity clause).
+    Axis names ride along so a flat ("batch",) mesh and a ("host",
+    "batch") mesh of equal size never collide either."""
+    if sharding is None:
+        return (1,)
+    mesh = sharding.mesh
+    return (mesh_device_count(mesh),) + tuple(mesh.axis_names)

@@ -561,6 +561,26 @@ class JaxTPU:
         self.budget = budget
         self.max_expansions = max_expansions
         self.sharding = sharding  # optional NamedSharding for the batch axis
+        # Mesh placement is resolved ONCE here (qsm_tpu/mesh/ owns the
+        # policy): the lane-axis sharding every dispatch site applies, the
+        # mesh-shape key folded into every compile-cache identity (a
+        # 1-chip executable must never serve an 8-chip mesh), and a bucket
+        # ladder restricted to mesh-divisible widths (uneven buckets shard
+        # raggedly).  Unsharded instances keep (1,) and untouched ladders.
+        from ..mesh.dispatch import mesh_bucket_ladder, mesh_slots_table
+        from ..mesh.topology import (lane_sharding_of, mesh_device_count,
+                                     mesh_shape_key)
+
+        self._mesh_key = mesh_shape_key(sharding)
+        self._lane_sharding = (lane_sharding_of(sharding)
+                               if sharding is not None else None)
+        if sharding is not None:
+            n_dev = mesh_device_count(sharding)
+            if n_dev > 1:
+                self.BATCH_BUCKETS = mesh_bucket_ladder(
+                    self.BATCH_BUCKETS, n_dev)
+                self.MAX_SLOTS_FOR_BATCH = mesh_slots_table(
+                    self.MAX_SLOTS_FOR_BATCH, self.BATCH_BUCKETS)
         self.rescue_budget = rescue_budget
         self.rescue_slots = rescue_slots
         self.mid_budget = mid_budget
@@ -671,7 +691,8 @@ class JaxTPU:
     def _init_fn(self, n_ops: int, batch: int, slots: int):
         import jax
 
-        key = ("init", n_ops, batch, slots, self._unroll())
+        key = ("init", n_ops, batch, slots, self._unroll(),
+               self._mesh_key)
         fn = self._compiled.get(key)
         if fn is None:
             init_one, _ = self._stepper(n_ops, slots)
@@ -683,8 +704,11 @@ class JaxTPU:
                   donate: bool = True):
         import jax
 
+        # mesh shape is part of every compile identity: executables are
+        # SPMD-partitioned for a specific device count (mesh/topology.py
+        # mesh_shape_key — a 1-chip build must never serve an 8-chip mesh)
         key = ("chunk", n_ops, batch, slots, chunk, donate,
-               self._unroll())
+               self._unroll(), self._mesh_key)
         fn = self._compiled.get(key)
         if fn is None:
             _, run_one = self._stepper(n_ops, slots)
@@ -719,7 +743,7 @@ class JaxTPU:
         import jax
         import jax.numpy as jnp
 
-        key = ("compact", new_bucket, slots, old_slots)
+        key = ("compact", new_bucket, slots, old_slots, self._mesh_key)
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
@@ -1168,15 +1192,15 @@ class JaxTPU:
         """Every carry leaf is batch-leading; on a mesh, place it with the
         same batch-axis sharding as the kernel args (otherwise each chunk
         call implicitly reshards the dominant state — the carry, cache
-        included, is far larger than the inputs)."""
-        if self.sharding is None:
+        included, is far larger than the inputs).  The placement itself
+        is ``mesh.lane_sharding_of(self.sharding)``, resolved once in
+        ``__init__`` — the one lane-axis derivation shared with
+        :meth:`_arg_shardings`."""
+        if self._lane_sharding is None:
             return carry
         import jax
-        from jax.sharding import PartitionSpec as P
 
-        mesh = self.sharding.mesh
-        axis = self.sharding.spec[0] if self.sharding.spec else None
-        batched = jax.NamedSharding(mesh, P(axis))
+        batched = self._lane_sharding
         return {k: jax.device_put(v, batched) for k, v in carry.items()}
 
     def _stepper_key_words(self, n_ops: int) -> int:
@@ -1212,11 +1236,8 @@ class JaxTPU:
         return args
 
     def _arg_shardings(self):
-        """Batch-axis sharding for each kernel argument."""
-        import jax
-        from jax.sharding import PartitionSpec as P
-
-        mesh = self.sharding.mesh
-        axis = self.sharding.spec[0] if self.sharding.spec else None
-        batched = jax.NamedSharding(mesh, P(axis))
+        """Batch-axis sharding for each kernel argument — the same
+        ``lane_sharding_of`` derivation the carry uses (one definition,
+        qsm_tpu/mesh/topology.py)."""
+        batched = self._lane_sharding
         return (batched, batched, batched, batched, batched)

@@ -1,4 +1,21 @@
-"""Mesh/sharding for batch-parallel checking at scale (SURVEY.md §2b, §5)."""
+"""DEPRECATED — the mesh/sharding helpers moved to :mod:`qsm_tpu.mesh`.
 
-from .mesh import (batch_sharding, init_distributed, make_mesh, make_mesh_2d,
-                   replicated_sharding)
+This package was the dormant home of the mesh construction helpers before
+ISSUE 19 promoted them into the full mesh-sharded dispatch substrate
+(``qsm_tpu/mesh/``: topology + dispatch policy + the one-call
+``sharded_backend``).  It remains ONLY as a thin re-export so existing
+imports keep working; no mesh logic lives here.  New code imports from
+``qsm_tpu.mesh``.  Pinned by tests/test_parallel.py; removal is fair game
+once in-tree importers are gone.
+"""
+
+from ..mesh.topology import (batch_sharding, init_distributed, make_mesh,
+                             make_mesh_2d, replicated_sharding)
+
+__all__ = [
+    "batch_sharding",
+    "init_distributed",
+    "make_mesh",
+    "make_mesh_2d",
+    "replicated_sharding",
+]

@@ -186,6 +186,12 @@ class SearchPlan:
     # build_backend — per-key sub-histories are sparser, so the
     # quiescent-cut stage under it cuts more often.
     decompose_keys: bool = False
+    # Mesh shape this plan was sized for: bucket ladders are filtered to
+    # widths divisible by it (qsm_tpu/mesh/dispatch.py) and it is part of
+    # the plan's NAME — plan identity IS compile-bucket identity (the name
+    # rides SearchStats.plan into artifacts), so a 1-chip plan can never
+    # be mistaken for an 8-chip one downstream.
+    mesh_devices: int = 1
     why: Tuple[str, ...] = ()
 
     def describe(self) -> Dict:
@@ -198,6 +204,7 @@ class SearchPlan:
             "decompose": self.decompose,
             "decompose_keys": self.decompose_keys,
             "unroll": self.unroll,
+            "mesh_devices": self.mesh_devices,
             "why": list(self.why),
         }
 
@@ -236,16 +243,21 @@ def _plan_decompose_keys(spec, profile: Optional[CorpusProfile]
 
 
 def plan_search(spec, profile: Optional[CorpusProfile] = None,
-                platform: Optional[str] = None) -> SearchPlan:
+                platform: Optional[str] = None,
+                mesh_devices: int = 1) -> SearchPlan:
     """Pick the search plan for ``spec`` on ``platform`` ("cpu"/"tpu"; None
     = whatever jax's default backend reports) given optional corpus
     statistics.  Pure policy — constructs no backend and touches no
-    device."""
+    device.  ``mesh_devices > 1`` sizes the plan for a mesh of that many
+    devices: bucket ladders filter to mesh-divisible widths and the plan
+    name gains an ``@meshN`` suffix (per-mesh-shape compile buckets —
+    a 1-chip plan must never serve an 8-chip mesh)."""
     if platform is None:
         import jax
 
         platform = jax.default_backend()
     on_device = platform not in ("cpu",)
+    mesh_devices = max(1, int(mesh_devices))
     why = []
 
     orderable = ordering_table(spec) is not None
@@ -279,19 +291,36 @@ def plan_search(spec, profile: Optional[CorpusProfile] = None,
         why.append("decompose=off (no corpus profile)")
     why.append(dk_why)
 
+    def _mesh_fit(name, buckets, slots):
+        """Mesh-shape the plan: divisible buckets, matching slot table,
+        ``@meshN`` name suffix (plan identity = compile-bucket identity)."""
+        if mesh_devices == 1:
+            return name, tuple(buckets), dict(slots)
+        from ..mesh.dispatch import mesh_bucket_ladder, mesh_slots_table
+
+        kept = mesh_bucket_ladder(buckets, mesh_devices)
+        why.append(f"mesh_devices={mesh_devices}: bucket ladder filtered "
+                   f"to mesh-divisible widths ({len(buckets)} -> "
+                   f"{len(kept)} buckets)")
+        return (f"{name}@mesh{mesh_devices}", kept,
+                mesh_slots_table(slots, kept))
+
     if on_device:
         why.append("device platform: verified (batch × slots) safe region "
                    "kept; small first chunk ends the starved wide stage "
                    "at the first compaction")
+        name, buckets, slots = _mesh_fit("tpu-safe-v1", _TPU_BUCKETS,
+                                         _TPU_SLOTS)
         return SearchPlan(
-            name="tpu-safe-v1",
+            name=name,
             chunk_schedule=_TPU_SCHEDULE,
-            batch_buckets=_TPU_BUCKETS,
-            slots_for_batch=dict(_TPU_SLOTS),
+            batch_buckets=buckets,
+            slots_for_batch=slots,
             ordering=orderable,
             decompose=decompose,
             decompose_keys=decompose_keys,
             unroll=8,
+            mesh_devices=mesh_devices,
             why=tuple(why),
         )
     first = _CPU_SCHEDULE[0]
@@ -312,15 +341,18 @@ def plan_search(spec, profile: Optional[CorpusProfile] = None,
                    f"{eff_max}")
     why.append("cpu platform: no crash region — full-size memo tables, "
                "fine buckets to single-lane")
+    name, buckets, slots = _mesh_fit(
+        "cpu-fine-v1", _CPU_BUCKETS, {b: _CPU_SLOTS for b in _CPU_BUCKETS})
     return SearchPlan(
-        name="cpu-fine-v1",
+        name=name,
         chunk_schedule=sched,
-        batch_buckets=_CPU_BUCKETS,
-        slots_for_batch={b: _CPU_SLOTS for b in _CPU_BUCKETS},
+        batch_buckets=buckets,
+        slots_for_batch=slots,
         ordering=orderable,
         decompose=decompose,
         decompose_keys=decompose_keys,
         unroll=None,
+        mesh_devices=mesh_devices,
         why=tuple(why),
     )
 
@@ -332,8 +364,20 @@ def build_backend(spec, plan: SearchPlan, budget: int = 2_000, **device_kw):
     (``PComp``) when the plan splits per key — outermost, because per-key
     sub-histories are sparser and cut more often, so every inner stage
     benefits.  (Imports are local: the search plane must stay importable
-    without jax for the pure-policy callers — lint, docs, profiling.)"""
+    without jax for the pure-policy callers — lint, docs, profiling.)
+
+    A mesh-sized plan (``plan.mesh_devices > 1``) implies a sharded
+    engine: when the caller passes no explicit ``sharding=``, the lane
+    sharding is derived here from a mesh of exactly that many devices, so
+    plan and placement can never disagree (the plan's bucket ladder was
+    filtered for that device count)."""
     from ..ops.jax_kernel import JaxTPU
+
+    if plan.mesh_devices > 1 and device_kw.get("sharding") is None:
+        from ..mesh.topology import batch_sharding, make_mesh
+
+        device_kw["sharding"] = batch_sharding(
+            make_mesh(plan.mesh_devices))
 
     def make_core(s):
         if not plan.decompose:

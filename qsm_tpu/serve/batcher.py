@@ -90,19 +90,27 @@ class MicroBatcher:
     def __init__(self, dispatch: Callable[[str, List[Lane], dict], None],
                  max_lanes: int = 64, flush_s: float = 0.02,
                  queue_depth: int = 4096, concurrency: int = 1,
-                 lanes_target: Optional[int] = None):
+                 lanes_target: Optional[int] = None,
+                 mesh_devices: int = 1):
         self._dispatch = dispatch
-        self.max_lanes = max_lanes
         self.flush_s = flush_s
         self.concurrency = max(1, int(concurrency))
+        # mesh-aware flush target (qsm_tpu/mesh/): when the engine under
+        # _dispatch shards its lane axis over N devices, every flushed
+        # width must divide by N or the padded batch shards raggedly —
+        # so max_lanes and lanes_target round UP to mesh multiples, and
+        # one dispatch fills the whole mesh instead of one device
+        self.mesh_devices = max(1, int(mesh_devices))
+        self.max_lanes = self._mesh_ceil(max_lanes)
         # per-worker flush target: with N dispatch slots, a burst of
         # lanes splits into N parallel batches instead of one serial
         # max_lanes batch (the pool's scaling shape); 1 slot keeps the
         # historical fill-to-max_lanes behavior
         if lanes_target is not None:
-            self.lanes_target = max(1, int(lanes_target))
+            self.lanes_target = self._mesh_ceil(lanes_target)
         elif self.concurrency > 1:
-            self.lanes_target = max(1, self.max_lanes // self.concurrency)
+            self.lanes_target = self._mesh_ceil(
+                max(1, self.max_lanes // self.concurrency))
         else:
             self.lanes_target = self.max_lanes
         # bounded by contract (QSM-SERVE-UNBOUNDED): admission gates
@@ -159,6 +167,11 @@ class MicroBatcher:
                         continue
             for t in self._dispatchers:
                 t.join(max(0.5, t_end - time.monotonic()))
+
+    def _mesh_ceil(self, n: int) -> int:
+        """Smallest multiple of ``mesh_devices`` holding ``n`` lanes."""
+        m = self.mesh_devices
+        return max(1, int(n)) if m == 1 else -(-max(1, int(n)) // m) * m
 
     def submit(self, group_key: str, lane: Lane) -> bool:
         """Enqueue one lane; False when the (bounded) queue is full —
@@ -274,8 +287,9 @@ class MicroBatcher:
         # width is FIXED at max_lanes so every dispatch hits the same
         # compiled executable (core/property.py's padding lesson); a
         # group can never exceed it (lanes arrive one per loop turn),
-        # but never drop a lane even if that invariant breaks
-        width = max(self.max_lanes, len(lanes))
+        # but never drop a lane even if that invariant breaks — and the
+        # overflow fallback still pads to a mesh-divisible width
+        width = max(self.max_lanes, self._mesh_ceil(len(lanes)))
         with self._if_lock:  # dispatcher threads share these counters
             self.batches += 1
             batch_id = self.batches
@@ -315,4 +329,5 @@ class MicroBatcher:
                 "flush_s": self.flush_s,
                 "concurrency": self.concurrency,
                 "lanes_target": self.lanes_target,
+                "mesh_devices": self.mesh_devices,
                 "in_flight": in_flight}

@@ -219,7 +219,8 @@ class CheckServer:
                  session_dir: Optional[str] = None,
                  lease_path: Optional[str] = None,
                  slo: Optional[str] = None,
-                 slo_window_s: float = 60.0):
+                 slo_window_s: float = 60.0,
+                 mesh_devices: int = 1):
         if engine not in ("auto", "planned"):
             raise ValueError(f"unknown serve engine {engine!r}; "
                              "one of ('auto', 'planned')")
@@ -228,8 +229,19 @@ class CheckServer:
             # belongs in the supervisor process where the probe gate ran
             raise ValueError("workers>0 requires engine='auto' (pool "
                              "workers run the host cpp->memo ladder)")
+        if workers and mesh_devices > 1:
+            # mutually exclusive fan-outs: pool workers own host-ladder
+            # engines (no device, nothing to shard); the mesh fan-out
+            # belongs to the planned device engine in THIS process
+            raise ValueError("workers>0 and mesh_devices>1 are exclusive "
+                             "fan-outs (the pool runs host engines)")
         self.host, self.port, self.unix_path = host, port, unix_path
         self.engine_kind = engine
+        # lane-axis mesh span of the planned device engine (qsm_tpu/mesh/,
+        # docs/MESH.md): >1 makes _build_engine plan per-mesh-shape
+        # buckets + sharded dispatch, and the batcher's flush target
+        # rounds to mesh multiples so one flush fills the whole mesh
+        self.mesh_devices = max(1, int(mesh_devices))
         self.policy = policy or preset("serve")
         self.max_lanes = max_lanes
         self.allow_shutdown = allow_shutdown
@@ -325,7 +337,8 @@ class CheckServer:
         self.batcher = MicroBatcher(self._dispatch, max_lanes=max_lanes,
                                     flush_s=flush_s,
                                     queue_depth=max(queue_depth * 2, 64),
-                                    concurrency=self.n_workers or 1)
+                                    concurrency=self.n_workers or 1,
+                                    mesh_devices=self.mesh_devices)
         self._engines: Dict[str, _EngineEntry] = {}
         self._engines_lock = threading.Lock()
         self._engine_builds: Dict[str, threading.Lock] = {}
@@ -558,10 +571,14 @@ class CheckServer:
             inner, plan_why = self._engine_factory(spec), ["injected"]
         elif self.engine_kind == "planned":
             # the planner-built device checker; same reachability
-            # contract as --backend tpu (the CLI gates before start)
+            # contract as --backend tpu (the CLI gates before start).
+            # mesh_devices > 1 sizes the plan for the mesh and
+            # build_backend derives the matching lane sharding — ONE
+            # dispatch then fills every device (docs/MESH.md)
             from ..search.planner import build_backend
 
-            plan = plan_search(spec, platform=None)
+            plan = plan_search(spec, platform=None,
+                               mesh_devices=self.mesh_devices)
             inner, plan_why = build_backend(spec, plan), list(plan.why)
         else:
             # today's fast path: the exact host ladder (native C++
@@ -1891,6 +1908,7 @@ class CheckServer:
             "node": self.node_id,
             "uptime_s": round(time.monotonic() - self._t0, 1),
             "engine_kind": self.engine_kind,
+            "mesh_devices": self.mesh_devices,
             "workers": self.n_workers,
             "requests": self.requests,
             "histories": self.histories,

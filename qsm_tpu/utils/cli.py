@@ -478,6 +478,7 @@ def cmd_serve(args) -> int:
     server = CheckServer(
         host=args.host, port=args.port, unix_path=args.unix,
         engine=args.engine, max_lanes=args.max_lanes,
+        mesh_devices=args.mesh_devices,
         flush_s=args.flush_ms / 1000.0, queue_depth=args.queue_depth,
         cache_path=args.cache, cache_entries=args.cache_entries,
         workers=args.workers, quarantine_after=args.quarantine_after,
@@ -511,6 +512,7 @@ def cmd_serve(args) -> int:
                           "peers": peers or None,
                           "workers": args.workers,
                           "max_lanes": args.max_lanes,
+                          "mesh_devices": args.mesh_devices,
                           "flush_ms": args.flush_ms,
                           "queue_depth": args.queue_depth,
                           "cache": args.cache,
@@ -1976,6 +1978,15 @@ def main(argv=None) -> int:
                         "ladder (no respawn storm)")
     p.add_argument("--max-lanes", type=int, default=64,
                    help="micro-batch width: lanes coalesced per dispatch")
+    p.add_argument("--mesh-devices", type=int, default=1,
+                   help="shard the planned engine's lane axis over a mesh "
+                        "of N devices (qsm_tpu/mesh, docs/MESH.md): plans "
+                        "get mesh-divisible compile buckets and the "
+                        "batcher's flush target rounds to mesh multiples "
+                        "so one dispatch fills the whole mesh; verdicts "
+                        "are bit-identical at any N (CPU bench: "
+                        "XLA_FLAGS=--xla_force_host_platform_device_"
+                        "count=N)")
     p.add_argument("--flush-ms", type=float, default=20.0,
                    help="micro-batch flush interval (latency floor for "
                         "a lone client)")

@@ -47,6 +47,26 @@ def _strip_host_platform_flag(flags: str) -> str:
                   flags).strip()
 
 
+def forced_host_device_env(n_devices: int,
+                           base_env: Optional[dict] = None) -> dict:
+    """Subprocess environment pinning the JAX CPU platform with
+    ``n_devices`` virtual devices — the one derivation every
+    forced-device-count lane uses (tests/_mesh_worker.py parity
+    subprocesses, tools/bench_mesh.py scaling cells, the fleet gate
+    re-run).  The flag must reach the child BEFORE its first backend
+    init, which is exactly why this is an env builder and not an
+    in-process setter: :func:`force_cpu_platform` covers the in-process
+    case, subprocesses get their mesh width from here.  Existing
+    operator ``XLA_FLAGS`` survive (only a previous forcing flag is
+    replaced — same contract as :func:`_strip_host_platform_flag`)."""
+    env = dict(os.environ if base_env is None else base_env)
+    flags = _strip_host_platform_flag(env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (f"{flags} --xla_force_host_platform_device_count="
+                        f"{int(n_devices)}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 @dataclasses.dataclass(frozen=True)
 class Probe:
     """Result of a bounded backend probe."""

@@ -64,9 +64,10 @@ def main(argv) -> int:
     force_cpu_platform(4)
     import jax
 
+    from qsm_tpu.mesh import (batch_sharding, init_distributed,
+                              lane_sharding_of, make_mesh_2d,
+                              mesh_device_count, mesh_shape_key)
     from qsm_tpu.ops.jax_kernel import build_kernel
-    from qsm_tpu.parallel import (batch_sharding, init_distributed,
-                                  make_mesh_2d)
 
     ok = init_distributed(f"127.0.0.1:{port}", num_processes=nproc,
                           process_id=pid)
@@ -80,6 +81,12 @@ def main(argv) -> int:
     # the mesh must really span both OS processes, not 8 local devices
     assert len({d.process_index for d in mesh.devices.flat}) == 2
     sharding = batch_sharding(mesh)
+    # the promoted substrate's identity helpers hold on the MULTI-HOST
+    # mesh shape too: 8 global devices under ("host", "batch"), and the
+    # lane derivation reduces the hierarchical spec to its leading axis
+    assert mesh_device_count(mesh) == 8, mesh_device_count(mesh)
+    assert mesh_shape_key(sharding) == (8, "host", "batch")
+    assert lane_sharding_of(sharding).spec[0] == ("host", "batch")
     garrs = [
         jax.make_array_from_callback(a.shape, sharding,
                                      lambda idx, a=a: a[idx])
@@ -99,6 +106,7 @@ def main(argv) -> int:
         json.dump({"process_index": pid,
                    "process_count": jax.process_count(),
                    "global_devices": len(jax.devices()),
+                   "mesh_shape_key": list(mesh_shape_key(sharding)),
                    "rows": rows}, f)
     return 0
 

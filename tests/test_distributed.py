@@ -79,6 +79,10 @@ def test_two_process_jax_distributed_sharded_kernel_parity(tmp_path):
     assert {r["process_index"] for r in reports} == {0, 1}
     assert all(r["process_count"] == 2 for r in reports)
     assert all(r["global_devices"] == 8 for r in reports)
+    # both processes built the SAME multi-host mesh shape and the
+    # substrate's compile-bucket identity agrees on it (qsm_tpu/mesh)
+    assert all(r["mesh_shape_key"] == [8, "host", "batch"]
+               for r in reports)
 
     # union of per-process addressable rows covers the whole batch
     mod = _load_worker_module()

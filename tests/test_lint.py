@@ -17,6 +17,7 @@ from qsm_tpu.analysis import (ERROR, FAMILIES, Finding, Whitelist,
                               run_lint)
 from qsm_tpu.analysis.engine import (DEFAULT_FLEET_FILES,
                                      DEFAULT_GEN_FILES,
+                                     DEFAULT_MESH_FILES,
                                      DEFAULT_MONITOR_FILES,
                                      DEFAULT_OBS_FILES,
                                      DEFAULT_OPS_FILES,
@@ -92,9 +93,13 @@ def test_in_tree_corpus_is_clean(report):
     # driver (ISSUE 17)
     assert len(DEFAULT_GEN_FILES) == 5
     assert "gen" in report.passes
-    # a–m all registered and all ran in the default lane
-    assert sorted(FAMILIES) == list("abcdefghijklm")
-    assert report.families == list("abcdefghijklm")
+    # the mesh-dispatch family (n): the substrate + its sharded
+    # consumers + the mesh bench driver (ISSUE 19)
+    assert len(DEFAULT_MESH_FILES) == 6
+    assert "mesh" in report.passes
+    # a–n all registered and all ran in the default lane
+    assert sorted(FAMILIES) == list("abcdefghijklmn")
+    assert report.families == list("abcdefghijklmn")
     assert report.ok, "\n".join(
         f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
 
@@ -422,6 +427,85 @@ def test_gen_live_tree_is_clean():
     for rel in DEFAULT_GEN_FILES:
         findings += check_gen_file(os.path.join(REPO_ROOT, rel),
                                    root=REPO_ROOT)
+    assert findings == []
+
+
+def test_mesh_hardcode_is_caught():
+    """The mesh pass's bulb check (family n, ISSUE 19): the hardcoded
+    stub fires QSM-MESH-HARDCODE for BOTH shapes — indexing the device
+    enumeration and a literal count in a mesh constructor — while the
+    shape-polymorphic twin (threaded count, len() over the enumeration)
+    stays clean."""
+    from qsm_tpu.analysis.mesh_passes import check_mesh_file
+
+    findings = [f for f in check_mesh_file(fixtures.__file__)
+                if f.rule_id == "QSM-MESH-HARDCODE"
+                and "MeshStub" in f.location]
+    assert len(findings) == 2
+    assert {f.severity for f in findings} == {ERROR}
+    assert all("HardcodedMeshStub" in f.location for f in findings)
+    assert any("pin_first_device" in f.location for f in findings)
+    assert any("build_fixed_mesh" in f.location for f in findings)
+    assert not any("ShapePolymorphicMeshStub" in f.location
+                   for f in check_mesh_file(fixtures.__file__))
+
+
+def test_mesh_transfer_is_caught():
+    """QSM-MESH-TRANSFER fires on the function that BOTH applies a
+    sharding and pulls to host; the split twin (the jax_kernel.py
+    _shard_carry / _compact_carry_host shape) stays clean."""
+    from qsm_tpu.analysis.mesh_passes import check_mesh_file
+
+    findings = [f for f in check_mesh_file(fixtures.__file__)
+                if f.rule_id == "QSM-MESH-TRANSFER"]
+    assert len(findings) == 1
+    assert "TransferringDispatchStub.shard_then_pull" in \
+        findings[0].location
+    assert findings[0].severity == ERROR
+    assert not any("DeviceResidentDispatchStub" in f.location
+                   for f in check_mesh_file(fixtures.__file__))
+
+
+def test_mesh_scope_is_the_function_not_the_module():
+    """A module that device_puts in one function and np.asarray's in
+    another must NOT co-occur into a finding — the rule's scope is the
+    function, because gather-then-reshard THROUGH a helper is exactly
+    the sanctioned compaction shape."""
+    import tempfile
+    import textwrap
+
+    from qsm_tpu.analysis.mesh_passes import check_mesh_file
+
+    src = textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def shard(arrs, sharding):
+            return [jax.device_put(a, sharding) for a in arrs]
+
+        def gather(shards):
+            return [np.asarray(s) for s in shards]
+    """)
+    with tempfile.NamedTemporaryFile("w", suffix=".py") as f:
+        f.write(src)
+        f.flush()
+        assert check_mesh_file(f.name) == []
+
+
+def test_mesh_live_tree_is_clean():
+    """The substrate keeps its own discipline: no literal device count
+    outside a threaded parameter, no host pull inside a sharding-
+    applying function, across qsm_tpu/mesh/ and every sharded consumer
+    (jax_kernel's _shard_carry / _compact_carry_host split included)."""
+    import os
+
+    from qsm_tpu.analysis.engine import REPO_ROOT
+    from qsm_tpu.analysis.mesh_passes import check_mesh_file
+
+    findings = []
+    for rel in DEFAULT_MESH_FILES:
+        findings += check_mesh_file(os.path.join(REPO_ROOT, rel),
+                                    root=REPO_ROOT)
     assert findings == []
 
 

@@ -1,0 +1,258 @@
+"""The mesh-substrate gate (ISSUE 19): ONE NamedSharding lane axis
+under every check plane, with bit-identical answers at every mesh
+shape.
+
+Two lanes:
+
+* the SUBPROCESS parity lane — tests/_mesh_worker.py spawned with
+  forced host device counts 8 and 1 (``forced_host_device_env``, the
+  no-hardware recipe docs/MESH.md documents): verdicts, witnesses and
+  minimized shrink rows must compare bit-for-bit across shapes, kv
+  riding its pcomp per-key sub-lanes;
+* in-process pins on the substrate's own contracts — mesh-divisible
+  planner buckets and ``@meshN`` plan identity, plan-driven default
+  sharding in ``build_backend``, the batcher's mesh-ceil flush target,
+  the server's fan-out exclusivity, topology identity helpers, and the
+  monitor frontier re-checking through a sharded oracle
+  (tests/conftest.py pins this process to an 8-device virtual CPU
+  platform, so in-process meshes up to 8 wide are real here).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "_mesh_worker.py")
+
+
+def _load_worker_module():
+    spec = importlib.util.spec_from_file_location("_mesh_worker", WORKER)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# the subprocess parity lane
+# ---------------------------------------------------------------------------
+
+def test_forced_device_count_parity_8_vs_1(tmp_path):
+    """The acceptance gate: the identical corpus through the identical
+    substrate at mesh shapes 8 and 1 answers identically — verdicts
+    AND witnesses AND shrink rows — with kv pcomp-split and every
+    linearizable witness replayed in-worker (witness_failures 0)."""
+    from qsm_tpu.utils.device import forced_host_device_env
+
+    outs = {n: str(tmp_path / f"mesh{n}.json") for n in (8, 1)}
+    procs = {
+        n: subprocess.Popen(
+            [sys.executable, WORKER, str(n), outs[n]],
+            env=forced_host_device_env(n), cwd="/root/repo",
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for n in (8, 1)
+    }
+    logs = {}
+    try:
+        for n, p in procs.items():
+            logs[n], _ = p.communicate(timeout=600)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+    assert all(p.returncode == 0 for p in procs.values()), \
+        "\n---\n".join(f"d{n}:\n{log}" for n, log in logs.items())
+
+    reports = {n: json.load(open(outs[n])) for n in (8, 1)}
+    assert reports[8]["devices"] == 8 and reports[1]["devices"] == 1
+    mod = _load_worker_module()
+    fams = [f[0] for f in mod.FAMILY_SHAPES]
+    for fam in fams:
+        r8, r1 = (reports[8]["families"][fam],
+                  reports[1]["families"][fam])
+        # bit-identical answers, per family
+        assert r8["verdicts"] == r1["verdicts"], fam
+        assert r8["witnesses"] == r1["witnesses"], fam
+        # the corpus must exercise both verdicts or parity is vacuous
+        assert len(set(r8["verdicts"])) >= 2, (fam, r8["verdicts"])
+        # compile-bucket identity carries the shape: @mesh8 vs plain
+        assert r8["plan"].endswith("@mesh8"), r8["plan"]
+        assert "@mesh" not in r1["plan"], r1["plan"]
+        assert r8["mesh_shape_key"] == [8, "batch"]
+        assert r1["mesh_shape_key"] == [1]
+    # the pcomp plane rode the mesh: kv decomposed, plain cas did not
+    assert reports[8]["families"]["kv"]["pcomp"] is True
+    assert reports[8]["families"]["cas"]["pcomp"] is False
+    # shrink plane: same 1-minimal rows at both shapes
+    assert reports[8]["shrink_ok"] and reports[1]["shrink_ok"]
+    assert reports[8]["shrink_rows"] == reports[1]["shrink_rows"]
+    # every linearizable witness replayed search-free, both shapes
+    assert reports[8]["witness_failures"] == 0
+    assert reports[1]["witness_failures"] == 0
+
+
+# ---------------------------------------------------------------------------
+# in-process pins: planner compile buckets
+# ---------------------------------------------------------------------------
+
+def test_plan_buckets_are_mesh_divisible_and_identity_is_suffixed():
+    from qsm_tpu.models import CasSpec
+    from qsm_tpu.search.planner import plan_search
+
+    plain = plan_search(CasSpec())
+    plan = plan_search(CasSpec(), mesh_devices=8)
+    assert plan.mesh_devices == 8
+    assert plan.name == f"{plain.name}@mesh8"
+    assert all(b % 8 == 0 for b in plan.batch_buckets)
+    assert set(plan.slots_for_batch) == set(plan.batch_buckets)
+    assert any("mesh_devices=8" in w for w in plan.why)
+    # mesh_devices=1 is the identity: same name, same ladder
+    one = plan_search(CasSpec(), mesh_devices=1)
+    assert one.name == plain.name
+    assert one.batch_buckets == plain.batch_buckets
+
+
+def test_mesh_bucket_ladder_filters_and_falls_back():
+    from qsm_tpu.mesh.dispatch import mesh_bucket_ladder
+
+    assert mesh_bucket_ladder((1, 2, 4, 8, 64), 1) == (1, 2, 4, 8, 64)
+    assert mesh_bucket_ladder((1, 2, 4, 8, 64), 8) == (8, 64)
+    # nothing divisible: one bucket of exactly one lane per device
+    assert mesh_bucket_ladder((3, 5, 7), 8) == (8,)
+
+
+def test_build_backend_applies_plan_mesh_sharding():
+    """A ``@mesh8`` plan materializes its own lane sharding when the
+    caller passes none — compile-bucket identity and placement can
+    never drift apart."""
+    from qsm_tpu.mesh import backend_sharding, mesh_shape_key
+    from qsm_tpu.models import CasSpec
+    from qsm_tpu.search.planner import build_backend, plan_search
+
+    plan = plan_search(CasSpec(), mesh_devices=8)
+    backend = build_backend(CasSpec(), plan)
+    assert mesh_shape_key(backend_sharding(backend)) == (8, "batch")
+
+
+def test_kernel_mesh_key_buckets_and_lane_sharding():
+    """The driver's compile cache is keyed by mesh shape, its ladders
+    are filtered to mesh-divisible widths, and the carry sharding is
+    the lane-axis derivation of the batch sharding."""
+    from qsm_tpu.mesh import batch_sharding, make_mesh
+    from qsm_tpu.models import CasSpec
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+
+    mesh = make_mesh(8)
+    drv = JaxTPU(CasSpec(), sharding=batch_sharding(mesh))
+    assert drv._mesh_key == (8, "batch")
+    assert all(b % 8 == 0 for b in drv.BATCH_BUCKETS)
+    assert set(drv.MAX_SLOTS_FOR_BATCH) == set(drv.BATCH_BUCKETS)
+    assert drv._lane_sharding.spec[0] == "batch"
+    plain = JaxTPU(CasSpec())
+    assert plain._mesh_key == (1,)
+    assert plain._lane_sharding is None
+
+
+# ---------------------------------------------------------------------------
+# in-process pins: serve plane fan-out
+# ---------------------------------------------------------------------------
+
+def test_batcher_mesh_ceil_flush_target():
+    from qsm_tpu.serve.batcher import MicroBatcher
+
+    sink = lambda *a: None  # noqa: E731 — never flushed here
+    b = MicroBatcher(sink, flush_s=0.01, max_lanes=10, mesh_devices=8)
+    # every lanes target is rounded UP to a multiple of the mesh width
+    # (never down: admission capacity must not silently shrink)
+    assert b.max_lanes == 16
+    assert b._mesh_ceil(1) == 8 and b._mesh_ceil(17) == 24
+    assert b.snapshot()["mesh_devices"] == 8
+    plain = MicroBatcher(sink, flush_s=0.01, max_lanes=10)
+    assert plain.max_lanes == 10 and plain._mesh_ceil(7) == 7
+
+
+def test_server_mesh_devices_and_worker_pool_are_exclusive():
+    from qsm_tpu.serve.server import CheckServer
+
+    with pytest.raises(ValueError):
+        CheckServer(workers=2, mesh_devices=8)
+
+
+def test_server_stats_report_mesh_devices():
+    from qsm_tpu.serve.server import CheckServer
+
+    server = CheckServer(flush_s=0.005, max_lanes=8,
+                         mesh_devices=8).start()
+    try:
+        assert server.stats()["mesh_devices"] == 8
+        assert server.batcher.max_lanes % 8 == 0
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# in-process pins: topology identity helpers
+# ---------------------------------------------------------------------------
+
+def test_topology_identity_helpers():
+    from jax.sharding import PartitionSpec as P
+
+    from qsm_tpu.mesh import (batch_sharding, lane_sharding_of,
+                              make_mesh, make_mesh_2d,
+                              mesh_device_count, mesh_shape_key)
+
+    mesh = make_mesh(8)
+    sharding = batch_sharding(mesh)
+    assert mesh_device_count(mesh) == 8
+    assert mesh_device_count(sharding) == 8
+    assert mesh_shape_key(sharding) == (8, "batch")
+    assert mesh_shape_key(None) == (1,)
+    assert lane_sharding_of(sharding).spec == P("batch")
+    # hierarchical mesh: the lane derivation keeps dim 0 over BOTH
+    # axes and drops the rest — carries shard like their batch dim
+    mesh2 = make_mesh_2d(2, 4)
+    s2 = batch_sharding(mesh2)
+    assert mesh_shape_key(s2) == (8, "host", "batch")
+    assert lane_sharding_of(s2).spec[0] == ("host", "batch")
+
+
+# ---------------------------------------------------------------------------
+# in-process pins: monitor plane on a sharded oracle
+# ---------------------------------------------------------------------------
+
+def test_monitor_frontier_recheck_through_sharded_oracle():
+    """The frontier's window re-check (oracle.check_from) answers
+    identically through a mesh-sharded kernel and the unsharded one —
+    the monitor plane rides the substrate without a verdict drift."""
+    from qsm_tpu import generate_program, run_concurrent
+    from qsm_tpu.mesh import batch_sharding, make_mesh
+    from qsm_tpu.models import AtomicCasSUT, CasSpec
+    from qsm_tpu.monitor.frontier import IncrementalFrontier
+    from qsm_tpu.ops.jax_kernel import JaxTPU
+
+    spec = CasSpec()
+    prog = generate_program(spec, seed=5, n_pids=4, max_ops=12)
+    hist = run_concurrent(AtomicCasSUT(spec), prog, seed="mesh-mon")
+    ops = sorted(hist.completed().ops, key=lambda o: o.invoke_time)
+
+    def drive(oracle):
+        frontier = IncrementalFrontier(spec, oracle=oracle)
+        seq = []
+        for op in ops:
+            frontier.append_completed(op)
+            seq.append(int(frontier.advance()))
+        seq.append(int(frontier.check_window()))
+        return seq
+
+    sharded = drive(JaxTPU(spec, budget=200_000,
+                           sharding=batch_sharding(make_mesh(8))))
+    plain = drive(JaxTPU(spec, budget=200_000))
+    assert sharded == plain
+    assert sharded[-1] is not None

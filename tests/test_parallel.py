@@ -1,14 +1,18 @@
 """Mesh batch-sharding: the same kernel, sharded over the 8-device CPU mesh
 (SURVEY.md §5 comm backend — batch-axis DP via NamedSharding; the driver's
-dryrun_multichip exercises the same path)."""
+dryrun_multichip exercises the same path).  The helpers now live in
+``qsm_tpu.mesh`` (ISSUE 19); ``qsm_tpu.parallel`` stays as a deprecation
+re-export and is pinned as such at the bottom.  Full mesh-substrate
+coverage (parity across shapes, planner buckets, batcher targets) is
+tests/test_mesh.py."""
 
 import numpy as np
 import pytest
 
 from qsm_tpu import generate_program, run_concurrent
+from qsm_tpu.mesh import batch_sharding, make_mesh
 from qsm_tpu.models import AtomicCasSUT, CasSpec, RacyCasSUT
 from qsm_tpu.ops.jax_kernel import JaxTPU
-from qsm_tpu.parallel import batch_sharding, make_mesh
 
 
 def _corpus(spec, n):
@@ -72,7 +76,7 @@ def test_make_mesh_subset():
 def test_make_mesh_2d_and_hierarchical_batch_sharding():
     import jax
 
-    from qsm_tpu.parallel import make_mesh_2d
+    from qsm_tpu.mesh import make_mesh_2d
 
     mesh = make_mesh_2d(2, 4)
     assert mesh.devices.shape == (2, 4)
@@ -85,7 +89,24 @@ def test_make_mesh_2d_and_hierarchical_batch_sharding():
 
 
 def test_init_distributed_noop_without_coordinator(monkeypatch):
-    from qsm_tpu.parallel import init_distributed
+    from qsm_tpu.mesh import init_distributed
 
     monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
     assert init_distributed() is False  # single-host: no-op by design
+
+
+def test_parallel_package_is_a_deprecation_reexport():
+    """``qsm_tpu.parallel`` survives ONLY as a thin re-export of
+    ``qsm_tpu.mesh`` (ISSUE 19 satellite: no second copy of mesh logic)
+    — the names must be the SAME objects, and the package must say it is
+    deprecated."""
+    import qsm_tpu.mesh as mesh
+    import qsm_tpu.parallel as parallel
+
+    for name in ("batch_sharding", "init_distributed", "make_mesh",
+                 "make_mesh_2d", "replicated_sharding"):
+        assert getattr(parallel, name) is getattr(mesh, name), name
+    assert "DEPRECATED" in (parallel.__doc__ or "")
+    # the old implementation module is gone, not shadowed
+    with pytest.raises(ImportError):
+        import qsm_tpu.parallel.mesh  # noqa: F401

@@ -44,6 +44,11 @@ sys.path.insert(0, REPO)
 # artifact's rows) become its trend-table facts.  Rates before ratios
 # before counts; gate verdicts always included.
 _HEADLINE_KEYS = (
+    # the MESH artifact's lane-axis trend: best lanes/sec across mesh
+    # widths and the 8-vs-1-width rate ratio (forced host devices on a
+    # 1-core host partition rather than accelerate; the honest gate is
+    # no-collapse, not speedup — docs/MESH.md)
+    "lanes_per_sec", "ratio_d8_vs_d1",
     "histories_per_sec", "h_per_s", "reduction_vs_hand",
     "engine_call_ratio", "call_ratio_batched", "wall_ratio",
     "nodes_ratio", "ratio_n3_vs_n1", "speedup", "ratio", "mean_ratio",

@@ -80,7 +80,7 @@ CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 # interprocedural race analyzer), independent of the window artifacts'
 # ROUND_TAG — renaming those retires banked measurements, renaming this
 # just says which rule set produced the findings.
-LINT_ROUND = "r18"  # family (j): fleet handoff discipline — r18
+LINT_ROUND = "r19"  # family (n): mesh-dispatch discipline — r19
 LINT_ARTIFACT = os.path.join(REPO, f"LINT_{LINT_ROUND}.json")
 
 # Committed archive of the P-compositionality bench (tools/
@@ -172,6 +172,19 @@ SESSIONS_ARTIFACT = os.path.join(REPO,
 # full scan = soak + summary
 SESSIONS_MIN_ROWS = 2
 _SESSIONS_STATE: dict = {"attempted": False}
+
+# Committed archive of the mesh-dispatch bench (tools/bench_mesh.py):
+# HOST-ONLY like the other off-window gates — forced virtual CPU
+# devices stand in for the lane axis, so the lanes/sec-by-width curve,
+# the bit-identical parity verdict across mesh widths 1/2/4/8 and the
+# DECIDED (no longer waived) 3-vs-1-node fleet ratio are all banked
+# without a window — refreshed off-window on CellJournal --resume
+# rails.  Tracks its own round tag (the mesh substrate landed in r19).
+MESH_ROUND = "r19"
+MESH_ARTIFACT = os.path.join(REPO, f"BENCH_MESH_{MESH_ROUND}.json")
+# full scan = oracle + 4 scale widths + parity + 2 fleet + summary
+MESH_MIN_ROWS = 9
+_MESH_STATE: dict = {"attempted": False}
 
 # Cached verdict of the pre-seize lint gate, keyed on a SOURCE
 # fingerprint — not process lifetime: the watcher runs all round while
@@ -402,6 +415,15 @@ def _maybe_archive_sessions(timeout: float = 1500.0) -> None:
     _maybe_archive(_SESSIONS_STATE, SESSIONS_ARTIFACT,
                    "soak_sessions.py", SESSIONS_MIN_ROWS,
                    "sessions_soak", timeout)
+
+
+def _maybe_archive_mesh(timeout: float = 2700.0) -> None:
+    """The mesh-dispatch bench artifact (tools/bench_mesh.py): the
+    lanes/sec-by-mesh-width curve, the cross-width parity verdict at
+    zero wrong verdicts and the decided fleet-scaling gate archived
+    beside the other host-only gates."""
+    _maybe_archive(_MESH_STATE, MESH_ARTIFACT, "bench_mesh.py",
+                   MESH_MIN_ROWS, "mesh_bench", timeout)
 
 
 def _run_window_bench(bench_timeout: float, extra_args, label: str,
@@ -790,6 +812,7 @@ def main() -> int:
         _maybe_archive_monitor()
         _maybe_archive_gen()
         _maybe_archive_sessions()
+        _maybe_archive_mesh()
     while True:
         t0 = time.time()
         _maybe_compact_probe_log()  # bounded; no-op below the threshold
