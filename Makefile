@@ -1,8 +1,8 @@
 # qsm_tpu CI/tooling entry points.
 #
 # `lint-gate` is the static-analysis gate: it runs every registered
-# qsmlint pass family (a–n, docs/ANALYSIS.md) over the full tree,
-# archives the JSON findings document to LINT_r19.json (the artifact
+# qsmlint pass family (a–o, docs/ANALYSIS.md) over the full tree,
+# archives the JSON findings document to LINT_r20.json (the artifact
 # probe_watcher also refreshes before every window seize) and FAILS
 # (exit 1) on any non-whitelisted error-severity finding — including
 # QSM-PROTO-DRIFT when the committed PROTOCOL.json no longer matches a
@@ -13,7 +13,7 @@
 PYTHON ?= python
 # keep in lockstep with tools/probe_watcher.py LINT_ROUND (the watcher
 # archives the same document before every window seize)
-LINT_ARTIFACT ?= LINT_r19.json
+LINT_ARTIFACT ?= LINT_r20.json
 
 # P-compositionality bench (tools/bench_pcomp.py): host-only — no TPU
 # window needed — on CellJournal --resume rails; refreshes the
@@ -80,9 +80,18 @@ SESSIONS_ARTIFACT ?= BENCH_SESSIONS_r18.json
 # devices to DECIDE the previously waived ratio_n3_vs_n1 gate)
 MESH_ARTIFACT ?= BENCH_MESH_r19.json
 
+# Device-work-queue bench (tools/bench_devq.py): host-only — a forced
+# 8-virtual-device CPU mesh stands in for the seized window — on
+# CellJournal --resume rails; refreshes the committed BENCH_DEVQ
+# artifact (four planes banked, a simulated window drained in score
+# order with every verdict re-proved by a fresh host oracle at ZERO
+# wrong verdicts, SIGKILL-mid-drain exactly-once resume, the matched
+# host-ladder baseline, and window_utilization >= 0.8; docs/WINDOWS.md)
+DEVQ_ARTIFACT ?= BENCH_DEVQ_r20.json
+
 .PHONY: lint-gate lint-changed lint-sarif protocol test bench-pcomp \
 	bench-shrink bench-obs bench-fleet bench-monitor bench-gen \
-	soak-sessions bench-mesh bench-report
+	soak-sessions bench-mesh bench-devq bench-report
 
 lint-gate:
 	$(PYTHON) -m qsm_tpu lint --json --out $(LINT_ARTIFACT)
@@ -135,6 +144,12 @@ soak-sessions:
 bench-mesh:
 	$(PYTHON) tools/bench_mesh.py \
 		--out $(MESH_ARTIFACT) --resume
+
+# same no-pin rationale as bench-mesh: the simulated window children
+# get their forced device count from forced_host_device_env
+bench-devq:
+	$(PYTHON) tools/bench_devq.py \
+		--out $(DEVQ_ARTIFACT) --resume
 
 # Aggregate every committed BENCH_*.json into one per-round trend
 # table (BENCH_REPORT.md + BENCH_REPORT.json, atomic + deterministic)

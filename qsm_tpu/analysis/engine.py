@@ -120,6 +120,11 @@ DEFAULT_RACE_FILES = (
     # the durable-session chaos soak: worker threads drive per-thread
     # clients while the rig SIGKILLs and respawns the fleet under them
     "qsm_tpu/gen/soak.py",
+    # the device-work queue: banked from connection threads (the check/
+    # pcomp seams), the gossip beat thread (anti-entropy) and the
+    # shrink/monitor planes, drained by the watcher's drain process —
+    # one shared RLock'd structure across the whole closed program
+    "qsm_tpu/devq/queue.py", "qsm_tpu/devq/drain.py",
     "tools/bench_serve.py", "tools/bench_pcomp.py",
     "tools/bench_shrink.py", "tools/bench_fleet.py",
     "tools/probe_watcher.py", "tools/soak_prune.py",
@@ -176,6 +181,9 @@ DEFAULT_OBS_FILES = (
     "qsm_tpu/monitor/store.py",
     "qsm_tpu/ingest/adapters.py", "qsm_tpu/ingest/edn.py",
     "qsm_tpu/ingest/specmap.py", "qsm_tpu/ingest/tail.py",
+    # the devq plane: the server's devq verbs emit obs events and the
+    # drain report feeds the window_utilization SLO objective
+    "qsm_tpu/devq/queue.py", "qsm_tpu/devq/drain.py",
     "tools/bench_obs.py", "tools/bench_fleet.py",
     "tools/bench_monitor.py")
 
@@ -197,6 +205,14 @@ DEFAULT_MESH_FILES = (
     "qsm_tpu/ops/jax_kernel.py", "qsm_tpu/search/planner.py",
     "qsm_tpu/serve/batcher.py",
     "tools/bench_mesh.py")
+
+# the device-work-queue scan set (family o): the queue + drain plane
+# itself and its window/bench drivers (ISSUE 20).  monitor/session.py
+# has a ``_drain`` of its own (the reorder-buffer flush) and is
+# deliberately NOT here — its bounds are family (k)'s jurisdiction.
+DEFAULT_DEVQ_FILES = (
+    "qsm_tpu/devq/queue.py", "qsm_tpu/devq/drain.py",
+    "tools/window_drain.py", "tools/bench_devq.py")
 
 # the wire-contract scan set (family l): the contract source, every
 # module that dispatches or sends protocol ops, the helpers whose
@@ -413,6 +429,12 @@ def _per_file_mesh(path: str, root: str) -> List[Finding]:
     return check_mesh_file(path, root=root)
 
 
+def _per_file_devq(path: str, root: str) -> List[Finding]:
+    from .devq_passes import check_devq_file
+
+    return check_devq_file(path, root=root)
+
+
 def _run_protocol(ctx: _LintRun, files: List[str]) -> List[Finding]:
     # one extraction serves both the conformance passes and the
     # report's ``protocol`` summary block (bench_report trends it);
@@ -528,6 +550,16 @@ FAMILIES: Dict[str, Family] = {f.fid: f for f in (
                  "counts, no host transfer inside sharded dispatch)",
            files=DEFAULT_MESH_FILES, per_file=_per_file_mesh,
            triggers=("qsm_tpu/analysis/mesh_passes.py",
+                     "qsm_tpu/analysis/astutil.py")),
+    Family(fid="o", key="devq",
+           title="device-work-queue discipline (bounded banked work, "
+                 "deadline-consulting drain loops)",
+           files=DEFAULT_DEVQ_FILES, per_file=_per_file_devq,
+           triggers=("qsm_tpu/analysis/devq_passes.py",
+                     # family o's scan shares family k's class scan
+                     # and family m's ownership refinement
+                     "qsm_tpu/analysis/monitor_passes.py",
+                     "qsm_tpu/analysis/gen_passes.py",
                      "qsm_tpu/analysis/astutil.py")),
 )}
 

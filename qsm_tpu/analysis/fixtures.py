@@ -1082,3 +1082,88 @@ class DeviceResidentDispatchStub:
         import numpy as np
 
         return [np.asarray(s) for s in shards]
+
+
+# --- family (o): device-work-queue fixtures -------------------------------
+#
+# Never executed; tests point the devq AST pass at this file and assert
+# each rule fires on its seeded stub and stays silent on the sanctioned
+# twin (tests/test_lint.py).
+
+
+class UnboundedDevqStub:
+    """Seeded bug for QSM-DEVQ-UNBOUNDED: a work queue every plane on
+    every fleet node feeds, growing a pending map and a done log with
+    NO cap comparison and NO eviction anywhere in the class — the
+    family-(k) OOM pathology recurring at the fleet's shared choke
+    point."""
+
+    def __init__(self):
+        self.pending = []
+        self.done = []
+
+    def put(self, item):
+        self.pending.append(item)        # <-- bug: no cap, no eviction
+        self.done.append(item.key)       # <-- bug: tombstones unbounded
+
+
+class BoundedDevqStub:
+    """The sanctioned twin the devq pass must NOT flag: pending is
+    capped by lowest-score eviction (the queue.py ``_evict_over_cap``
+    shape) and the done-tombstone log is pruned to a tail window —
+    must stay CLEAN under QSM-DEVQ-UNBOUNDED."""
+
+    CAP = 512
+
+    def __init__(self):
+        self.pending = []
+        self.done = []
+
+    def put(self, item):
+        self.pending.append(item)
+        if len(self.pending) > self.CAP:          # explicit cap
+            self.pending.pop(0)                   # lowest-score evict
+        self.done.append(item.key)
+        self.done = self.done[-4 * self.CAP:]     # tail-window prune
+
+
+class DeadlineBlindDrainStub:
+    """Seeded bug for QSM-DEVQ-DRAIN: a drain loop that runs until the
+    queue empties without ever consulting the window deadline — a
+    snatched-away chip leaves it wedged on a device it no longer
+    owns."""
+
+    def drain_queue(self, queue):
+        results = {}
+        while queue.pending():                   # <-- bug: no deadline
+            item = queue.pending()[0]
+            results[item.key] = self.dispatch(item)
+            queue.mark_done(item.key)
+        return results
+
+    def dispatch(self, item):
+        return 1
+
+
+class DeadlineGatedDrainStub:
+    """The sanctioned twin: every iteration consults the remaining
+    window time before starting an item (the drain.py
+    ``DrainScheduler.drain`` shape) — must stay CLEAN under
+    QSM-DEVQ-DRAIN."""
+
+    def __init__(self, window_end):
+        self.window_end = window_end
+
+    def drain_queue(self, queue, now):
+        results = {}
+        while queue.pending():
+            remaining = self.window_end - now()  # deadline consulted
+            if remaining <= 0.0:
+                break
+            item = queue.pending()[0]
+            results[item.key] = self.dispatch(item)
+            queue.mark_done(item.key)
+        return results
+
+    def dispatch(self, item):
+        return 1

@@ -1,0 +1,31 @@
+"""Window arbitrage: a persistent device-work queue + drain scheduler.
+
+Device windows are rare (probe reality: 9 hits in 717 probes) while
+device-worthy work is continuous — so the two are decoupled, the way
+OmniLink-style trace validation decouples capture from checking.
+Planes BANK work into a fleet-replicated :class:`.queue.DeviceWorkQueue`
+at their natural seams; when a window lands, :class:`.drain
+.DrainScheduler` spends ALL of it on the backlog and banks every
+oracle-re-proved verdict under the exact fingerprint the originating
+plane will hit next.  docs/WINDOWS.md is the contract; wire ops
+``devq.put/digests/pull/drain_report`` extend PROTOCOL.json.
+"""
+
+from .drain import DEFAULT_WINDOW_S, DrainScheduler
+from .queue import (DEFAULT_CAP, PLANES, DeviceWorkQueue, WorkItem,
+                    bank_histories, global_devq, item_fingerprint,
+                    note_device_plan, set_global_devq)
+
+__all__ = [
+    "DEFAULT_CAP",
+    "DEFAULT_WINDOW_S",
+    "DeviceWorkQueue",
+    "DrainScheduler",
+    "PLANES",
+    "WorkItem",
+    "bank_histories",
+    "global_devq",
+    "item_fingerprint",
+    "note_device_plan",
+    "set_global_devq",
+]

@@ -65,10 +65,16 @@ class GossipAgent:
                  interval_s: float = 2.0,
                  max_segments: int = 16,
                  obs=None,
+                 devq=None,
                  rng: Optional[random.Random] = None):
         self.node_id = str(node_id)
         self.replog = replog
         self.cache = cache
+        # the device-work queue (qsm_tpu/devq): when this node runs
+        # one, every exchange reconciles its segment log too over the
+        # devq.* ops — banked work AND done tombstones converge
+        # fleet-wide, so any node's window drains everyone's backlog
+        self.devq = devq
         self.policy = policy or preset("gossip")
         self.fanout = max(1, int(fanout))
         self.interval_s = float(interval_s)
@@ -88,6 +94,8 @@ class GossipAgent:
         self.segments_pushed = 0
         self.segments_subsumed = 0   # ships skipped: rows already held
         self.rows_pulled = 0
+        self.devq_pulled = 0         # devq segments adopted from peers
+        self.devq_pushed = 0         # devq segments shipped to peers
         if peers:
             self.set_peers(peers)
 
@@ -284,7 +292,74 @@ class GossipAgent:
                  "segments": [{"name": name, "fingerprint": fp,
                                "lines": lines}]}, t())
             pushed += int(ack.get("adopted", 0))
+
+        # devq leg (qsm_tpu/devq): same digest→pull shape over the
+        # queue's own segment log, push via idempotent devq.put/
+        # drain_report row payloads (item keys dedupe, done absorbs).
+        if self.devq is not None:
+            dq_pulled, dq_pushed = self._exchange_devq(link, t, deadline)
+            with self._lock:
+                self.devq_pulled += dq_pulled
+                self.devq_pushed += dq_pushed
         return pulled, pushed, subsumed, rows
+
+    def _exchange_devq(self, link, t, deadline: float) -> Tuple[int, int]:
+        """Reconcile the device-work queue's segment log with one peer:
+        pull devq segments we lack (fingerprint-verified adopt folds
+        items/tombstones into the live queue), then push the ones the
+        peer lacks via ``devq.put`` of their row payloads — put dedupes
+        by item key, so the push is idempotent.  A peer that runs no
+        devq answers an error; skipped, not a fault."""
+        import time as _time
+
+        resp = link.request({"op": "devq.digests"}, t())
+        if not resp.get("ok"):
+            return 0, 0
+        theirs = dict(resp.get("digests") or {})
+        pulled = pushed = 0
+        want = self.devq.missing(theirs)[:self.max_segments]
+        if want:
+            got = link.request({"op": "devq.pull",
+                                "segments": want}, t())
+            for seg in got.get("segments") or []:
+                try:
+                    if self.devq.adopt(str(seg.get("name")),
+                                       str(seg.get("fingerprint")),
+                                       list(seg.get("lines") or [])):
+                        pulled += 1
+                except (ValueError, OSError):
+                    continue
+        mine = self.devq.digests()
+        lack = [n for n in sorted(mine) if n not in theirs]
+        for name in lack[:self.max_segments]:
+            if _time.monotonic() >= deadline:
+                break
+            try:
+                fp, lines = self.devq.read_segment(name)
+            except (KeyError, TypeError):
+                continue
+            if lines is None:
+                continue
+            import json as _json
+
+            items, done = [], []
+            for line in lines:
+                try:
+                    row = _json.loads(line)
+                except ValueError:
+                    continue
+                if row.get("done"):
+                    done.append(str(row.get("key")))
+                elif isinstance(row.get("item"), dict):
+                    items.append(row["item"])
+            if items:
+                ack = link.request({"op": "devq.put", "items": items},
+                                   t())
+                pushed += int(ack.get("banked", 0) or 0)
+            if done:
+                link.request({"op": "devq.drain_report", "done": done},
+                             t())
+        return pulled, pushed
 
     # -- observability -------------------------------------------------
     def snapshot(self) -> dict:
@@ -298,4 +373,6 @@ class GossipAgent:
                     "segments_pushed": self.segments_pushed,
                     "segments_subsumed": self.segments_subsumed,
                     "rows_pulled": self.rows_pulled,
+                    "devq_pulled": self.devq_pulled,
+                    "devq_pushed": self.devq_pushed,
                     "policy": self.policy.name}

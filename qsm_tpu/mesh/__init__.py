@@ -10,8 +10,8 @@ from .dispatch import (backend_sharding, mesh_bucket_ladder,
                        mesh_slots_table, sharded_backend)
 from .topology import (LANE_AXIS, batch_sharding, init_distributed,
                        lane_sharding_of, make_mesh, make_mesh_2d,
-                       mesh_device_count, mesh_shape_key,
-                       replicated_sharding)
+                       mesh_device_count, mesh_from_devices,
+                       mesh_shape_key, replicated_sharding)
 
 __all__ = [
     "LANE_AXIS",
@@ -23,6 +23,7 @@ __all__ = [
     "make_mesh_2d",
     "mesh_bucket_ladder",
     "mesh_device_count",
+    "mesh_from_devices",
     "mesh_shape_key",
     "mesh_slots_table",
     "replicated_sharding",

@@ -90,6 +90,32 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = LANE_AXIS):
     return Mesh(np.asarray(devs), (axis,))
 
 
+def mesh_from_devices(devices: Sequence, axis: str = LANE_AXIS):
+    """A 1-D lane mesh over an EXPLICIT device list — the constructor a
+    live seized window needs.
+
+    ``make_mesh(n)`` slices ``jax.devices()[:n]``: correct when the
+    caller owns the whole process, wrong for a window drain, where the
+    devices that actually answered the probe are the only ones safe to
+    schedule on (a snatched-away chip must not be in the mesh at all).
+    The drain scheduler therefore derives its mesh from the window's
+    probed device SET, never from a forced count (ISSUE 20 bugfix;
+    pinned by tests/test_mesh.py::test_mesh_from_devices_*).
+
+    Accepts jax Device objects (preserved in order, duplicates refused —
+    a mesh with one chip twice would double-count lanes silently)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = list(devices)
+    if not devs:
+        raise ValueError("mesh_from_devices: empty device set "
+                         "(a window with no probed devices has no mesh)")
+    if len({id(d) for d in devs}) != len(devs):
+        raise ValueError("mesh_from_devices: duplicate devices")
+    return Mesh(np.asarray(devs), (axis,))
+
+
 def make_mesh_2d(n_hosts: int, per_host: int,
                  axes: Sequence[str] = ("host", LANE_AXIS)):
     """A (host, device) mesh: dim 0 maps hosts (DCN between real hosts),

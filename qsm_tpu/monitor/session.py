@@ -313,6 +313,7 @@ class MonitorSession:
         if verdict == int(Verdict.VIOLATION) and not self.flipped:
             self.flipped = True
             self.flip_rows = [list(r) for r in self.rows]
+            self._bank_recheck()
         return verdict
 
     def close(self) -> int:
@@ -405,6 +406,19 @@ class MonitorSession:
                 fdoc, fspec, bank=bank, node_budget=node_budget,
                 max_states=max_states)
         return s
+
+    # -- the devq seam --------------------------------------------------
+    def _bank_recheck(self) -> None:
+        """Monitor seam (qsm_tpu/devq): a flip is terminal and snapshot-
+        backed, but it was decided incrementally — bank the flipped
+        stream so the next seized window re-proves it as ONE whole-
+        history check (the strongest cross-examination the incremental
+        frontier can get).  Free (one global read) without a queue."""
+        from ..devq.queue import bank_histories, global_devq
+
+        if global_devq() is None or not self.rows:
+            return
+        bank_histories(self.spec, [self.history()], plane="monitor")
 
     # -- introspection --------------------------------------------------
     def history(self) -> History:

@@ -320,6 +320,43 @@ class SloEvaluator:
                     "evaluations": self.evaluations}
 
 
+#: The window-utilization target (ISSUE 20): a drained window should
+#: spend at least this fraction of its wall clock in engine dispatch.
+WINDOW_UTILIZATION_TARGET = 0.8
+
+
+def utilization_objective(value,
+                          target: float = WINDOW_UTILIZATION_TARGET
+                          ) -> dict:
+    """``window_utilization`` as one more objective row (qsm_tpu/devq).
+
+    Shaped exactly like :meth:`SloEvaluator._evaluate_one`'s rows so
+    the ``health`` verb can append it to a configured objective table
+    (or report it alone).  This is a LOWER-bound objective — burn is
+    target/measured, >1 means the last window wasted device time — and
+    ``value=None`` (no window drained yet) reports zero samples and
+    burns 0: rare windows are the subsystem's premise, their absence
+    is never an incident."""
+    if value is None:
+        burn, samples, status, row_value = 0.0, 0, "ok", None
+    else:
+        v = float(value)
+        burn = (target / v) if v > 0 else float("inf")
+        samples = 1
+        if burn > 1.25:
+            status = "breach"
+        elif burn > 1.0:
+            status = "degraded"
+        else:
+            status = "ok"
+        row_value = round(v, 4)
+        burn = round(burn, 4) if burn != float("inf") else burn
+    return {"objective": f"window_utilization>={target}",
+            "kind": "utilization", "target": target,
+            "value": row_value, "burn_rate": burn,
+            "samples": samples, "status": status}
+
+
 def worst_status(statuses) -> str:
     """The fleet-health fold: the most severe of a set of statuses
     (unknown strings read as ``degraded`` — an unreachable node is a

@@ -378,6 +378,14 @@ def build_backend(spec, plan: SearchPlan, budget: int = 2_000, **device_kw):
 
         device_kw["sharding"] = batch_sharding(
             make_mesh(plan.mesh_devices))
+    if plan.mesh_devices > 1:
+        # the planner seam (qsm_tpu/devq): a mesh-sized plan says the
+        # device pays — bank a warmup item so the next seized window
+        # pre-compiles this plan's @meshN bucket ladder.  No-op (and
+        # no import cost beyond the cached module) without a queue.
+        from ..devq.queue import note_device_plan
+
+        note_device_plan(spec, plan)
 
     def make_core(s):
         if not plan.decompose:

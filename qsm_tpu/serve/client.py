@@ -212,6 +212,43 @@ class CheckClient:
                                  "id": f"q{next(_ids)}",
                                  "node": str(node)})
 
+    # -- the device-work queue (qsm_tpu/devq, docs/WINDOWS.md) ---------
+    def devq_put(self, items) -> dict:
+        """Bank device-worthy work items (``devq.put``): any fleet node
+        can feed the queue a window host later drains.  Idempotent —
+        items dedupe by their fingerprint key."""
+        return self._round_trip({"op": "devq.put",
+                                 "id": f"q{next(_ids)}",
+                                 "items": list(items)})
+
+    def devq_digests(self) -> dict:
+        """The queue's anti-entropy advertisement (``devq.digests``):
+        segment digests of the devq log plus a queue snapshot."""
+        return self._round_trip({"op": "devq.digests",
+                                 "id": f"q{next(_ids)}"})
+
+    def devq_pull(self, segments) -> dict:
+        """Ship devq segments out (``devq.pull``) — fingerprint-
+        verified by the adopting side, like ``replog.pull``."""
+        return self._round_trip({"op": "devq.pull",
+                                 "id": f"q{next(_ids)}",
+                                 "segments": list(segments)})
+
+    def devq_drain_report(self, report: Optional[dict] = None,
+                          rows=None, done=None) -> dict:
+        """Hand a drained window back (``devq.drain_report``): verdict
+        rows bank under their originating fingerprints, drained keys
+        tombstone as done, the report feeds the ``window_utilization``
+        SLO.  With no arguments, reads the node's last report."""
+        req = {"op": "devq.drain_report", "id": f"q{next(_ids)}"}
+        if report is not None:
+            req["report"] = report
+        if rows:
+            req["rows"] = [list(r) for r in rows]
+        if done:
+            req["done"] = list(done)
+        return self._round_trip(req)
+
     # -- fleet observability (docs/OBSERVABILITY.md "Fleet") -----------
     def health(self) -> dict:
         """The ``health`` op: SLO status of the server/router (and,

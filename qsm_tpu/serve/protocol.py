@@ -186,6 +186,7 @@ OPS = (
     "obs.spans", "obs.trace", "obs.metrics", "health",
     "lease.acquire", "lease.renew", "lease.release", "lease.read",
     "node.join", "node.leave",
+    "devq.put", "devq.digests", "devq.pull", "devq.drain_report",
 )
 
 # Ops that MAY legally sit on a retrying call path (CheckClient
@@ -206,6 +207,12 @@ OPS = (
 #   node.join/leave   — membership set-union/difference: re-adding a
 #                       present node or removing an absent one is a
 #                       no-op rebuild of the same ring
+#   devq.*            — put dedupes by item fingerprint (a replayed put
+#                       of a pending/done key is a no-op), digests/pull
+#                       are anti-entropy reads, drain_report banks
+#                       fingerprint-keyed verdicts (set-union) + marks
+#                       done tombstones (absorbing), so a replay
+#                       re-banks identical rows
 # ``shutdown`` is deliberately ABSENT: re-sending it after a mid-flight
 # failover could stop a *different* process than the one addressed, so
 # the client sends it on a single non-retrying attempt
@@ -219,6 +226,7 @@ IDEMPOTENT_OPS = (
     "obs.spans", "obs.trace", "obs.metrics", "health",
     "lease.acquire", "lease.renew", "lease.release", "lease.read",
     "node.join", "node.leave",
+    "devq.put", "devq.digests", "devq.pull", "devq.drain_report",
 )
 
 # Envelope keys: request keys any sender may attach / response keys

@@ -220,6 +220,8 @@ class CheckServer:
                  lease_path: Optional[str] = None,
                  slo: Optional[str] = None,
                  slo_window_s: float = 60.0,
+                 devq_dir: Optional[str] = None,
+                 devq_cap: int = 512,
                  mesh_devices: int = 1):
         if engine not in ("auto", "planned"):
             raise ValueError(f"unknown serve engine {engine!r}; "
@@ -331,6 +333,23 @@ class CheckServer:
             from ..fleet.lease import FileLeaseStore
 
             self.lease_store = FileLeaseStore(lease_path)
+        # device-work queue (qsm_tpu/devq, docs/WINDOWS.md): with a
+        # devq_dir this node banks device-worthy work — its own seams
+        # (oversize admission, the pcomp split, shrink rounds, monitor
+        # appends reach it through the process-global hook) plus the
+        # devq.* wire ops, so ANY fleet node can bank work this or some
+        # other node's window later drains.  The queue rides its own
+        # SegmentedLog (a second replog row domain); gossip grows a
+        # devq exchange leg when both are configured.
+        self.devq = None
+        self.devq_report: Optional[dict] = None  # last drained window
+        if devq_dir is not None:
+            from ..devq import DeviceWorkQueue, set_global_devq
+
+            self.devq = DeviceWorkQueue(devq_dir,
+                                        node_id=node_id or "n0",
+                                        cap=devq_cap)
+            set_global_devq(self.devq)
         self.admission = AdmissionController(
             queue_depth=queue_depth, policy=self.policy,
             pool_state=self.pool.shed_state if self.pool else None)
@@ -407,7 +426,8 @@ class CheckServer:
             self.gossip = GossipAgent(
                 self.node_id or "n0", self.replog, self.cache,
                 peers=peers, interval_s=self._gossip_interval,
-                fanout=self._gossip_fanout, obs=self.obs)
+                fanout=self._gossip_fanout, obs=self.obs,
+                devq=self.devq)
         else:
             self.gossip.set_peers(peers)
 
@@ -515,6 +535,11 @@ class CheckServer:
             self._metrics_server = None
         if global_obs() is self.obs:
             set_global(None)
+        if self.devq is not None:
+            from ..devq import global_devq, set_global_devq
+
+            if global_devq() is self.devq:
+                set_global_devq(None)
         self.obs.close()
         self._stopped.set()
 
@@ -680,6 +705,15 @@ class CheckServer:
         elif op in ("replog.digests", "replog.pull", "replog.push",
                     "replog.covers", "replog.subsumed"):
             self._handle_replog(conn, op, req)
+        elif op in ("devq.put", "devq.digests", "devq.pull",
+                    "devq.drain_report"):
+            try:
+                self._handle_devq(conn, op, req)
+            except OSError:
+                raise
+            except Exception as e:  # noqa: BLE001 — answer, don't die
+                self._send(conn, {"id": req.get("id"), "ok": False,
+                                  "error": f"{type(e).__name__}: {e}"})
         elif op == "gossip.peers":
             self._handle_gossip_peers(conn, req)
         elif op in ("lease.acquire", "lease.renew", "lease.release",
@@ -782,18 +816,36 @@ class CheckServer:
         """The ``health`` op payload: per-objective burn rates and an
         overall status (obs/slo.py), or plain liveness when no SLO is
         configured — the status maps to `qsm-tpu health`'s pinned exit
-        codes either way."""
+        codes either way.  A node running a device-work queue folds
+        ``window_utilization`` in as one more objective (ISSUE 20): the
+        last drained window's utilization against the 0.8 target, with
+        no-windows-yet reported as zero samples, NOT a breach — rare
+        windows are the premise, their absence is not an incident."""
         if self.slo is None:
-            return {"status": "ok",
-                    "slo": {"configured": False},
-                    "uptime_s": round(time.monotonic() - self._t0, 1)}
-        doc = self.slo.evaluate()
-        return {"status": doc["status"],
-                "slo": {"configured": True,
-                        "window_s": doc["window_s"],
-                        "window_actual_s": doc["window_actual_s"],
-                        "objectives": doc["objectives"]},
-                "uptime_s": round(time.monotonic() - self._t0, 1)}
+            doc = {"status": "ok",
+                   "slo": {"configured": False},
+                   "uptime_s": round(time.monotonic() - self._t0, 1)}
+        else:
+            ev = self.slo.evaluate()
+            doc = {"status": ev["status"],
+                   "slo": {"configured": True,
+                           "window_s": ev["window_s"],
+                           "window_actual_s": ev["window_actual_s"],
+                           "objectives": ev["objectives"]},
+                   "uptime_s": round(time.monotonic() - self._t0, 1)}
+        if self.devq is not None:
+            from ..obs.slo import utilization_objective
+            from ..obs.slo import worst_status as _worst
+
+            row = utilization_objective(
+                (self.devq_report or {}).get("window_utilization"))
+            doc["devq"] = {"pending": len(self.devq),
+                           "window_utilization": row}
+            doc["status"] = _worst([doc["status"], row["status"]])
+            if self.slo is not None:
+                doc["slo"]["objectives"] = (
+                    list(doc["slo"]["objectives"]) + [row])
+        return doc
 
     # -- the replog anti-entropy ops (fleet/replog.py) -----------------
     def _handle_replog(self, conn: socket.socket, op: str,
@@ -887,6 +939,84 @@ class CheckServer:
         if errors:
             doc["errors"] = errors
         self._send(conn, doc)
+
+    # -- the device-work-queue ops (qsm_tpu/devq) ----------------------
+    def _handle_devq(self, conn: socket.socket, op: str,
+                     req: dict) -> None:
+        """The window-arbitrage surface (docs/WINDOWS.md): ``put`` banks
+        fingerprint-keyed work items (dedup by item key — a replayed put
+        is a no-op), ``digests``/``pull`` are the queue's anti-entropy
+        legs mirroring ``replog.*`` over the devq segment log, and
+        ``drain_report`` is how a window host hands a drained window
+        back — verdict rows bank into THIS node's cache under their
+        originating fingerprints (set-union), drained item keys
+        tombstone as done (absorbing), and the report feeds the
+        ``window_utilization`` SLO the ``health`` verb reports.  Sent
+        with no body, ``drain_report`` reads the last banked report."""
+        if self.devq is None:
+            self._send(conn, {"id": req.get("id"), "ok": False,
+                              "error": "node runs no device-work queue "
+                                       "(start with devq_dir)"})
+            return
+        if op == "devq.put":
+            banked = 0
+            errors: List[str] = []
+            for doc in list(req.get("items") or [])[:64]:
+                try:
+                    if self.devq.put_doc(dict(doc)):
+                        banked += 1
+                except (KeyError, ValueError, TypeError) as e:
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+            self.obs.event("devq.put", banked=banked,
+                           pending=len(self.devq))
+            doc = {"id": req.get("id"), "ok": True, "banked": banked,
+                   "pending": len(self.devq)}
+            if errors:
+                doc["errors"] = errors
+            self._send(conn, doc)
+            return
+        if op == "devq.digests":
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "digests": self.devq.digests(),
+                              "queue": self.devq.snapshot()})
+            return
+        if op == "devq.pull":
+            segments = []
+            for name in list(req.get("segments") or [])[:64]:
+                got = self.devq.read_segment(str(name))
+                if got is not None:
+                    segments.append({"name": str(name),
+                                     "fingerprint": got[0],
+                                     "lines": got[1]})
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "segments": segments})
+            return
+        if op == "devq.drain_report":
+            # bank + tombstone (write form) or read the last report
+            # back (empty body — what `health`/tools poll)
+            report = req.get("report")
+            rows_in = req.get("rows") or []
+            done_in = req.get("done") or []
+            if report is None and not rows_in and not done_in:
+                self._send(conn, {"id": req.get("id"), "ok": True,
+                                  "report": self.devq_report})
+                return
+            if report is not None:
+                self.devq_report = dict(report)
+            self.cache.put_many(
+                (str(r[0]), int(r[1]), r[2] if len(r) > 2 else None)
+                for r in rows_in)
+            done = 0
+            for key in done_in:
+                if self.devq.mark_done(str(key)):
+                    done += 1
+            self.obs.event("devq.drain_report", rows=len(rows_in),
+                           done=done,
+                           utilization=(report or {}).get(
+                               "window_utilization"))
+            self._send(conn, {"id": req.get("id"), "ok": True,
+                              "rows": len(rows_in), "done": done,
+                              "pending": len(self.devq)})
 
     def _handle_gossip_peers(self, conn: socket.socket,
                              req: dict) -> None:
@@ -1000,6 +1130,15 @@ class CheckServer:
         # lanes it cannot use
         entry = self._engine_for(model, spec_kwargs)
         spec_key = self._spec_key(model, spec_kwargs)
+        if self.devq is not None and len(hists) >= self.max_lanes:
+            # the admission seam (qsm_tpu/devq): an oversize corpus is
+            # exactly what a device window pays for — bank a copy for
+            # the drain scheduler (side channel; THIS request still
+            # serves on the host path right now, shed or not)
+            from ..devq import bank_histories
+
+            bank_histories(entry.spec, hists, plane="check",
+                           queue=self.devq)
         if not self.admission.try_admit(len(hists)):
             self._respond(conn, self._shed(req, "queue full", trace,
                                            root), trace, root, t_req)
@@ -1217,6 +1356,15 @@ class CheckServer:
         with self._pcomp_lock:
             self.pcomp_split += 1
             self.pcomp_subs += len(subs)
+        if self.devq is not None:
+            # the pcomp seam (qsm_tpu/devq): the validated per-key
+            # sub-lane group banks under the PROJECTED spec — the same
+            # fingerprints the sub-lane cache rows use below, so a
+            # window drain pre-answers this exact split next time
+            from ..devq import bank_histories
+
+            bank_histories(entry.proj, [subs[k] for k in sorted(subs)],
+                           plane="pcomp", queue=self.devq)
         split_span = self.obs.event("pcomp.split", trace=trace,
                                     parent=parent, keys=len(subs),
                                     ops=len(h))
@@ -1952,6 +2100,18 @@ class CheckServer:
             # count — None unless --slo configured objectives
             "slo": (self.slo.snapshot()
                     if self.slo is not None else None),
+            # device-work queue (qsm_tpu/devq): banked/pending/evicted
+            # counts plus the last drained window's headline — None
+            # unless --devq-dir configured the queue
+            "devq": ({**self.devq.snapshot(),
+                      "last_window": (
+                          {"window_id": self.devq_report.get(
+                              "window_id"),
+                           "drained": self.devq_report.get("drained"),
+                           "window_utilization": self.devq_report.get(
+                               "window_utilization")}
+                          if self.devq_report is not None else None)}
+                     if self.devq is not None else None),
             # fault-plane hits in THIS process (resilience/faults.py) —
             # zeros/empty unless someone is fault-drilling the server
             "faults": fired_snapshot(),

@@ -174,6 +174,22 @@ class Shrinker:
         return [int(v) for v in out]
 
     # ------------------------------------------------------------------
+    def _bank_undecided(self, hists: List[History]) -> None:
+        """Shrink seam (qsm_tpu/devq): a round's BUDGET_EXCEEDED
+        candidates are exactly the lanes a seized device window can
+        afford to decide — bank them so the next drain settles the
+        frontier and the memo answers on a retried shrink.  Free (one
+        global read) when no queue is configured."""
+        from ..devq.queue import bank_histories, global_devq
+
+        if global_devq() is None:
+            return
+        if getattr(self.spec, "spec_kwargs", None) is None:
+            return  # not registry-reconstructible: the drain could
+            # never rebuild this spec, so the item would be dead weight
+        bank_histories(self.spec, hists, plane="shrink")
+
+    # ------------------------------------------------------------------
     def run(self, history: History) -> ShrinkResult:
         first = self._verdicts([history])
         if first is None:
@@ -224,6 +240,10 @@ class Shrinker:
                 break
             self.rounds += 1
             last_frontier, last_verdicts = cands, verdicts
+            undecided_now = [c.history for c, v in zip(cands, verdicts)
+                             if v == int(Verdict.BUDGET_EXCEEDED)]
+            if undecided_now:
+                self._bank_undecided(undecided_now)
             fail = next((i for i, v in enumerate(verdicts)
                          if v == int(Verdict.VIOLATION)), None)
             if fail is None:

@@ -492,7 +492,8 @@ def cmd_serve(args) -> int:
         max_sessions=args.max_sessions,
         session_dir=args.session_dir,
         lease_path=args.lease_host,
-        slo=args.slo, slo_window_s=args.slo_window)
+        slo=args.slo, slo_window_s=args.slo_window,
+        devq_dir=args.devq_dir, devq_cap=args.devq_cap)
     warm = [m.strip() for m in args.warm.split(",")] if args.warm else []
     warm = [m for m in warm if m]
     unknown = sorted(set(warm) - set(MODELS))
@@ -509,6 +510,7 @@ def cmd_serve(args) -> int:
                           "engine": args.engine,
                           "node": args.node_id,
                           "replog": args.replog_dir,
+                          "devq": args.devq_dir,
                           "peers": peers or None,
                           "workers": args.workers,
                           "max_lanes": args.max_lanes,
@@ -566,6 +568,9 @@ def cmd_fleet(args) -> int:
                 cmd += ["--session-dir",
                         os.path.join(args.session_root, f"n{i}"),
                         "--max-sessions", str(args.max_sessions)]
+            if args.devq_root:
+                cmd += ["--devq-dir",
+                        os.path.join(args.devq_root, f"n{i}")]
             if args.workers:
                 cmd += ["--workers", str(args.workers)]
             if args.warm:
@@ -2072,6 +2077,18 @@ def main(argv=None) -> int:
                         "(fleet/lease.py)")
     p.add_argument("--slo-window", type=float, default=60.0,
                    help="SLO sliding-window seconds")
+    p.add_argument("--devq-dir", default=None, metavar="DIR",
+                   help="persistent device-work queue (qsm_tpu/devq, "
+                        "docs/WINDOWS.md): every plane banks device-"
+                        "worthy work under DIR as fingerprint-keyed "
+                        "items; a seized TPU window drains it in "
+                        "score order and the verdicts land in the "
+                        "cache.  Gossips with --peers; serves "
+                        "devq.put/digests/pull/drain_report")
+    p.add_argument("--devq-cap", type=int, default=512,
+                   help="pending device-work items kept (lowest-"
+                        "score eviction past the cap; tombstones "
+                        "persist so evicted work re-banks cleanly)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -2103,6 +2120,12 @@ def main(argv=None) -> int:
                         "store under DIR/<node-id> (serve "
                         "--session-dir): sessions survive node "
                         "restarts and cap eviction")
+    p.add_argument("--devq-root", default=None, metavar="DIR",
+                   help="give each spawned node a persistent device-"
+                        "work queue under DIR/<node-id> (serve "
+                        "--devq-dir); with gossip on, banked work "
+                        "converges fleet-wide so ANY node's seized "
+                        "window can drain it (docs/WINDOWS.md)")
     p.add_argument("--session-journal", default=None, metavar="DIR",
                    help="durable ROUTER session journals "
                         "(monitor/store.py): point the active and "

@@ -15,7 +15,8 @@ import pytest
 import qsm_tpu.analysis.fixtures as fixtures
 from qsm_tpu.analysis import (ERROR, FAMILIES, Finding, Whitelist,
                               run_lint)
-from qsm_tpu.analysis.engine import (DEFAULT_FLEET_FILES,
+from qsm_tpu.analysis.engine import (DEFAULT_DEVQ_FILES,
+                                     DEFAULT_FLEET_FILES,
                                      DEFAULT_GEN_FILES,
                                      DEFAULT_MESH_FILES,
                                      DEFAULT_MONITOR_FILES,
@@ -97,9 +98,13 @@ def test_in_tree_corpus_is_clean(report):
     # consumers + the mesh bench driver (ISSUE 19)
     assert len(DEFAULT_MESH_FILES) == 6
     assert "mesh" in report.passes
-    # a–n all registered and all ran in the default lane
-    assert sorted(FAMILIES) == list("abcdefghijklmn")
-    assert report.families == list("abcdefghijklmn")
+    # the device-work-queue family (o): the queue/drain plane + the
+    # window and bench drivers (ISSUE 20)
+    assert len(DEFAULT_DEVQ_FILES) == 4
+    assert "devq" in report.passes
+    # a–o all registered and all ran in the default lane
+    assert sorted(FAMILIES) == list("abcdefghijklmno")
+    assert report.families == list("abcdefghijklmno")
     assert report.ok, "\n".join(
         f"{f.rule_id} {f.location}: {f.message}" for f in report.errors)
 
@@ -509,6 +514,63 @@ def test_mesh_live_tree_is_clean():
     assert findings == []
 
 
+def test_devq_unbounded_queue_is_caught():
+    """The devq pass's bulb check (family o, ISSUE 20): the queue stub
+    whose pending map AND done-tombstone log grow with no cap
+    comparison or eviction fires QSM-DEVQ-UNBOUNDED once per unbounded
+    attribute; the capped/pruning twin (queue.py _evict_over_cap +
+    tail-window tombstone trim shapes) must NOT be flagged."""
+    from qsm_tpu.analysis.devq_passes import check_devq_file
+
+    # scope to the devq stubs: families k/m's unbounded fixtures in the
+    # same file legitimately trip this shared scan too (their own tests
+    # cover them)
+    findings = [f for f in check_devq_file(fixtures.__file__)
+                if f.rule_id == "QSM-DEVQ-UNBOUNDED"
+                and "DevqStub" in f.location]
+    assert len(findings) == 2  # self.pending and self.done
+    assert {f.severity for f in findings} == {ERROR}
+    assert all("UnboundedDevqStub" in f.location for f in findings)
+    assert any("self.pending" in f.message for f in findings)
+    assert any("self.done" in f.message for f in findings)
+    assert not any("BoundedDevqStub" in f.location for f in findings)
+
+
+def test_devq_deadline_blind_drain_is_caught():
+    """Family o's second rule (QSM-DEVQ-DRAIN): the drain stub whose
+    while-loop never consults the window deadline fires; the
+    deadline-gated twin (the DrainScheduler.drain `remaining` shape)
+    must NOT be flagged.  (The family-g counter fixtures' `_drain`
+    threads in the same file trip the name heuristic too — scoped out,
+    their own tests cover them.)"""
+    from qsm_tpu.analysis.devq_passes import check_devq_file
+
+    blind = [f for f in check_devq_file(fixtures.__file__)
+             if f.rule_id == "QSM-DEVQ-DRAIN"
+             and "drain_queue" in f.location]
+    assert len(blind) == 1  # DeadlineBlindDrainStub.drain_queue only:
+    # the gated twin's drain_queue consults `remaining` and stays clean
+    assert blind[0].severity == ERROR
+    assert "deadline" in blind[0].message
+
+
+def test_devq_live_tree_is_clean():
+    """The devq plane keeps its own discipline: capped pending map +
+    tombstone trim (queue.py), every drain while-loop consulting the
+    remaining window time (drain.py, tools/window_drain.py)."""
+    import os
+
+    from qsm_tpu.analysis.devq_passes import check_devq_file
+    from qsm_tpu.analysis.engine import REPO_ROOT
+
+    findings = []
+    for rel in DEFAULT_DEVQ_FILES:
+        p = os.path.join(REPO_ROOT, rel)
+        if os.path.exists(p):
+            findings += check_devq_file(p, root=REPO_ROOT)
+    assert findings == []
+
+
 def test_protocol_fixture_matrix():
     """The protocol pass's bulb check (family l, ISSUE 16): the
     miswired pair fires QSM-PROTO-UNHANDLED (undispatched ``mis.ghost``
@@ -615,12 +677,12 @@ def test_lint_report_carries_protocol_summary(report):
     """``qsm-tpu lint --json`` exposes the contract trend block —
     bench_report.py rows key off these counts."""
     assert report.protocol is not None
-    assert report.protocol["ops"] == 23
+    assert report.protocol["ops"] == 27
     assert report.protocol["handled_ops"] == report.protocol["ops"]
     assert report.protocol["called_ops"] == report.protocol["ops"]
     # shutdown is the one deliberately non-idempotent op, and it must
     # never appear on a retrying path
-    assert report.protocol["idempotent_ops"] == 22
+    assert report.protocol["idempotent_ops"] == 26
     assert "shutdown" not in report.protocol["retried_ops"]
 
 

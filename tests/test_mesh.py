@@ -256,3 +256,51 @@ def test_monitor_frontier_recheck_through_sharded_oracle():
     plain = drive(JaxTPU(spec, budget=200_000))
     assert sharded == plain
     assert sharded[-1] is not None
+
+
+# ---------------------------------------------------------------------------
+# in-process pins: the window's mesh comes from its probed device SET
+# ---------------------------------------------------------------------------
+
+def test_mesh_from_devices_uses_the_explicit_list():
+    """The ISSUE 20 bugfix, pinned: a drain mesh is built from the
+    devices the window's probe ACTUALLY answered with — order
+    preserved, size = len(list), never a forced count over
+    ``jax.devices()`` (a 2-chip window must not lay out 8 shards)."""
+    import jax
+
+    from qsm_tpu.mesh import mesh_device_count, mesh_from_devices
+
+    window = jax.devices()[1:4]          # a window that offered 3 chips
+    mesh = mesh_from_devices(window)
+    assert mesh_device_count(mesh) == 3
+    assert list(mesh.devices.flat) == list(window)
+    assert mesh.axis_names == ("batch",)
+
+
+def test_mesh_from_devices_refuses_empty_and_duplicates():
+    import jax
+    import pytest
+
+    from qsm_tpu.mesh import mesh_from_devices
+
+    with pytest.raises(ValueError, match="empty device set"):
+        mesh_from_devices([])
+    d0 = jax.devices()[0]
+    with pytest.raises(ValueError, match="duplicate devices"):
+        mesh_from_devices([d0, d0])
+
+
+def test_drain_scheduler_builds_mesh_from_window_devices():
+    """The drain scheduler threads the probed set through
+    ``mesh_from_devices``: hand it 3 of the process's 8 devices and
+    its mesh is exactly 3 wide."""
+    import jax
+
+    from qsm_tpu.devq.drain import DrainScheduler
+    from qsm_tpu.devq.queue import DeviceWorkQueue
+
+    sched = DrainScheduler(DeviceWorkQueue(),
+                           devices=jax.devices()[:3], window_s=1.0,
+                           cache=None)
+    assert sched.n_devices == 3

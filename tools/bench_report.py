@@ -60,6 +60,10 @@ _HEADLINE_KEYS = (
     # resumes the chaos schedule forced, how many rode banked decided
     # prefixes, and the standby-takeover latency
     "resume_restored_total", "prefix_hits_total", "router_takeover_s",
+    # the DEVQ artifact's window-arbitrage trend: fraction of the
+    # simulated window spent in engine dispatch (the serve `health`
+    # SLO) and how much banked work the window paid down
+    "window_utilization", "items_drained", "host_lanes_per_sec",
     "value", "p50_ms", "p99_ms",
     # the LINT artifact's wire-contract trend (flattened from its
     # nested ``protocol`` block): op vocabulary size, handler/caller

@@ -43,6 +43,11 @@ REPO = "/root/repo"
 LOG = os.path.join(REPO, "probe_log.jsonl")
 WINDOW_ARTIFACT = os.path.join(REPO, "BENCH_TPU_WINDOW.json")
 
+# The persistent device-work queue a serve node banks into (serve
+# --devq-dir; docs/WINDOWS.md).  None -> REPO/devq, resolved lazily so
+# the tests' sandboxed REPO is honored; QSM_DEVQ_DIR overrides both.
+DEVQ_DIR: str | None = None
+
 # Round-stamped COMMITTED twins of the gitignored runtime artifacts
 # (VERDICT.md round 3, "Next round" #1: a caught window must leave
 # committed evidence — the driver commits any uncommitted files at round
@@ -80,111 +85,74 @@ CAPTURES_LOG = os.path.join(REPO, f"BENCH_TPU_CAPTURES_{ROUND_TAG}.jsonl")
 # interprocedural race analyzer), independent of the window artifacts'
 # ROUND_TAG — renaming those retires banked measurements, renaming this
 # just says which rule set produced the findings.
-LINT_ROUND = "r19"  # family (n): mesh-dispatch discipline — r19
+LINT_ROUND = "r20"  # family (o): device-work-queue discipline — r20
 LINT_ARTIFACT = os.path.join(REPO, f"LINT_{LINT_ROUND}.json")
 
-# Committed archive of the P-compositionality bench (tools/
-# bench_pcomp.py): HOST-ONLY — kv long-history corpora on the cpp→memo
-# ladder, no window involved — so the watcher refreshes it off-window
-# like the lint gate, on CellJournal --resume rails.  Tracks its own
-# round tag (the decomposition plane landed in r09), decoupled from
-# the window artifacts' ROUND_TAG.
-PCOMP_ROUND = "r09"
-PCOMP_ARTIFACT = os.path.join(REPO, f"BENCH_PCOMP_{PCOMP_ROUND}.json")
-# full scan = (decomp + whole) × 3 sizes + serve_pool + summary
-PCOMP_MIN_ROWS = 8
-_PCOMP_STATE: dict = {"attempted": False}
+# --- off-window archive registry ------------------------------------
+# Every HOST-ONLY gate artifact is ONE declarative row here — script,
+# round-stamped filename, full-scan row floor, log event, time bound —
+# replacing the seven hand-cloned constant blocks + ``_maybe_archive_*``
+# wrappers that each prior plane pasted in (and that drifted: adding a
+# plane meant editing three places).  Each runs once per watcher
+# process, on CellJournal --resume rails, entirely off-window: device
+# probing is untouched (host work; the tunnel's state is irrelevant).
+# Round tags are per-plane — each tracks the round its bench semantics
+# last changed, decoupled from the window artifacts' ROUND_TAG.
+class ArchiveGate:
+    """One host-only committed bench artifact the watcher keeps banked."""
 
-# Committed archive of the batched-shrink bench (tools/bench_shrink.py):
-# HOST-ONLY like the pcomp gate — racy kv/cas failing corpora,
-# frontier-at-once vs one-at-a-time — refreshed off-window on
-# CellJournal --resume rails.  Tracks its own round tag (the shrink
-# plane landed in r10), decoupled from the window artifacts' ROUND_TAG.
-SHRINK_ROUND = "r10"
-SHRINK_ARTIFACT = os.path.join(REPO, f"BENCH_SHRINK_{SHRINK_ROUND}.json")
-# full scan = (batched + naive) × 2 families + serve_shrink + summary
-SHRINK_MIN_ROWS = 6
-_SHRINK_STATE: dict = {"attempted": False}
+    def __init__(self, key: str, script: str, round_tag: str,
+                 min_rows: int, event: str, timeout: float, doc: str):
+        self.key = key
+        self.script = script          # under tools/, CellJournal rails
+        self.round_tag = round_tag
+        self.min_rows = min_rows      # full-scan measured-row floor
+        self.event = event            # probe_log event name
+        self.timeout = timeout
+        self.doc = doc
+        self.attempted = False        # once per watcher process
 
-# Committed archive of the obs-overhead bench (tools/bench_obs.py):
-# HOST-ONLY like the pcomp/shrink gates — the serve path with obs
-# absent / tracing off / tracing on — refreshed off-window on
-# CellJournal --resume rails so windows archive a trace/metrics cost
-# snapshot beside the BENCH/LINT artifacts.  Tracks its own round tag
-# (the trace plane landed in r11; fleet collection/federation cells
-# joined in r15).
-OBS_ROUND = "r15"
-OBS_ARTIFACT = os.path.join(REPO, f"BENCH_OBS_{OBS_ROUND}.json")
-# full scan = no_obs + tracing_off + tracing_on + 2 fleet cells +
-# federation_scrape + summary
-OBS_MIN_ROWS = 7
-_OBS_STATE: dict = {"attempted": False}
+    @property
+    def artifact(self) -> str:
+        # lazy: REPO is monkeypatched into a sandbox by the tests
+        stem = ("BENCH_SESSIONS" if self.key == "sessions"
+                else f"BENCH_{self.key.upper()}")
+        return os.path.join(REPO, f"{stem}_{self.round_tag}.json")
 
-# Committed archive of the fleet soak (tools/bench_fleet.py): HOST-ONLY
-# like the pcomp/shrink/obs gates — 1/2/3-node fleets on a recorded
-# traffic mix with kill/wedge/partition/rolling-restart chaos cells —
-# refreshed off-window on CellJournal --resume rails.  Tracks its own
-# round tag (the fleet tier landed in r12).
-FLEET_ROUND = "r13"
-FLEET_ARTIFACT = os.path.join(REPO, f"BENCH_FLEET_{FLEET_ROUND}.json")
-# full scan = 3 scaling cells + 4 node-chaos cells + 3 router-HA/
-# gossip cells (r13) + summary
-FLEET_MIN_ROWS = 11
-_FLEET_STATE: dict = {"attempted": False}
 
-# Committed archive of the monitor bench (tools/bench_monitor.py):
-# HOST-ONLY like the other off-window gates — a growing event stream
-# decided incrementally vs from scratch, decided-prefix bank resume,
-# flip-to-push latency, streamed-vs-oneshot parity — refreshed
-# off-window on CellJournal --resume rails.  Tracks its own round tag
-# (the monitor plane landed in r14).
-MONITOR_ROUND = "r14"
-MONITOR_ARTIFACT = os.path.join(REPO,
-                                f"BENCH_MONITOR_{MONITOR_ROUND}.json")
-# full scan = streamed + resume + scratch + flip + parity + summary
-MONITOR_MIN_ROWS = 6
-_MONITOR_STATE: dict = {"attempted": False}
-
-# Committed archive of the generation bench (tools/bench_gen.py):
-# HOST-ONLY like the other off-window gates — steered vs unsteered
-# fuzzing at matched engine-call budget, the flip/witness audit, and
-# the 2-node closed-loop soak — refreshed off-window on CellJournal
-# --resume rails.  Tracks its own round tag (the generation plane
-# landed in r17).
-GEN_ROUND = "r17"
-GEN_ARTIFACT = os.path.join(REPO, f"BENCH_GEN_{GEN_ROUND}.json")
-# full scan = (steered + unsteered) × 3 families + flip_audit +
-# soak_fleet + summary
-GEN_MIN_ROWS = 9
-_GEN_STATE: dict = {"attempted": False}
-
-# Committed archive of the durable-session chaos soak (tools/
-# soak_sessions.py): HOST-ONLY like the other off-window gates —
-# ≥1000 concurrent monitor sessions held open through a rolling node
-# restart, an active-router SIGKILL with standby takeover off the
-# shared lease + session-journal stores, and one node leave + one
-# node join with handoff — refreshed off-window on CellJournal
-# --resume rails.  Tracks its own round tag (the durable-session
-# plane landed in r18).
-SESSIONS_ROUND = "r18"
-SESSIONS_ARTIFACT = os.path.join(REPO,
-                                 f"BENCH_SESSIONS_{SESSIONS_ROUND}.json")
-# full scan = soak + summary
-SESSIONS_MIN_ROWS = 2
-_SESSIONS_STATE: dict = {"attempted": False}
-
-# Committed archive of the mesh-dispatch bench (tools/bench_mesh.py):
-# HOST-ONLY like the other off-window gates — forced virtual CPU
-# devices stand in for the lane axis, so the lanes/sec-by-width curve,
-# the bit-identical parity verdict across mesh widths 1/2/4/8 and the
-# DECIDED (no longer waived) 3-vs-1-node fleet ratio are all banked
-# without a window — refreshed off-window on CellJournal --resume
-# rails.  Tracks its own round tag (the mesh substrate landed in r19).
-MESH_ROUND = "r19"
-MESH_ARTIFACT = os.path.join(REPO, f"BENCH_MESH_{MESH_ROUND}.json")
-# full scan = oracle + 4 scale widths + parity + 2 fleet + summary
-MESH_MIN_ROWS = 9
-_MESH_STATE: dict = {"attempted": False}
+ARCHIVE_GATES = [
+    ArchiveGate("pcomp", "bench_pcomp.py", "r09", 8, "pcomp_bench",
+                1800.0, "P-compositionality: kv long-history corpora, "
+                "decomp vs whole on the cpp→memo ladder"),
+    ArchiveGate("shrink", "bench_shrink.py", "r10", 6, "shrink_bench",
+                1800.0, "batched shrink: frontier-at-once vs "
+                "one-at-a-time on racy kv/cas failing corpora"),
+    ArchiveGate("obs", "bench_obs.py", "r15", 7, "obs_bench", 900.0,
+                "obs overhead: serve path with obs absent / tracing "
+                "off / tracing on + fleet collection/federation"),
+    ArchiveGate("fleet", "bench_fleet.py", "r13", 11, "fleet_bench",
+                1200.0, "fleet soak: 1/2/3-node scaling + kill/wedge/"
+                "partition/rolling-restart chaos + router-HA/gossip"),
+    ArchiveGate("monitor", "bench_monitor.py", "r14", 6,
+                "monitor_bench", 900.0, "monitor: streamed vs scratch, "
+                "bank resume, flip-to-push, streamed-vs-oneshot parity"),
+    ArchiveGate("gen", "bench_gen.py", "r17", 9, "gen_bench", 900.0,
+                "generation: steered vs unsteered at matched budget, "
+                "flip/witness audit, closed-loop soak"),
+    ArchiveGate("sessions", "soak_sessions.py", "r18", 2,
+                "sessions_soak", 1500.0, "durable-session chaos soak: "
+                "≥1000 sessions through restarts/takeover/handoff"),
+    ArchiveGate("mesh", "bench_mesh.py", "r19", 9, "mesh_bench",
+                2700.0, "mesh dispatch: lanes/sec-by-width curve, "
+                "cross-width parity, decided fleet-scaling gate"),
+    # window arbitrage (r20): simulated 8-device window drains a banked
+    # four-plane queue — zero wrong verdicts vs the host ladder, exactly-
+    # once kill/resume, utilization ≥ the SLO floor.  Full scan = bank +
+    # drain + kill_resume + host_baseline + fleet + summary.
+    ArchiveGate("devq", "bench_devq.py", "r20", 6, "devq_bench",
+                1200.0, "device-work queue: banked planes drained in a "
+                "simulated window, oracle-proved, exactly-once resume"),
+]
 
 # Cached verdict of the pre-seize lint gate, keyed on a SOURCE
 # fingerprint — not process lifetime: the watcher runs all round while
@@ -331,99 +299,33 @@ def _maybe_compact_probe_log() -> None:
              rows_before=rows, detail=f"{type(e).__name__}: {e}")
 
 
-def _maybe_archive(state: dict, artifact: str, script_name: str,
-                   min_rows: int, event: str, timeout: float) -> None:
-    """Off-window: (re)bank one host-only CellJournal bench artifact
-    when it is missing or incomplete.  Once per watcher process (the
-    benches are minutes of host CPU), and --resume means a partial
-    from a killed attempt is finished, not re-paid.  Device probing is
-    untouched (host work; the tunnel's state is irrelevant)."""
-    if state["attempted"]:
+def _maybe_archive(gate: ArchiveGate) -> None:
+    """Off-window: (re)bank one registered host-only CellJournal bench
+    artifact when it is missing or incomplete.  Once per watcher
+    process (the benches are minutes of host CPU), and --resume means
+    a partial from a killed attempt is finished, not re-paid."""
+    if gate.attempted:
         return
-    state["attempted"] = True
-    if _tool_rows(artifact) >= min_rows:
-        _log(event=event, ok=True, detail="already banked; kept")
+    gate.attempted = True
+    artifact = gate.artifact
+    if _tool_rows(artifact) >= gate.min_rows:
+        _log(event=gate.event, ok=True, detail="already banked; kept")
         return
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          script_name)
+                          gate.script)
     try:
         r = subprocess.run(
             [sys.executable, script, "--out", artifact, "--resume"],
-            capture_output=True, text=True, timeout=timeout, cwd=REPO,
-            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            capture_output=True, text=True, timeout=gate.timeout,
+            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"))
         detail = (r.stdout or r.stderr or "").strip()[-200:]
-        _log(event=event, ok=r.returncode == 0,
+        _log(event=gate.event, ok=r.returncode == 0,
              rows=_tool_rows(artifact), detail=detail)
     except (subprocess.TimeoutExpired, OSError) as e:
         # the journal keeps every completed cell; the next watcher
         # process resumes from there
-        _log(event=event, ok=False, rows=_tool_rows(artifact),
+        _log(event=gate.event, ok=False, rows=_tool_rows(artifact),
              detail=f"{type(e).__name__}: {e}")
-
-
-def _maybe_archive_pcomp(timeout: float = 1800.0) -> None:
-    """The P-compositionality gate artifact (tools/bench_pcomp.py)."""
-    _maybe_archive(_PCOMP_STATE, PCOMP_ARTIFACT, "bench_pcomp.py",
-                   PCOMP_MIN_ROWS, "pcomp_bench", timeout)
-
-
-def _maybe_archive_shrink(timeout: float = 1800.0) -> None:
-    """The batched-shrink gate artifact (tools/bench_shrink.py)."""
-    _maybe_archive(_SHRINK_STATE, SHRINK_ARTIFACT, "bench_shrink.py",
-                   SHRINK_MIN_ROWS, "shrink_bench", timeout)
-
-
-def _maybe_archive_obs(timeout: float = 900.0) -> None:
-    """The obs-overhead artifact (tools/bench_obs.py): windows always
-    have a current trace/metrics cost snapshot archived beside the
-    BENCH/LINT artifacts."""
-    _maybe_archive(_OBS_STATE, OBS_ARTIFACT, "bench_obs.py",
-                   OBS_MIN_ROWS, "obs_bench", timeout)
-
-
-def _maybe_archive_fleet(timeout: float = 1200.0) -> None:
-    """The fleet soak artifact (tools/bench_fleet.py): the survival
-    gates (kill/wedge/partition/rolling-restart at zero wrong and zero
-    lost verdicts) archived beside the other host-only gates."""
-    _maybe_archive(_FLEET_STATE, FLEET_ARTIFACT, "bench_fleet.py",
-                   FLEET_MIN_ROWS, "fleet_bench", timeout)
-
-
-def _maybe_archive_monitor(timeout: float = 900.0) -> None:
-    """The monitor bench artifact (tools/bench_monitor.py): the
-    streamed-vs-scratch incrementality ratio, the decided-prefix bank
-    resume and the flip-to-push latency archived beside the other
-    host-only gates."""
-    _maybe_archive(_MONITOR_STATE, MONITOR_ARTIFACT, "bench_monitor.py",
-                   MONITOR_MIN_ROWS, "monitor_bench", timeout)
-
-
-def _maybe_archive_gen(timeout: float = 900.0) -> None:
-    """The generation bench artifact (tools/bench_gen.py): the
-    steered-vs-unsteered flip/node ratios, the zero-miss flip audit
-    and the closed-loop soak verdict archived beside the other
-    host-only gates."""
-    _maybe_archive(_GEN_STATE, GEN_ARTIFACT, "bench_gen.py",
-                   GEN_MIN_ROWS, "gen_bench", timeout)
-
-
-def _maybe_archive_sessions(timeout: float = 1500.0) -> None:
-    """The durable-session soak artifact (tools/soak_sessions.py):
-    the chaos-schedule survival verdict (zero wrong verdicts, zero
-    lost flips, every resume off banked decided prefixes) archived
-    beside the other host-only gates."""
-    _maybe_archive(_SESSIONS_STATE, SESSIONS_ARTIFACT,
-                   "soak_sessions.py", SESSIONS_MIN_ROWS,
-                   "sessions_soak", timeout)
-
-
-def _maybe_archive_mesh(timeout: float = 2700.0) -> None:
-    """The mesh-dispatch bench artifact (tools/bench_mesh.py): the
-    lanes/sec-by-mesh-width curve, the cross-width parity verdict at
-    zero wrong verdicts and the decided fleet-scaling gate archived
-    beside the other host-only gates."""
-    _maybe_archive(_MESH_STATE, MESH_ARTIFACT, "bench_mesh.py",
-                   MESH_MIN_ROWS, "mesh_bench", timeout)
 
 
 def _run_window_bench(bench_timeout: float, extra_args, label: str,
@@ -628,6 +530,45 @@ def _run_tool(script: str, out_path: str, timeout: float, label: str,
                        "kept the richer bank"} if demoted else {}))
 
 
+def _maybe_drain_devq(budget_s: float) -> None:
+    """Window arbitrage (qsm_tpu/devq, docs/WINDOWS.md): spend part of
+    the open window on the banked device-work queue.  Runs
+    tools/window_drain.py in a bounded subprocess — it re-probes, builds
+    the mesh from the probed device set, drains in score order with the
+    window deadline threaded through, and commits the drain artifact
+    beside the bench evidence.  A missing/empty queue costs one stat."""
+    devq_dir = (os.environ.get("QSM_DEVQ_DIR") or DEVQ_DIR
+                or os.path.join(REPO, "devq"))
+    if not os.path.isdir(devq_dir):
+        return  # no node ever banked here: nothing to say, even in the log
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "window_drain.py")
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, script, "--dir", devq_dir,
+             "--window-s", str(max(30.0, budget_s * 0.9)),
+             "--out", os.path.join(REPO, "DEVQ_DRAIN_WINDOW.json"),
+             "--resume"],
+            capture_output=True, text=True, timeout=budget_s, cwd=REPO)
+        line = (r.stdout or "").strip().splitlines()
+        try:
+            rep = json.loads(line[-1]) if line else {}
+        except ValueError:
+            rep = {}
+        _log(event="window_devq_drain", ok=r.returncode == 0,
+             seconds=round(time.time() - t0, 1),
+             drained=rep.get("drained"),
+             utilization=rep.get("window_utilization"),
+             detail=(r.stderr or "").strip()[-200:])
+    except (subprocess.TimeoutExpired, OSError) as e:
+        # the drain journals per item (CellJournal): a window that
+        # closes mid-drain resumes exactly-once from the journal
+        _log(event="window_devq_drain", ok=False,
+             seconds=round(time.time() - t0, 1),
+             detail=f"{type(e).__name__}: {e}")
+
+
 def _headline_settings() -> dict:
     """(batch, unroll) the banked headline actually ran with, or {}."""
     try:
@@ -756,6 +697,10 @@ def _seize_window(bench_timeout: float) -> bool:
     # chase the upgrades only while the window is demonstrably open;
     # after a failed bank the flicker closed — a full sweep on the
     # CPU fallback would block probing for up to bench_timeout.
+    # --- 2.5 window arbitrage: drain the banked device-work queue -------
+    # (bounded; the demonstrably-open window pays for fleet-banked work
+    # before the long sweep can eat the rest of it)
+    _maybe_drain_devq(bench_timeout / 4)
     # --- 3. e2e: the on-chip trial_batch A/B -----------------------------
     if e2e_done:
         _log(event="window_e2e", ok=True, detail="already banked; kept")
@@ -802,17 +747,11 @@ def main() -> int:
         # the CPU while the tunnel is (typically) wedged anyway, so a
         # later healed window is never spent on it
         _preflight_lint()
-        # same logic for the host-only pcomp/shrink bench artifacts:
+        # same logic for every registered host-only gate artifact:
         # bank them off-window so no healed window ever waits behind
-        # them
-        _maybe_archive_pcomp()
-        _maybe_archive_shrink()
-        _maybe_archive_obs()
-        _maybe_archive_fleet()
-        _maybe_archive_monitor()
-        _maybe_archive_gen()
-        _maybe_archive_sessions()
-        _maybe_archive_mesh()
+        # them (ARCHIVE_GATES — one declarative row per plane)
+        for gate in ARCHIVE_GATES:
+            _maybe_archive(gate)
     while True:
         t0 = time.time()
         _maybe_compact_probe_log()  # bounded; no-op below the threshold

@@ -1,0 +1,110 @@
+"""Drain the persistent device-work queue into an open TPU window.
+
+The watcher (tools/probe_watcher.py) calls this the moment a probe finds
+the tunnel healed; ``make bench-devq`` calls it under a forced virtual
+CPU mesh so the whole drain plane is benchable without hardware.  One
+bounded run:
+
+1. re-probe the default backend (bounded subprocess; the window may
+   have closed between the watcher's probe and this launch) — unless
+   ``--force-devices`` forces a virtual CPU mesh for the simulated path;
+2. load the queue at ``--dir`` (qsm_tpu/devq), build the drain mesh from
+   the devices the probe ACTUALLY found (mesh/topology.py
+   ``mesh_from_devices`` — never a forced count; a 2-chip window must
+   not be asked to lay out 8 shards), and spend the window on the
+   queue in score order with the deadline threaded through every item;
+3. every verdict is re-proved by a fresh host memo oracle before it is
+   banked under the exact fingerprint the originating plane recorded
+   (qsm_tpu/devq/drain.py — soundness does not ride on the device);
+4. write the drain report to ``--out`` atomically and print it as ONE
+   JSON line.  ``--resume`` replays the per-item CellJournal, so a
+   window that closed (or a process that was SIGKILLed) mid-drain
+   re-dispatches nothing it already proved: exactly-once banking.
+
+Exit codes: 0 drained (or empty queue), 3 window closed at re-probe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qsm_tpu.resilience.checkpoint import atomic_write_json  # noqa: E402
+from qsm_tpu.utils.device import (forced_host_device_env,  # noqa: E402
+                                  probe_default_backend)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", required=True,
+                    help="device-work queue directory (serve --devq-dir)")
+    ap.add_argument("--out", default=None,
+                    help="drain report artifact (atomic; default "
+                         "DEVQ_DRAIN_WINDOW.json beside --dir)")
+    ap.add_argument("--cache", default=None,
+                    help="persistent verdict-cache bank to land proofs "
+                         "in (serve --cache path); default: "
+                         "<dir>/drain_cache.jsonl")
+    ap.add_argument("--window-s", type=float, default=300.0,
+                    help="wall-clock budget; every item's dispatch "
+                         "deadline is bounded by what remains of it")
+    ap.add_argument("--window-id", default="window",
+                    help="journal identity: --resume with the SAME id "
+                         "skips every item this id already proved")
+    ap.add_argument("--resume", action="store_true",
+                    help="replay the per-item journal; proved items "
+                         "are banked again, never re-dispatched")
+    ap.add_argument("--force-devices", type=int, default=None,
+                    help="simulated window: re-exec under a forced "
+                         "N-device virtual CPU mesh and skip the probe "
+                         "(bench/CI path; see docs/WINDOWS.md)")
+    ap.add_argument("--budget", type=int, default=2000,
+                    help="per-lane node budget for the device backends")
+    args = ap.parse_args()
+
+    if args.force_devices and "--xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # the flag must precede the first backend init: re-exec, don't set
+        env = forced_host_device_env(args.force_devices)
+        os.execve(sys.executable, [sys.executable, *sys.argv], env)
+
+    if not args.force_devices:
+        from qsm_tpu.resilience.policy import preset
+
+        p = probe_default_backend(policy=preset("window-reprobe"))
+        if not p.is_device:
+            print(json.dumps({"error": "window closed at re-probe",
+                              "detail": p.detail[:200]}), flush=True)
+            return 3
+
+    import jax
+
+    from qsm_tpu.devq import DeviceWorkQueue, DrainScheduler
+    from qsm_tpu.serve.cache import VerdictCache
+
+    queue = DeviceWorkQueue(args.dir)
+    out = args.out or os.path.join(args.dir, "..",
+                                   "DEVQ_DRAIN_WINDOW.json")
+    cache = VerdictCache(
+        max_entries=65536,
+        path=args.cache or os.path.join(args.dir, "drain_cache.jsonl"))
+    sched = DrainScheduler(
+        queue, cache=cache,
+        devices=jax.devices(),  # the window's ACTUAL device set
+        window_s=args.window_s,
+        journal_path=os.path.join(args.dir, "drain_journal.jsonl"),
+        window_id=args.window_id, resume=args.resume,
+        budget=args.budget)
+    report = sched.drain()
+    cache.flush()
+    atomic_write_json(out, report)
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
